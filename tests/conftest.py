@@ -1,0 +1,23 @@
+"""Test environment: force the CPU backend with 8 virtual devices so every
+sharding/mesh test runs cluster-free (SURVEY.md §4 implication; the driver's
+multi-chip dryrun uses the same mechanism).
+
+This container's sitecustomize registers a remote TPU ("axon") PJRT plugin
+and forces jax_platforms="axon,cpu" at interpreter start; tests must not
+depend on (or block on) the TPU tunnel, so we override the config back to
+cpu here — conftest imports after sitecustomize, before any backend
+initialization.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

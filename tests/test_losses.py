@@ -1,0 +1,97 @@
+"""Loss functions and their gradients against hand-derived formulas
+(reference strategy: tests/polybeast_loss_functions_test.py — values AND
+gradients, e.g. the softmax jacobian for the pg loss, and the requirement
+that advantages receive no gradient)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.ops import (
+    compute_baseline_loss,
+    compute_entropy_loss,
+    compute_policy_gradient_loss,
+)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_baseline_loss_value_and_grad():
+    adv = np.array([[1.0, -2.0], [3.0, 0.5]], dtype=np.float32)
+    loss = compute_baseline_loss(jnp.asarray(adv))
+    np.testing.assert_allclose(loss, 0.5 * (adv ** 2).sum(), rtol=1e-6)
+    # d/dx 0.5 x^2 = x
+    grad = jax.grad(lambda a: compute_baseline_loss(a))(jnp.asarray(adv))
+    np.testing.assert_allclose(grad, adv, rtol=1e-6)
+
+
+def test_entropy_loss_value():
+    rng = np.random.default_rng(3)
+    logits = rng.standard_normal((4, 3, 5)).astype(np.float32)
+    p = _softmax(logits)
+    expected = (p * np.log(p)).sum()
+    loss = compute_entropy_loss(jnp.asarray(logits))
+    np.testing.assert_allclose(loss, expected, rtol=1e-5)
+
+
+def test_entropy_loss_uniform_is_minimal():
+    # Uniform policy has maximal entropy -> minimal (most negative) loss.
+    uniform = jnp.zeros((1, 1, 8))
+    peaked = jnp.asarray([[np.eye(8)[0] * 10]])
+    assert compute_entropy_loss(uniform) < compute_entropy_loss(peaked)
+    np.testing.assert_allclose(
+        compute_entropy_loss(uniform), -np.log(8), rtol=1e-6
+    )
+
+
+def test_pg_loss_value():
+    rng = np.random.default_rng(4)
+    T, B, A = 5, 3, 4
+    logits = rng.standard_normal((T, B, A)).astype(np.float32)
+    actions = rng.integers(0, A, size=(T, B))
+    adv = rng.standard_normal((T, B)).astype(np.float32)
+
+    log_p = np.log(_softmax(logits))
+    ce = -np.take_along_axis(log_p, actions[..., None], -1)[..., 0]
+    expected = (ce * adv).sum()
+
+    loss = compute_policy_gradient_loss(
+        jnp.asarray(logits), jnp.asarray(actions), jnp.asarray(adv)
+    )
+    np.testing.assert_allclose(loss, expected, rtol=1e-4)
+
+
+def test_pg_loss_grad_is_weighted_softmax_jacobian():
+    # d/dlogits [-log pi(a) * adv] = (softmax(logits) - onehot(a)) * adv
+    # (hand-derived, same check as reference
+    # tests/polybeast_loss_functions_test.py:136-163).
+    rng = np.random.default_rng(5)
+    T, B, A = 3, 2, 5
+    logits = rng.standard_normal((T, B, A)).astype(np.float32)
+    actions = rng.integers(0, A, size=(T, B))
+    adv = rng.standard_normal((T, B)).astype(np.float32)
+
+    grad = jax.grad(compute_policy_gradient_loss)(
+        jnp.asarray(logits), jnp.asarray(actions), jnp.asarray(adv)
+    )
+    onehot = np.eye(A)[actions]
+    expected = (_softmax(logits) - onehot) * adv[..., None]
+    np.testing.assert_allclose(grad, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_pg_loss_advantages_get_no_gradient():
+    # Advantages are stop_gradient'ed inside the loss
+    # (reference .detach(); tests/polybeast_loss_functions_test.py:165-177).
+    rng = np.random.default_rng(6)
+    logits = jnp.asarray(rng.standard_normal((3, 2, 4)).astype(np.float32))
+    actions = jnp.asarray(rng.integers(0, 4, size=(3, 2)))
+
+    def loss_of_adv(adv):
+        return compute_policy_gradient_loss(logits, actions, adv)
+
+    grad = jax.grad(loss_of_adv)(jnp.ones((3, 2)))
+    np.testing.assert_allclose(grad, np.zeros((3, 2)))

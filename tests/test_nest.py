@@ -1,0 +1,43 @@
+"""Nest API semantics (reference: nest/nest_test.py:68-119; refcount tests
+don't apply — pytrees hold no C++ state)."""
+
+import pytest
+
+from torchbeast_tpu import nest
+
+
+def test_map_preserves_structure():
+    n = {"a": (1, 2), "b": [3, {"c": 4}]}
+    out = nest.map(lambda x: x * 10, n)
+    assert out == {"a": (10, 20), "b": [30, {"c": 40}]}
+
+
+def test_flatten_and_pack_as_roundtrip():
+    n = {"a": (1, 2), "b": [3, 4]}
+    flat = nest.flatten(n)
+    assert flat == [1, 2, 3, 4]
+    packed = nest.pack_as(n, [x + 1 for x in flat])
+    assert packed == {"a": (2, 3), "b": [4, 5]}
+
+
+def test_pack_as_wrong_length_raises():
+    with pytest.raises(ValueError):
+        nest.pack_as((1, 2, 3), [1, 2])
+
+
+def test_map_many2():
+    out = nest.map_many2(lambda a, b: a + b, {"x": 1, "y": (2, 3)}, {"x": 10, "y": (20, 30)})
+    assert out == {"x": 11, "y": (22, 33)}
+
+
+def test_map_many_requires_nest():
+    with pytest.raises(ValueError):
+        nest.map_many(lambda: None)
+
+
+def test_front_and_flatten_use_sorted_key_order():
+    # JAX pytrees sort dict keys (documented divergence, see nest.py).
+    assert nest.flatten({"b": (7, 8), "a": [9]}) == [9, 7, 8]
+    assert nest.front({"b": (7, 8), "a": [9]}) == 9
+    with pytest.raises(ValueError):
+        nest.front(())

@@ -1,0 +1,14 @@
+"""torchbeast_tpu — a TPU-native IMPALA actor-learner framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+facebookresearch/torchbeast (reference layout mapped in SURVEY.md): CPU-side
+actors step environments (locally or behind a streaming env-server protocol),
+dynamic batching feeds a TPU inference server, and rollouts flow into a single
+jitted learner program (model forward, V-trace, losses, optimizer step) that
+scales over a `jax.sharding.Mesh` with ICI collectives.
+"""
+
+__version__ = "0.1.0"
+
+from torchbeast_tpu import nest  # noqa: F401
+from torchbeast_tpu.types import AgentOutput, EnvOutput  # noqa: F401

@@ -1,0 +1,27 @@
+"""Model registry.
+
+`create_model("shallow"|"deep", ...)` mirrors the reference's two families:
+MonoBeast's AtariNet (monobeast.py:545) and PolyBeast's deep ResNet
+(polybeast_learner.py:134).
+"""
+
+from torchbeast_tpu.models.atari_net import AtariNet  # noqa: F401
+from torchbeast_tpu.models.cores import LSTMCore  # noqa: F401
+from torchbeast_tpu.models.resnet import ResNet  # noqa: F401
+
+_REGISTRY = {
+    "shallow": AtariNet,
+    "atari": AtariNet,
+    "deep": ResNet,
+    "resnet": ResNet,
+}
+
+
+def create_model(name: str, num_actions: int, use_lstm: bool = False, **kwargs):
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown model {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(num_actions=num_actions, use_lstm=use_lstm, **kwargs)

@@ -1,0 +1,75 @@
+"""Shallow Atari network (the reference's MonoBeast `AtariNet`,
+/root/reference/torchbeast/monobeast.py:545-635), re-designed for TPU.
+
+Differences from the reference that are deliberate TPU-first choices:
+- NHWC frame layout (`[T, B, H, W, C]`) — XLA's native conv layout on TPU;
+  the env adapter produces HWC frames instead of torch's CHW.
+- A `dtype` knob: conv/fc compute can run in bfloat16 on the MXU while params
+  and the loss stay float32.
+- The per-timestep LSTM Python loop is an `nn.scan` (models/cores.py).
+
+API: `model.apply(vars, inputs, core_state, sample_action=..., rngs=...)
+-> (AgentOutput(action, policy_logits, baseline), core_state)` where `inputs`
+is a dict of time-major arrays: frame [T,B,H,W,C] uint8, reward [T,B],
+done [T,B] bool, last_action [T,B] int32.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
+
+
+class AtariNet(nn.Module):
+    num_actions: int
+    use_lstm: bool = False
+    dtype: Any = jnp.float32
+
+    @property
+    def core_output_size(self) -> int:
+        # fc output + clipped reward + one-hot last action
+        # (reference monobeast.py:564-566).
+        return 512 + self.num_actions + 1
+
+    @nn.compact
+    def __call__(self, inputs, core_state=(), *, sample_action: bool = True):
+        frame = inputs["frame"]  # [T, B, H, W, C] uint8
+        T, B = frame.shape[:2]
+        x = frame.reshape((T * B,) + frame.shape[2:])
+        x = x.astype(self.dtype) / 255.0
+
+        conv = lambda feat, k, s: nn.Conv(  # noqa: E731
+            feat, (k, k), strides=(s, s), padding="VALID", dtype=self.dtype
+        )
+        x = nn.relu(conv(32, 8, 4)(x))
+        x = nn.relu(conv(64, 4, 2)(x))
+        x = nn.relu(conv(64, 3, 1)(x))
+        x = x.reshape((T * B, -1))  # 7*7*64 = 3136 for 84x84 input
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = x.astype(jnp.float32)
+
+        one_hot_last_action = jax.nn.one_hot(
+            inputs["last_action"].reshape(T * B), self.num_actions
+        )
+        clipped_reward = jnp.clip(
+            inputs["reward"].astype(jnp.float32), -1, 1
+        ).reshape(T * B, 1)
+        core_input = jnp.concatenate(
+            [x, clipped_reward, one_hot_last_action], axis=-1
+        )
+
+        return RecurrentPolicyHead(
+            num_actions=self.num_actions,
+            use_lstm=self.use_lstm,
+            hidden_size=self.core_output_size,
+            num_layers=2,
+            name="head",
+        )(core_input, inputs["done"], core_state, T, B, sample_action)
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        return lstm_initial_state(
+            self.use_lstm, 2, self.core_output_size, batch_size
+        )

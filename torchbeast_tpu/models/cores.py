@@ -1,0 +1,135 @@
+"""Recurrent cores.
+
+The reference steps its LSTM in a Python loop over T with done-masking of the
+carried state (/root/reference/torchbeast/monobeast.py:599-611,
+polybeast_learner.py:237-249). On TPU that loop becomes `nn.scan` (lax.scan
+under jit): one compiled region, unrolled by XLA, state carried in registers/
+HBM without host sync.
+
+Core state layout matches the reference: a tuple `(h, c)`, each
+`[num_layers, B, hidden_size]` (torch nn.LSTM convention, monobeast.py:574-580).
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.types import AgentOutput
+
+
+class _StackedLSTMStep(nn.Module):
+    """One time-step of a multi-layer LSTM with episode-boundary reset."""
+
+    hidden_size: int
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        inp, notdone = xs  # inp: [B, D], notdone: [B] float
+        h, c = carry  # each [L, B, H]
+        # Reset state to zero wherever an episode ended before this step
+        # (reference monobeast.py:603-607).
+        nd = notdone[None, :, None]
+        h = h * nd
+        c = c * nd
+        new_h = []
+        new_c = []
+        y = inp
+        for layer in range(self.num_layers):
+            (c_l, h_l), y = nn.OptimizedLSTMCell(
+                self.hidden_size, name=f"layer_{layer}"
+            )((c[layer], h[layer]), y)
+            new_h.append(h_l)
+            new_c.append(c_l)
+        return (jnp.stack(new_h), jnp.stack(new_c)), y
+
+
+class LSTMCore(nn.Module):
+    """Scan a stacked LSTM over the time axis.
+
+    __call__(core_input [T,B,D], notdone [T,B], core_state (h,c)) ->
+        (core_output [T,B,H], new_core_state)
+    """
+
+    hidden_size: int
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, core_input, notdone, core_state):
+        scan = nn.scan(
+            _StackedLSTMStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )(self.hidden_size, self.num_layers)
+        core_state, core_output = scan(core_state, (core_input, notdone))
+        return core_output, core_state
+
+    def initial_state(self, batch_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return lstm_initial_state(
+            True, self.num_layers, self.hidden_size, batch_size
+        )
+
+
+def lstm_initial_state(
+    use_lstm: bool, num_layers: int, hidden_size: int, batch_size: int
+):
+    """Zero (h, c) state, or () for feed-forward nets — the shared
+    `initial_state` implementation of every model family."""
+    if not use_lstm:
+        return ()
+    shape = (num_layers, batch_size, hidden_size)
+    return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+
+class RecurrentPolicyHead(nn.Module):
+    """Optional LSTM core + policy/baseline heads + action selection.
+
+    Shared tail of every model family (the reference duplicates this block
+    across AtariNet and the deep Net, monobeast.py:594-632 /
+    polybeast_learner.py:235-264). Takes flattened `[T*B, D]` core inputs
+    plus the `[T, B]` done mask, returns (AgentOutput, new_core_state) with
+    `[T, B, ...]` outputs.
+    """
+
+    num_actions: int
+    use_lstm: bool
+    hidden_size: int
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, core_input, done, core_state, T, B, sample_action):
+        if self.use_lstm:
+            core_input = core_input.reshape(T, B, -1)
+            notdone = 1.0 - done.astype(jnp.float32)
+            core_output, core_state = LSTMCore(
+                hidden_size=self.hidden_size,
+                num_layers=self.num_layers,
+                name="core",
+            )(core_input, notdone, core_state)
+            core_output = core_output.reshape(T * B, -1)
+        else:
+            core_output = core_input
+            core_state = ()
+
+        policy_logits = nn.Dense(self.num_actions, name="policy")(core_output)
+        baseline = nn.Dense(1, name="baseline")(core_output)
+
+        if sample_action:
+            action = jax.random.categorical(
+                self.make_rng("action"), policy_logits, axis=-1
+            )
+        else:
+            action = jnp.argmax(policy_logits, axis=-1)
+
+        return (
+            AgentOutput(
+                action=action.reshape(T, B).astype(jnp.int32),
+                policy_logits=policy_logits.reshape(T, B, self.num_actions),
+                baseline=baseline.reshape(T, B),
+            ),
+            core_state,
+        )

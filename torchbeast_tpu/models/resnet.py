@@ -1,0 +1,84 @@
+"""Deep IMPALA ResNet (the reference's PolyBeast `Net`,
+/root/reference/torchbeast/polybeast_learner.py:134-266), TPU-native.
+
+Three sections of [3x3 conv -> 3x3/2 maxpool -> 2 residual double-conv
+blocks] with 16/32/32 channels, fc to 256, reward appended to the core input
+(no last-action input, unlike AtariNet), optional 1-layer LSTM(256). NHWC
+layout, optional bfloat16 trunk; the residual blocks use pre-activation ReLU
+ordering exactly as the reference (ReLU-conv-ReLU-conv then add).
+"""
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
+
+
+class ResNetBase(nn.Module):
+    """Conv trunk shared by actor/learner; returns [T*B, 256] features."""
+
+    channels: Sequence[int] = (16, 32, 32)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, frame):
+        T, B = frame.shape[:2]
+        x = frame.reshape((T * B,) + frame.shape[2:])
+        x = x.astype(self.dtype) / 255.0
+
+        conv3 = lambda feat, name: nn.Conv(  # noqa: E731
+            feat, (3, 3), strides=(1, 1), padding="SAME", dtype=self.dtype,
+            name=name,
+        )
+        for i, num_ch in enumerate(self.channels):
+            x = conv3(num_ch, f"feat_conv_{i}")(x)
+            x = nn.max_pool(
+                x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+            )
+            for j in range(2):
+                res_input = x
+                x = nn.relu(x)
+                x = conv3(num_ch, f"res_{i}_{j}_conv1")(x)
+                x = nn.relu(x)
+                x = conv3(num_ch, f"res_{i}_{j}_conv2")(x)
+                x = x + res_input
+
+        x = nn.relu(x)
+        x = x.reshape((T * B, -1))  # 11*11*32 = 3872 for 84x84 input
+        x = nn.relu(nn.Dense(256, dtype=self.dtype, name="fc")(x))
+        return x.astype(jnp.float32)
+
+
+class ResNet(nn.Module):
+    num_actions: int
+    use_lstm: bool = False
+    dtype: Any = jnp.float32
+
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, inputs, core_state=(), *, sample_action: bool = True):
+        frame = inputs["frame"]  # [T, B, H, W, C] uint8
+        T, B = frame.shape[:2]
+
+        x = ResNetBase(dtype=self.dtype, name="trunk")(frame)
+
+        clipped_reward = jnp.clip(
+            inputs["reward"].astype(jnp.float32), -1, 1
+        ).reshape(T * B, 1)
+        core_input = jnp.concatenate([x, clipped_reward], axis=-1)
+
+        return RecurrentPolicyHead(
+            num_actions=self.num_actions,
+            use_lstm=self.use_lstm,
+            hidden_size=self.hidden_size,
+            num_layers=1,
+            name="head",
+        )(core_input, inputs["done"], core_state, T, B, sample_action)
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        return lstm_initial_state(
+            self.use_lstm, 1, self.hidden_size, batch_size
+        )

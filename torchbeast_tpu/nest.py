@@ -1,0 +1,67 @@
+"""Nested-structure ("nest") utilities over JAX pytrees.
+
+The reference ships a standalone C++ pybind11 package `nest`
+(/root/reference/nest/nest/nest.h:34-325, nest_pybind.cc:43-80) because torch
+had no pytree story. JAX does: `jax.tree_util` is the native, registered-
+everywhere equivalent. This module provides the reference's Python API surface
+(`map`, `map_many`, `map_many2`, `flatten`, `pack_as`, `front`) as thin,
+idiomatic wrappers over pytrees.
+
+One deliberate semantic divergence: JAX pytrees traverse dict keys in
+SORTED order, while the reference's C++ nest uses std::map (also sorted) but
+its Python dicts were effectively insertion-ordered in user code. Here
+`flatten`/`pack_as`/`front` follow pytree (sorted-key) order; any parallel
+sequence you zip with `flatten(d)` must use the same order — use
+`flatten`/`pack_as` round-trips rather than hand-built orderings.
+
+The C++ runtime (under csrc/, built in a later stage) keeps its own Nest<T>
+for carrying arrays through the native layers, matching reference component
+N1 (SURVEY.md §2.1).
+"""
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+
+
+def map(fn: Callable[[Any], Any], nest: Any) -> Any:  # noqa: A001
+    """Apply fn to every leaf, preserving structure (nest_pybind.cc:44)."""
+    return jax.tree_util.tree_map(fn, nest)
+
+
+def map_many(fn: Callable[..., Any], *nests: Any) -> Any:
+    """Apply fn(leaf0, leaf1, ...) across structurally-equal nests
+    (nest_pybind.cc:45-56)."""
+    if not nests:
+        raise ValueError("map_many requires at least one nest")
+    return jax.tree_util.tree_map(fn, nests[0], *nests[1:])
+
+
+def map_many2(fn: Callable[[Any, Any], Any], nest1: Any, nest2: Any) -> Any:
+    """Binary variant with the reference's name (nest_pybind.cc:57-67)."""
+    return jax.tree_util.tree_map(fn, nest1, nest2)
+
+
+def flatten(nest: Any) -> List[Any]:
+    """Depth-first list of leaves (nest.h:135-158)."""
+    return jax.tree_util.tree_leaves(nest)
+
+
+def pack_as(nest: Any, flat: Sequence[Any]) -> Any:
+    """Inverse of flatten against a template structure (nest.h:160-194)."""
+    treedef = jax.tree_util.tree_structure(nest)
+    flat = list(flat)
+    if treedef.num_leaves != len(flat):
+        raise ValueError(
+            f"Structure had {treedef.num_leaves} leaves, but {len(flat)} "
+            "values were given to pack_as"
+        )
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def front(nest: Any) -> Any:
+    """First leaf in depth-first order (nest.h:74-95)."""
+    leaves = jax.tree_util.tree_leaves(nest)
+    if not leaves:
+        raise ValueError("front() called on empty nest")
+    return leaves[0]

@@ -1,0 +1,6 @@
+from torchbeast_tpu.ops import vtrace  # noqa: F401
+from torchbeast_tpu.ops.losses import (  # noqa: F401
+    compute_baseline_loss,
+    compute_entropy_loss,
+    compute_policy_gradient_loss,
+)

@@ -1,0 +1,36 @@
+"""IMPALA loss functions.
+
+The reference duplicates these in both drivers
+(/root/reference/torchbeast/monobeast.py:107-125 and
+polybeast_learner.py:113-131); here they live once. All reductions are sums
+over every element, matching the reference exactly (the total loss is then
+scaled by the driver's cost coefficients).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchbeast_tpu.ops.vtrace import action_log_probs
+
+
+def compute_baseline_loss(advantages):
+    """0.5 * sum((vs - V)^2)  (reference polybeast_learner.py:113-114)."""
+    return 0.5 * jnp.sum(jnp.square(advantages))
+
+
+def compute_entropy_loss(logits):
+    """Negative entropy, sum(p * log p)  (polybeast_learner.py:117-121)."""
+    policy = jax.nn.softmax(logits, axis=-1)
+    log_policy = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.sum(policy * log_policy)
+
+
+def compute_policy_gradient_loss(logits, actions, advantages):
+    """sum(-log pi(a) * stop_grad(advantage))  (polybeast_learner.py:124-131).
+
+    Advantages never receive gradient (reference uses .detach(); verified by
+    its grad-flow test, tests/polybeast_loss_functions_test.py:165-177).
+    """
+    cross_entropy = -action_log_probs(logits, actions)
+    return jnp.sum(cross_entropy * lax.stop_gradient(advantages))

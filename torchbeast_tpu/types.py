@@ -1,0 +1,33 @@
+"""Shared structured types.
+
+Mirrors the namedtuples threaded through the reference learner
+(/root/reference/torchbeast/polybeast_learner.py:288-292). NamedTuples are
+registered JAX pytrees, so these flow through jit/scan/shard_map unchanged.
+"""
+
+from typing import NamedTuple, Any
+
+
+class EnvOutput(NamedTuple):
+    """One environment step, time-major `[T, B, ...]` once batched."""
+
+    frame: Any
+    reward: Any
+    done: Any
+    episode_step: Any
+    episode_return: Any
+
+
+class AgentOutput(NamedTuple):
+    """One policy step. The reference's Poly `Net` returns this tuple
+    (polybeast_learner.py:264) and Mono's dict carries the same three fields
+    (monobeast.py:628-632)."""
+
+    action: Any
+    policy_logits: Any
+    baseline: Any
+
+
+class Batch(NamedTuple):
+    env: EnvOutput
+    agent: AgentOutput
