@@ -1,0 +1,56 @@
+"""Env adapter bookkeeping: episode counters, auto-reset, initial-state
+conventions (reference core/environment.py semantics)."""
+
+import numpy as np
+
+from torchbeast_tpu.envs import CountingEnv, Environment, MockEnv
+from torchbeast_tpu.envs.vec import SerialEnvPool
+
+
+def test_initial_conventions():
+    env = Environment(CountingEnv(episode_length=3))
+    out = env.initial()
+    assert out["done"] is True or out["done"] == True  # noqa: E712
+    assert out["reward"] == 0.0
+    assert out["last_action"] == 0
+    assert out["episode_step"] == 0
+    assert (out["frame"] == 0).all()
+
+
+def test_episode_accounting_and_auto_reset():
+    env = Environment(CountingEnv(episode_length=3))
+    env.initial()
+    rewards = []
+    for t in range(1, 4):
+        out = env.step(0)
+        rewards.append(out["reward"])
+        assert out["episode_step"] == t
+    # Episode ended at step 3: totals reported WITH the done step.
+    assert out["done"]
+    assert out["episode_return"] == sum(rewards) == 1 + 2 + 3
+    # Frame already reset to zeros on the done step.
+    assert (out["frame"] == 0).all()
+    # Counters restart on the following step.
+    out = env.step(1)
+    assert out["episode_step"] == 1
+    assert out["episode_return"] == 1.0
+    assert out["last_action"] == 1
+
+
+def test_mock_env_fixed_length():
+    env = Environment(MockEnv(episode_length=5, frame_shape=(4, 4, 1)))
+    env.initial()
+    dones = [env.step(0)["done"] for _ in range(10)]
+    assert dones == [False] * 4 + [True] + [False] * 4 + [True]
+
+
+def test_serial_pool_stacks():
+    pool = SerialEnvPool(
+        [lambda: CountingEnv(episode_length=4) for _ in range(3)]
+    )
+    out = pool.initial()
+    assert out["frame"].shape == (3, 48, 48, 1)
+    assert out["done"].shape == (3,)
+    out = pool.step(np.zeros(3, np.int32))
+    assert out["episode_step"].tolist() == [1, 1, 1]
+    pool.close()

@@ -1,0 +1,137 @@
+"""Learner-step arithmetic: exact optimizer update vs a manual calculation,
+weight change directionality, and stats plumbing (reference strategy:
+tests/polybeast_learn_function_test.py — mock-driven exact-SGD checks)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu.models import create_model
+
+T, B, A = 4, 2, 3
+
+
+def make_batch(rng_seed=0, t=T, b=B):
+    rng = np.random.default_rng(rng_seed)
+    return {
+        # 48px: the smallest-ish frame the shallow conv stack still accepts.
+        "frame": rng.integers(0, 256, (t + 1, b, 48, 48, 1), dtype=np.uint8),
+        "reward": rng.standard_normal((t + 1, b)).astype(np.float32),
+        "done": rng.random((t + 1, b)) < 0.2,
+        "episode_return": rng.standard_normal((t + 1, b)).astype(np.float32),
+        "episode_step": rng.integers(0, 100, (t + 1, b)).astype(np.int32),
+        "last_action": rng.integers(0, A, (t + 1, b)).astype(np.int32),
+        "action": rng.integers(0, A, (t + 1, b)).astype(np.int32),
+        "policy_logits": rng.standard_normal((t + 1, b, A)).astype(np.float32),
+        "baseline": rng.standard_normal((t + 1, b)).astype(np.float32),
+    }
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = create_model("shallow", num_actions=A)
+    batch = make_batch()
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+        batch,
+        (),
+    )
+    return model, params
+
+
+def test_update_step_matches_manual_sgd(model_and_params):
+    """With plain SGD the update must be exactly params - lr * grad."""
+    model, params = model_and_params
+    hp = learner_lib.HParams()
+    lr = 0.1
+    optimizer = optax.sgd(lr)
+    opt_state = optimizer.init(params)
+    batch = make_batch()
+
+    grads, _ = jax.grad(
+        lambda p: learner_lib.compute_loss(model, p, batch, (), hp),
+        has_aux=True,
+    )(params)
+    expected = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+    update_step = learner_lib.make_update_step(model, optimizer, hp)
+    # update_step donates params/opt_state; hand it copies so the shared
+    # fixture stays alive.
+    donated = jax.tree_util.tree_map(jnp.copy, (params, opt_state))
+    new_params, _, _ = update_step(*donated, batch, ())
+    for e, n in zip(
+        jax.tree_util.tree_leaves(expected),
+        jax.tree_util.tree_leaves(new_params),
+    ):
+        np.testing.assert_allclose(e, n, rtol=1e-5, atol=1e-6)
+
+
+def test_update_step_returns_stats(model_and_params):
+    model, params = model_and_params
+    hp = learner_lib.HParams()
+    optimizer = learner_lib.make_optimizer(hp)
+    opt_state = optimizer.init(params)
+    update_step = learner_lib.make_update_step(model, optimizer, hp)
+    donated = jax.tree_util.tree_map(jnp.copy, (params, opt_state))
+    _, _, stats = update_step(*donated, make_batch(), ())
+    for key in (
+        "total_loss", "pg_loss", "baseline_loss", "entropy_loss", "grad_norm",
+        "episode_returns_sum", "episode_count",
+    ):
+        assert key in stats
+        assert np.isfinite(jax.device_get(stats[key]))
+    post = learner_lib.episode_stat_postprocess(jax.device_get(stats))
+    assert "episodes_finished" in post
+
+
+def test_episode_return_aggregation(model_and_params):
+    model, params = model_and_params
+    hp = learner_lib.HParams()
+    batch = make_batch()
+    _, stats = learner_lib.compute_loss(model, params, batch, (), hp)
+    done = batch["done"][1:]
+    expected_sum = batch["episode_return"][1:][done].sum()
+    np.testing.assert_allclose(
+        stats["episode_returns_sum"], expected_sum, rtol=1e-5
+    )
+    assert int(stats["episode_count"]) == int(done.sum())
+
+
+def test_lr_schedule_decays_to_zero():
+    hp = learner_lib.HParams(
+        total_steps=1000, unroll_length=10, batch_size=10, learning_rate=1.0
+    )
+    frames_per_update = 100
+    schedule = optax.linear_schedule(
+        hp.learning_rate, 0.0, hp.total_steps // frames_per_update
+    )
+    assert schedule(0) == 1.0
+    assert schedule(5) == 0.5
+    assert schedule(10) == 0.0
+    assert schedule(20) == 0.0  # stays at zero past the horizon
+
+
+def test_rmsprop_matches_torch_semantics():
+    """One optax rmsprop step vs torch.optim.RMSprop on the same tensors."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal(5).astype(np.float32)
+    g = rng.standard_normal(5).astype(np.float32)
+    lr, alpha, eps = 0.01, 0.99, 0.01
+
+    tw = torch.nn.Parameter(torch.tensor(w))
+    opt = torch.optim.RMSprop([tw], lr=lr, alpha=alpha, eps=eps)
+    tw.grad = torch.tensor(g)
+    opt.step()
+
+    ow = jnp.asarray(w)
+    optax_opt = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=False)
+    state = optax_opt.init(ow)
+    updates, _ = optax_opt.update(jnp.asarray(g), state, ow)
+    ow = optax.apply_updates(ow, updates)
+
+    np.testing.assert_allclose(ow, tw.detach().numpy(), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,96 @@
+"""On-policy bookkeeping invariants — the trickiest part of the framework
+(SURVEY.md §7 hard parts). Mirrors the reference's agent-state integration
+test (tests/core_agent_state_test.py): a deterministic counting env + a
+'model' that increments its state every forward and resets it where done,
+asserting (a) rollout overlap-by-one, (b) initial_agent_state equals the
+state entering each rollout, (c) boundary steps carry reset frames."""
+
+import numpy as np
+
+from torchbeast_tpu.envs import CountingEnv
+from torchbeast_tpu.envs.vec import SerialEnvPool
+from torchbeast_tpu.rollout import RolloutCollector
+from torchbeast_tpu.types import AgentOutput
+
+B = 2
+EPISODE_LEN = 5
+T = 3  # deliberately not a divisor of EPISODE_LEN: boundaries move around
+
+
+def counting_policy(env_output, agent_state):
+    """State += 1 per forward, reset to 0 where done (before the step),
+    like the reference test's model (core_agent_state_test.py:26-44)."""
+    done = np.asarray(env_output["done"])
+    state = np.where(done, 0, agent_state) + 1
+    out = AgentOutput(
+        action=np.zeros(done.shape, np.int32),
+        policy_logits=state.astype(np.float32)[..., None],
+        baseline=state.astype(np.float32),
+    )
+    return out, state
+
+
+def make_collector():
+    pool = SerialEnvPool(
+        [lambda: CountingEnv(episode_length=EPISODE_LEN) for _ in range(B)]
+    )
+    return RolloutCollector(
+        pool, counting_policy, np.zeros(B, np.int64), unroll_length=T
+    )
+
+
+def test_overlap_by_one():
+    collector = make_collector()
+    prev, _ = collector.collect()
+    for _ in range(4):
+        batch, _ = collector.collect()
+        for key in batch:
+            np.testing.assert_array_equal(
+                batch[key][0], prev[key][-1],
+                err_msg=f"slot 0 of rollout != slot T of previous ({key})",
+            )
+        prev = batch
+
+
+def test_initial_agent_state_is_rollout_entry_state():
+    collector = make_collector()
+    for k in range(6):
+        batch, initial_state = collector.collect()
+        # The counting policy writes its post-increment state into
+        # baseline; the state entering the first in-rollout forward must be
+        # consistent: first forward consumes slot 0's env output, so
+        # baseline[1] == (0 if done[0] else initial_state) + 1.
+        done0 = batch["done"][0]
+        expected_first = np.where(done0, 0, initial_state) + 1
+        np.testing.assert_array_equal(batch["baseline"][1], expected_first)
+
+
+def test_boundary_frames_are_reset_frames():
+    collector = make_collector()
+    for _ in range(8):
+        batch, _ = collector.collect()
+        done = batch["done"]
+        frames = batch["frame"]
+        # Wherever done is set, the env auto-reset: the frame stored with
+        # the done step is the reset (all-zero) frame.
+        assert (frames[done] == 0).all()
+
+
+def test_frames_count_within_episode():
+    collector = make_collector()
+    batch, _ = collector.collect()
+    # CountingEnv frames equal episode_step (0 after reset).
+    np.testing.assert_array_equal(
+        batch["frame"][..., 0, 0, 0],
+        np.where(batch["done"], 0, batch["episode_step"]),
+    )
+
+
+def test_action_pairing():
+    """The action stored at slot i was computed from slot i-1's env output
+    and equals slot i's last_action input."""
+    collector = make_collector()
+    batch, _ = collector.collect()
+    np.testing.assert_array_equal(
+        batch["action"][1:], batch["last_action"][1:]
+    )

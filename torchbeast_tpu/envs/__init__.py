@@ -1,0 +1,20 @@
+"""Environment construction.
+
+`create_env(name, ...)` mirrors the reference's `create_env(flags)`
+(monobeast.py:638-646, polybeast_env.py:49-58): "Mock"/"Counting" build the
+dependency-free test envs; anything else is treated as a gymnasium Atari id
+and gets the DeepMind preprocessing stack.
+"""
+
+from torchbeast_tpu.envs.environment import Environment  # noqa: F401
+from torchbeast_tpu.envs.mock import CountingEnv, MockEnv  # noqa: F401
+
+
+def create_env(name: str, **kwargs):
+    if name == "Mock":
+        return MockEnv(**kwargs)
+    if name == "Counting":
+        return CountingEnv(**kwargs)
+    from torchbeast_tpu.envs.atari import create_atari_env
+
+    return create_atari_env(name, **kwargs)

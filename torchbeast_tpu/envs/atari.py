@@ -1,0 +1,118 @@
+"""Atari preprocessing: the DeepMind stack, built on gymnasium's maintained
+wrappers instead of hand-vendored baselines code.
+
+The reference vendors ~340 LoC of openai/baselines wrappers
+(/root/reference/torchbeast/atari_wrappers.py: NoopReset(30), MaxAndSkip(4),
+EpisodicLife, FireReset, WarpFrame 84x84 gray, ClipReward, FrameStack(4),
+ImageToPyTorch CHW). gymnasium.wrappers.AtariPreprocessing covers
+noop/skip-max/warp/grayscale natively; FrameStackObservation covers the
+stack. EpisodicLife and FireReset are not in gymnasium core, so they are
+implemented here as gymnasium.Wrapper subclasses. Frames come out HWC uint8
+[84, 84, 4] (TPU NHWC layout — no CHW transpose, unlike the reference's
+wrap_pytorch).
+
+Both reference drivers use clip_rewards=False (clipping happens in the
+learner), frame_stack=True, scale=False (monobeast.py:638-646,
+polybeast_env.py:49-58) — same defaults here.
+
+gymnasium is a baked dependency; ale_py (the Atari ROMs/emulator) is gated
+with a clear error when missing.
+"""
+
+import gymnasium
+import numpy as np
+
+
+class EpisodicLifeWrapper(gymnasium.Wrapper):
+    """End episodes on life loss, but only truly reset when the game is
+    over. Same behavior as the reference's EpisodicLifeEnv
+    (atari_wrappers.py:84-118)."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        self.lives = 0
+        self.was_real_done = True
+
+    def reset(self, **kwargs):
+        if self.was_real_done:
+            obs, info = self.env.reset(**kwargs)
+        else:
+            # no-op step to advance from the life-lost state
+            obs, _, terminated, truncated, info = self.env.step(0)
+            if terminated or truncated:
+                obs, info = self.env.reset(**kwargs)
+        self.lives = self.env.unwrapped.ale.lives()
+        return obs, info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self.was_real_done = terminated or truncated
+        lives = self.env.unwrapped.ale.lives()
+        if 0 < lives < self.lives:
+            terminated = True
+        self.lives = lives
+        return obs, reward, terminated, truncated, info
+
+
+class FireResetWrapper(gymnasium.Wrapper):
+    """Press FIRE after reset for envs that need it (reference
+    atari_wrappers.py:64-82)."""
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        obs, _, terminated, truncated, info = self.env.step(1)
+        if terminated or truncated:
+            obs, info = self.env.reset(**kwargs)
+        obs, _, terminated, truncated, info = self.env.step(2)
+        if terminated or truncated:
+            obs, info = self.env.reset(**kwargs)
+        return obs, info
+
+
+class StackToHWC(gymnasium.ObservationWrapper):
+    """FrameStackObservation yields [stack, H, W]; models want [H, W, stack]."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        old = env.observation_space
+        self.observation_space = gymnasium.spaces.Box(
+            low=np.moveaxis(old.low, 0, -1),
+            high=np.moveaxis(old.high, 0, -1),
+            dtype=old.dtype,
+        )
+
+    def observation(self, obs):
+        return np.moveaxis(np.asarray(obs), 0, -1)
+
+
+def create_atari_env(
+    env_name: str,
+    *,
+    frame_stack: int = 4,
+    episodic_life: bool = True,
+    noop_max: int = 30,
+):
+    """Build the full preprocessing stack -> HWC uint8 [84, 84, frame_stack]."""
+    try:
+        import ale_py  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "Atari environments need ale_py; install it or use --env Mock "
+            "for a dependency-free environment."
+        ) from e
+
+    env = gymnasium.make(env_name, frameskip=1)  # AtariPreprocessing skips
+    env = gymnasium.wrappers.AtariPreprocessing(
+        env,
+        noop_max=noop_max,
+        frame_skip=4,
+        screen_size=84,
+        grayscale_obs=True,
+        scale_obs=False,
+    )
+    if episodic_life:
+        env = EpisodicLifeWrapper(env)
+    if "FIRE" in env.unwrapped.get_action_meanings():
+        env = FireResetWrapper(env)
+    env = gymnasium.wrappers.FrameStackObservation(env, stack_size=frame_stack)
+    return StackToHWC(env)

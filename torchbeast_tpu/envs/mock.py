@@ -1,0 +1,56 @@
+"""Deterministic test/demo environments (no gym dependency).
+
+MockEnv mirrors the reference's trivial Mock env for manual runs
+(/root/reference/torchbeast/polybeast_env.py:39-46). CountingEnv is the
+deterministic frame-counting env used to verify on-policy bookkeeping
+invariants (modeled on the behavior of the reference's agent-state test env,
+tests/core_agent_state_env.py: frame counts steps, episode ends every
+`episode_length` steps)."""
+
+import numpy as np
+
+
+class MockEnv:
+    """Fixed-length episodes, constant reward, zero frames."""
+
+    def __init__(self, frame_shape=(84, 84, 4), num_actions=6, episode_length=200):
+        self.frame_shape = tuple(frame_shape)
+        self.num_actions = num_actions
+        self.episode_length = episode_length
+        self._t = 0
+
+    def reset(self):
+        self._t = 0
+        return np.zeros(self.frame_shape, dtype=np.uint8)
+
+    def step(self, action):
+        self._t += 1
+        done = self._t >= self.episode_length
+        frame = np.full(self.frame_shape, self._t % 255, dtype=np.uint8)
+        return frame, 1.0, done
+
+
+class CountingEnv:
+    """Frame value == step index within the episode; done every N steps.
+
+    Frame after reset is all-zero, so tests can assert that boundary steps
+    observed by the learner carry reset frames (reference
+    core_agent_state_test.py:81-84). The default 48px frame is the smallest
+    square the shallow conv trunk accepts, so the driver can run on
+    --env Counting too."""
+
+    def __init__(self, frame_shape=(48, 48, 1), num_actions=2, episode_length=5):
+        self.frame_shape = tuple(frame_shape)
+        self.num_actions = num_actions
+        self.episode_length = episode_length
+        self._t = 0
+
+    def reset(self):
+        self._t = 0
+        return np.zeros(self.frame_shape, dtype=np.uint8)
+
+    def step(self, action):
+        self._t += 1
+        done = self._t >= self.episode_length
+        frame = np.full(self.frame_shape, self._t, dtype=np.uint8)
+        return frame, float(self._t), done

@@ -1,0 +1,107 @@
+"""Vectorized environment pools: B envs behind one batched step() call.
+
+The actor-parallelism layer (reference: `num_actors` forked processes each
+owning one env, monobeast.py:362-381). Here the batching is explicit because
+acting is centrally batched on the TPU: the driver calls `pool.step(actions)`
+with a `[B]` action vector and gets `[B, ...]`-stacked EnvOutput dicts back.
+
+Two implementations:
+- SerialEnvPool: in-process loop — zero IPC, right for cheap/mock envs and
+  tests.
+- ProcessEnvPool: one OS process per env (spawn context so workers never
+  inherit JAX/TPU state), pipes carrying numpy arrays. Equivalent role to the
+  reference's actor processes; the heavy C++ shared-memory transport arrives
+  with the native runtime.
+"""
+
+import multiprocessing as mp
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from torchbeast_tpu.envs.environment import Environment
+
+
+def _stack(outputs: List[Dict]) -> Dict[str, np.ndarray]:
+    return {
+        k: np.stack([o[k] for o in outputs], axis=0) for k in outputs[0]
+    }
+
+
+class SerialEnvPool:
+    def __init__(self, env_fns: List[Callable]):
+        self._envs = [Environment(fn()) for fn in env_fns]
+
+    def __len__(self):
+        return len(self._envs)
+
+    def initial(self) -> Dict[str, np.ndarray]:
+        return _stack([e.initial() for e in self._envs])
+
+    def step(self, actions) -> Dict[str, np.ndarray]:
+        return _stack(
+            [e.step(int(a)) for e, a in zip(self._envs, actions)]
+        )
+
+    def close(self):
+        for e in self._envs:
+            e.close()
+
+
+def _env_worker(conn, env_fn):
+    """Child process body: owns one Environment, serves initial/step."""
+    try:
+        env = Environment(env_fn())
+        while True:
+            cmd, arg = conn.recv()
+            if cmd == "initial":
+                conn.send(env.initial())
+            elif cmd == "step":
+                conn.send(env.step(arg))
+            elif cmd == "close":
+                env.close()
+                conn.send(None)
+                break
+    except (EOFError, KeyboardInterrupt):
+        pass
+
+
+class ProcessEnvPool:
+    def __init__(self, env_fns: List[Callable], ctx: str = "spawn"):
+        mp_ctx = mp.get_context(ctx)
+        self._parents = []
+        self._procs = []
+        for fn in env_fns:
+            parent, child = mp_ctx.Pipe()
+            proc = mp_ctx.Process(
+                target=_env_worker, args=(child, fn), daemon=True
+            )
+            proc.start()
+            child.close()
+            self._parents.append(parent)
+            self._procs.append(proc)
+
+    def __len__(self):
+        return len(self._procs)
+
+    def initial(self) -> Dict[str, np.ndarray]:
+        for p in self._parents:
+            p.send(("initial", None))
+        return _stack([p.recv() for p in self._parents])
+
+    def step(self, actions) -> Dict[str, np.ndarray]:
+        for p, a in zip(self._parents, actions):
+            p.send(("step", int(a)))
+        return _stack([p.recv() for p in self._parents])
+
+    def close(self):
+        for p in self._parents:
+            try:
+                p.send(("close", None))
+                p.recv()
+            except (BrokenPipeError, EOFError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=5)
+            if proc.is_alive():
+                proc.terminate()

@@ -1,0 +1,390 @@
+"""Single-host IMPALA trainer (the reference MonoBeast's role,
+/root/reference/torchbeast/monobeast.py), re-designed TPU-first.
+
+Architecture difference, deliberate: the reference forks actor processes
+that each run the policy on CPU against a shared-memory model the learner
+overwrites in place (monobeast.py:128-191, 295). On TPU, per-actor host
+inference would starve the chip, so acting is *centrally batched*: env
+processes only step environments; every env step is one jitted `[1, B]`
+policy call on the TPU, and every unroll ends in one jitted update step. No
+weight copies at all — actor and learner share the same on-device params
+pytree. Policy lag is exactly zero (strictly stronger than the reference's
+queue-backpressure guarantee).
+
+Run:  python -m torchbeast_tpu.monobeast --env Mock --total_steps 20000
+"""
+
+import argparse
+import functools
+import logging
+import os
+import time
+
+import jax
+import numpy as np
+
+from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu.envs import create_env
+from torchbeast_tpu.envs.vec import ProcessEnvPool, SerialEnvPool
+from torchbeast_tpu.models import create_model
+from torchbeast_tpu.rollout import RolloutCollector
+from torchbeast_tpu.utils import (
+    FileWriter,
+    Timings,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+logging.basicConfig(
+    format=(
+        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
+        "%(message)s"
+    ),
+    level=logging.INFO,
+)
+log = logging.getLogger("torchbeast_tpu.monobeast")
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--env", type=str, default="PongNoFrameskip-v4",
+                        help="Gym environment (or Mock / Counting).")
+    parser.add_argument("--mode", default="train",
+                        choices=["train", "test"])
+    parser.add_argument("--xpid", default=None, help="Experiment id.")
+    # Training settings.
+    parser.add_argument("--savedir", default="~/logs/torchbeast_tpu",
+                        help="Root dir for experiment data.")
+    parser.add_argument("--num_actors", type=int, default=8,
+                        help="Parallel environments (= acting batch).")
+    parser.add_argument("--total_steps", type=int, default=100000,
+                        help="Total environment frames to train for.")
+    parser.add_argument("--batch_size", type=int, default=8,
+                        help="Learner batch size.")
+    parser.add_argument("--unroll_length", type=int, default=80,
+                        help="The unroll length (time dimension).")
+    parser.add_argument("--model", default="shallow",
+                        choices=["shallow", "deep"],
+                        help="Model family (Mono used shallow; Poly deep).")
+    parser.add_argument("--use_lstm", action="store_true",
+                        help="Use LSTM in the agent model.")
+    parser.add_argument("--serial_envs", action="store_true",
+                        help="Step envs in-process (tests/cheap envs).")
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--checkpoint_interval_s", type=int, default=600,
+                        help="Seconds between checkpoints (reference: 10min).")
+    # Loss settings.
+    parser.add_argument("--entropy_cost", type=float, default=0.0006)
+    parser.add_argument("--baseline_cost", type=float, default=0.5)
+    parser.add_argument("--discounting", type=float, default=0.99)
+    parser.add_argument("--reward_clipping", default="abs_one",
+                        choices=["abs_one", "none"])
+    # Optimizer settings.
+    parser.add_argument("--learning_rate", type=float, default=4.8e-4)
+    parser.add_argument("--alpha", type=float, default=0.99,
+                        help="RMSProp smoothing constant.")
+    parser.add_argument("--momentum", type=float, default=0.0)
+    parser.add_argument("--epsilon", type=float, default=0.01,
+                        help="RMSProp epsilon.")
+    parser.add_argument("--grad_norm_clipping", type=float, default=40.0)
+    # Misc.
+    parser.add_argument("--num_test_episodes", type=int, default=10)
+    parser.add_argument("--profile_dir", default=None,
+                        help="If set, capture a jax.profiler trace here.")
+    return parser
+
+
+def hparams_from_flags(flags) -> learner_lib.HParams:
+    return learner_lib.HParams(
+        discounting=flags.discounting,
+        baseline_cost=flags.baseline_cost,
+        entropy_cost=flags.entropy_cost,
+        reward_clipping=flags.reward_clipping,
+        learning_rate=flags.learning_rate,
+        rmsprop_alpha=flags.alpha,
+        rmsprop_eps=flags.epsilon,
+        rmsprop_momentum=flags.momentum,
+        grad_norm_clipping=flags.grad_norm_clipping,
+        total_steps=flags.total_steps,
+        unroll_length=flags.unroll_length,
+        batch_size=flags.batch_size,
+    )
+
+
+def _make_pool(flags, num_envs):
+    # functools.partial (not a lambda): ProcessEnvPool pickles the factory
+    # into spawn-context workers.
+    env_fns = [
+        functools.partial(create_env, flags.env) for _ in range(num_envs)
+    ]
+    if flags.serial_envs:
+        return SerialEnvPool(env_fns)
+    return ProcessEnvPool(env_fns)
+
+
+def _probe_env(flags):
+    """One throwaway env instance -> (num_actions, frame shape/dtype)."""
+    probe = create_env(flags.env)
+    if hasattr(probe, "num_actions"):
+        n = probe.num_actions
+    else:
+        n = probe.action_space.n
+    from torchbeast_tpu.envs.environment import Environment
+
+    frame = Environment(probe).initial()["frame"]
+    if hasattr(probe, "close"):
+        probe.close()
+    return int(n), frame.shape, frame.dtype
+
+
+def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
+                           frame_dtype=np.uint8):
+    model = create_model(
+        flags.model, num_actions=num_actions, use_lstm=flags.use_lstm
+    )
+    dummy = {
+        "frame": np.zeros((1, batch_size) + tuple(frame_shape), frame_dtype),
+        "reward": np.zeros((1, batch_size), np.float32),
+        "done": np.zeros((1, batch_size), bool),
+        "last_action": np.zeros((1, batch_size), np.int32),
+    }
+    state = model.initial_state(batch_size)
+    params = model.init(
+        {
+            "params": jax.random.PRNGKey(flags.seed),
+            "action": jax.random.PRNGKey(flags.seed + 1),
+        },
+        dummy,
+        state,
+    )
+    return model, params
+
+
+def train(flags):
+    if flags.num_actors % flags.batch_size != 0:
+        raise ValueError(
+            "num_actors must be a multiple of batch_size in the sync trainer "
+            f"(got {flags.num_actors} vs {flags.batch_size})"
+        )
+    if flags.xpid is None:
+        flags.xpid = "torchbeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
+    plogger = FileWriter(
+        xpid=flags.xpid, xp_args=vars(flags), rootdir=flags.savedir
+    )
+    checkpoint_path = os.path.join(
+        os.path.expanduser(flags.savedir), flags.xpid, "model.ckpt"
+    )
+
+    hp = hparams_from_flags(flags)
+    num_actions, frame_shape, frame_dtype = _probe_env(flags)
+    B = flags.num_actors
+    T = flags.unroll_length
+
+    model, params = _init_model_and_params(
+        flags, num_actions, B, frame_shape, frame_dtype
+    )
+    optimizer = learner_lib.make_optimizer(hp)
+    opt_state = optimizer.init(params)
+
+    step = 0
+    stats = {}
+    if os.path.exists(checkpoint_path):
+        restored = load_checkpoint(
+            checkpoint_path,
+            params_template=params,
+            opt_state_template=opt_state,
+        )
+        params, opt_state = restored["params"], restored["opt_state"]
+        step = restored["step"]
+        stats = restored["stats"]
+        log.info("Resuming preempted job, current stats:\n%s", stats)
+
+    update_step = learner_lib.make_update_step(model, optimizer, hp)
+    act_step = learner_lib.make_act_step(model)
+
+    pool = _make_pool(flags, B)
+    rng = jax.random.PRNGKey(flags.seed + 2)
+
+    # Mutable cell so the policy closure always samples with fresh rng.
+    rng_cell = [rng]
+
+    def policy(env_output, agent_state):
+        rng_cell[0], key = jax.random.split(rng_cell[0])
+        model_inputs = {
+            k: env_output[k]
+            for k in ("frame", "reward", "done", "last_action")
+        }
+        out, new_state = act_step(params_cell[0], key, model_inputs, agent_state)
+        return jax.device_get(out), new_state
+
+    params_cell = [params]
+    collector = RolloutCollector(
+        pool, policy, model.initial_state(B), unroll_length=T
+    )
+
+    timings = Timings()
+    last_checkpoint_time = time.time()
+    last_log_time = time.time()
+    last_log_step = step
+
+    if flags.profile_dir:
+        jax.profiler.start_trace(flags.profile_dir)
+
+    try:
+        while step < flags.total_steps:
+            timings.reset()
+            batch, initial_agent_state = collector.collect()
+            timings.time("collect")
+
+            # Split the [T+1, num_actors] unroll into learner batches of
+            # batch_size columns; aggregate stats over ALL sub-batches
+            # (losses averaged, episode sums/counts summed).
+            sub_stats = []
+            for i in range(0, B, flags.batch_size):
+                sub = {
+                    k: v[:, i : i + flags.batch_size] for k, v in batch.items()
+                }
+                sub_state = jax.tree_util.tree_map(
+                    lambda s: s[:, i : i + flags.batch_size], initial_agent_state
+                )
+                params_cell[0], opt_state, train_stats = update_step(
+                    params_cell[0], opt_state, sub, sub_state
+                )
+                sub_stats.append(jax.device_get(train_stats))
+                step += T * flags.batch_size
+            timings.time("learn")
+
+            agg = {}
+            for key in sub_stats[0]:
+                vals = [float(s[key]) for s in sub_stats]
+                if key in ("episode_returns_sum", "episode_count"):
+                    agg[key] = sum(vals)
+                else:
+                    agg[key] = sum(vals) / len(vals)
+            stats = learner_lib.episode_stat_postprocess(agg)
+            stats["step"] = step
+            plogger.log(stats)
+
+            now = time.time()
+            if now - last_log_time > 5:
+                sps = (step - last_log_step) / (now - last_log_time)
+                last_log_time, last_log_step = now, step
+                means = timings.means()
+                log.info(
+                    "Steps %d @ %.1f SPS. Loss %.4f. "
+                    "[collect %.0fms learn %.0fms] %s",
+                    step,
+                    sps,
+                    stats.get("total_loss", float("nan")),
+                    1000 * means.get("collect", 0.0),
+                    1000 * means.get("learn", 0.0),
+                    f"Return {stats['mean_episode_return']:.1f}."
+                    if "mean_episode_return" in stats
+                    else "",
+                )
+
+            if now - last_checkpoint_time > flags.checkpoint_interval_s:
+                save_checkpoint(
+                    checkpoint_path,
+                    params=params_cell[0],
+                    opt_state=opt_state,
+                    step=step,
+                    flags=vars(flags),
+                    stats=stats,
+                )
+                last_checkpoint_time = now
+        successful = True
+    except KeyboardInterrupt:
+        log.info("Interrupted; saving final checkpoint.")
+        successful = True
+    except BaseException:
+        successful = False
+        raise
+    finally:
+        if flags.profile_dir:
+            jax.profiler.stop_trace()
+        save_checkpoint(
+            checkpoint_path,
+            params=params_cell[0],
+            opt_state=opt_state,
+            step=step,
+            flags=vars(flags),
+            stats=stats,
+        )
+        plogger.close(successful=successful)
+        pool.close()
+    log.info("Learning finished after %d steps.", step)
+    return stats
+
+
+def test(flags):
+    """Greedy evaluation episodes (reference monobeast.py:508-542)."""
+    if flags.xpid is None:
+        checkpoint_path = os.path.expanduser(
+            os.path.join(flags.savedir, "latest", "model.ckpt")
+        )
+    else:
+        checkpoint_path = os.path.expanduser(
+            os.path.join(flags.savedir, flags.xpid, "model.ckpt")
+        )
+
+    num_actions, frame_shape, frame_dtype = _probe_env(flags)
+    model, params = _init_model_and_params(
+        flags, num_actions, 1, frame_shape, frame_dtype
+    )
+    if os.path.exists(checkpoint_path):
+        hp = hparams_from_flags(flags)
+        optimizer = learner_lib.make_optimizer(hp)
+        restored = load_checkpoint(
+            checkpoint_path,
+            params_template=params,
+            opt_state_template=optimizer.init(params),
+        )
+        params = restored["params"]
+        log.info("Loaded checkpoint from %s", checkpoint_path)
+    else:
+        log.warning("No checkpoint at %s; testing random init.", checkpoint_path)
+
+    from torchbeast_tpu.envs.environment import Environment
+
+    env = Environment(create_env(flags.env))
+    act = jax.jit(
+        lambda p, inputs, state: model.apply(
+            p, inputs, state, sample_action=False
+        )
+    )
+
+    returns = []
+    observation = env.initial()
+    agent_state = model.initial_state(1)
+    while len(returns) < flags.num_test_episodes:
+        inputs = {
+            k: np.asarray(observation[k])[None, None]
+            for k in ("frame", "reward", "done", "last_action")
+        }
+        out, agent_state = act(params, inputs, agent_state)
+        observation = env.step(int(out.action[0, 0]))
+        if observation["done"]:
+            returns.append(float(observation["episode_return"]))
+            log.info("Episode ended after %d steps. Return: %.1f",
+                     int(observation["episode_step"]), returns[-1])
+    env.close()
+    log.info(
+        "Average returns over %i episodes: %.1f",
+        len(returns), sum(returns) / len(returns),
+    )
+    return returns
+
+
+def main(flags):
+    if flags.mode == "train":
+        return train(flags)
+    return test(flags)
+
+
+if __name__ == "__main__":
+    # Make the JAX_PLATFORMS env var authoritative even when a site hook
+    # (e.g. a TPU-plugin sitecustomize) already forced a platform list.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    main(make_parser().parse_args())

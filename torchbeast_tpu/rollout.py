@@ -1,0 +1,76 @@
+"""Rollout collection with the reference's on-policy bookkeeping invariants.
+
+This replaces the reference's per-actor unroll loop (monobeast.py:128-191 and
+the C++ ActorPool hot loop, actorpool.cc:408-450) with a single vectorized
+collector: one batched policy call per env step for all B envs at once —
+the TPU-friendly formulation (one big `[1, B]` forward instead of B tiny
+ones).
+
+Invariants preserved exactly (these are what the reference's agent-state
+integration test pins down, SURVEY.md §4):
+- **Overlap-by-one**: slot 0 of rollout k+1 == slot T of rollout k (both env
+  and agent sides).
+- **Pairing**: the agent output stored at slot i was computed from the env
+  output at slot i-1 (slot 0's agent output is never consumed by the
+  learner, which time-shifts it away).
+- **Agent-state carry**: `initial_agent_state` returned with a rollout is
+  the recurrent state entering the rollout's first policy call; state is
+  carried across rollouts and reset inside the model wherever done is set.
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+from torchbeast_tpu.types import AgentOutput
+
+# policy(env_output [B,...] dict, agent_state) -> (AgentOutput [B,...], state)
+PolicyFn = Callable[[Dict[str, np.ndarray], Any], Tuple[AgentOutput, Any]]
+
+
+class RolloutCollector:
+    def __init__(self, pool, policy: PolicyFn, initial_agent_state, unroll_length: int):
+        self._pool = pool
+        self._policy = policy
+        self._unroll_length = unroll_length
+        self._agent_state = initial_agent_state
+
+        self._pending_env = pool.initial()
+        # Prime the boundary agent output; the state advance is discarded —
+        # the first in-rollout policy call re-consumes this env output with
+        # the state advancing for real (reference monobeast.py:145-147).
+        self._pending_agent, _ = policy(self._pending_env, self._agent_state)
+
+    def collect(self) -> Tuple[Dict[str, np.ndarray], Any]:
+        """Run one unroll; return (batch [T+1, B, ...], initial_agent_state).
+
+        The batch dict carries both env fields (frame, reward, done,
+        episode_return, episode_step, last_action) and behavior-agent fields
+        (action, policy_logits, baseline).
+        """
+        T = self._unroll_length
+        initial_agent_state = self._agent_state
+
+        env_steps = [self._pending_env]
+        agent_steps = [self._pending_agent]
+        for _ in range(T):
+            agent_out, self._agent_state = self._policy(
+                self._pending_env, self._agent_state
+            )
+            self._pending_env = self._pool.step(np.asarray(agent_out.action))
+            env_steps.append(self._pending_env)
+            agent_steps.append(agent_out)
+        self._pending_agent = agent_steps[-1]
+
+        batch = {
+            k: np.stack([s[k] for s in env_steps], axis=0)
+            for k in env_steps[0]
+        }
+        batch["action"] = np.stack([np.asarray(a.action) for a in agent_steps])
+        batch["policy_logits"] = np.stack(
+            [np.asarray(a.policy_logits) for a in agent_steps]
+        )
+        batch["baseline"] = np.stack(
+            [np.asarray(a.baseline) for a in agent_steps]
+        )
+        return batch, initial_agent_state

@@ -1,0 +1,6 @@
+from torchbeast_tpu.utils.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    save_checkpoint,
+)
+from torchbeast_tpu.utils.file_writer import FileWriter  # noqa: F401
+from torchbeast_tpu.utils.prof import Timings  # noqa: F401

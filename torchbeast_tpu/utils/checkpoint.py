@@ -1,0 +1,78 @@
+"""Checkpoint save/load with auto-resume.
+
+Capability parity with the reference's torch.save checkpoints of model/
+optimizer/scheduler state + flags (+ stats) every 10 minutes and at exit
+(/root/reference/torchbeast/monobeast.py:450-462, polybeast_learner.py:
+535-548, 491-500 auto-resume). Here the train state is a JAX pytree
+(params + opt_state), serialized with flax.serialization msgpack; flags and
+stats ride along in the same file. Atomic write (tmp + rename) so a
+preemption mid-write never corrupts the resume path.
+"""
+
+import logging
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import flax.serialization
+
+log = logging.getLogger(__name__)
+
+
+def save_checkpoint(
+    path: str,
+    *,
+    params: Any,
+    opt_state: Any,
+    step: int,
+    flags: Optional[Dict] = None,
+    stats: Optional[Dict] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> None:
+    payload = {
+        "params": flax.serialization.to_bytes(params),
+        "opt_state": flax.serialization.to_bytes(opt_state),
+        "step": step,
+        "flags": dict(flags) if flags else {},
+        "stats": dict(stats) if stats else {},
+        "extra": {
+            k: flax.serialization.to_bytes(v) for k, v in (extra or {}).items()
+        },
+    }
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    log.info("Saved checkpoint to %s (step %d)", path, step)
+
+
+def load_checkpoint(
+    path: str,
+    *,
+    params_template: Any,
+    opt_state_template: Any,
+    extra_templates: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Restore onto templates (pytrees with the right structure/shapes)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    out = {
+        "params": flax.serialization.from_bytes(
+            params_template, payload["params"]
+        ),
+        "opt_state": flax.serialization.from_bytes(
+            opt_state_template, payload["opt_state"]
+        ),
+        "step": payload["step"],
+        "flags": payload.get("flags", {}),
+        "stats": payload.get("stats", {}),
+    }
+    extras = {}
+    for k, template in (extra_templates or {}).items():
+        if k in payload.get("extra", {}):
+            extras[k] = flax.serialization.from_bytes(
+                template, payload["extra"][k]
+            )
+    out["extra"] = extras
+    return out

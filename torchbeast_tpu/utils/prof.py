@@ -1,0 +1,55 @@
+"""Online per-section timing profiler.
+
+Same capability as the reference's Timings (/root/reference/torchbeast/core/
+prof.py:32-81): O(1) running mean/variance per named section via Welford's
+update, printable summary with ms +/- std and % share.
+"""
+
+import collections
+import timeit
+
+
+class Timings:
+    def __init__(self):
+        self._means = collections.defaultdict(int)
+        self._vars = collections.defaultdict(int)
+        self._counts = collections.defaultdict(int)
+        self.reset()
+
+    def reset(self):
+        self.last_time = timeit.default_timer()
+
+    def time(self, name: str):
+        """Record the time since the last reset()/time() call under `name`."""
+        now = timeit.default_timer()
+        x = now - self.last_time
+        self.last_time = now
+
+        n = self._counts[name]
+        mean = self._means[name] + (x - self._means[name]) / (n + 1)
+        var = (
+            n * self._vars[name] + n * (self._means[name] - mean) ** 2 + (x - mean) ** 2
+        ) / (n + 1)
+        self._means[name] = mean
+        self._vars[name] = var
+        self._counts[name] = n + 1
+
+    def means(self):
+        return dict(self._means)
+
+    def stds(self):
+        return {k: v ** 0.5 for k, v in self._vars.items()}
+
+    def summary(self, prefix: str = "") -> str:
+        means = self.means()
+        stds = self.stds()
+        total = sum(means.values()) or 1e-9
+        rows = [
+            f"  {k}: {1000 * means[k]:.2f}ms +- {1000 * stds[k]:.2f}ms "
+            f"({100 * means[k] / total:.1f}%)"
+            for k in sorted(means, key=means.get, reverse=True)
+        ]
+        return "\n".join(
+            [f"{prefix}Mean duration of {len(means)} events "
+             f"(total {1000 * total:.1f}ms):"] + rows
+        )
