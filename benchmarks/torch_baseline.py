@@ -1,0 +1,177 @@
+"""Reference-equivalent learner step in PyTorch, measured on this machine.
+
+The reference repo publishes no throughput numbers (BASELINE.md), so this
+harness provides the measured baseline that bench.py's `vs_baseline` refers
+to: one full IMPALA learner update (deep ResNet + LSTM forward over a
+[T+1, B] batch, V-trace targets, three losses, backward, grad clip, RMSProp
+step) with the same shapes and hyperparameters as bench.py, implemented
+independently in idiomatic PyTorch (this is a fresh implementation of the
+published IMPALA math, not a copy of the reference code), run on CPU (this
+image has no GPU; the reference's own canonical config is a CPU docker
+image, BASELINE.md).
+
+Usage: python benchmarks/torch_baseline.py [--steps N] [--write]
+  --write stores the result into BASELINE_measured.json at the repo root.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+T, B, A = 80, 32, 6
+
+
+class DeepTrunk(nn.Module):
+    """IMPALA deep conv trunk: 3 sections of conv/pool/2-residual-blocks."""
+
+    def __init__(self, in_ch=4, sections=(16, 32, 32)):
+        super().__init__()
+        layers = []
+        for out_ch in sections:
+            layers.append(
+                nn.ModuleDict(
+                    {
+                        "entry": nn.Conv2d(in_ch, out_ch, 3, padding=1),
+                        "r0a": nn.Conv2d(out_ch, out_ch, 3, padding=1),
+                        "r0b": nn.Conv2d(out_ch, out_ch, 3, padding=1),
+                        "r1a": nn.Conv2d(out_ch, out_ch, 3, padding=1),
+                        "r1b": nn.Conv2d(out_ch, out_ch, 3, padding=1),
+                    }
+                )
+            )
+            in_ch = out_ch
+        self.sections = nn.ModuleList(layers)
+        self.fc = nn.Linear(3872, 256)
+
+    def forward(self, x):
+        for sec in self.sections:
+            x = F.max_pool2d(sec["entry"](x), 3, stride=2, padding=1)
+            for a, b in (("r0a", "r0b"), ("r1a", "r1b")):
+                y = sec[b](F.relu(sec[a](F.relu(x))))
+                x = x + y
+        x = F.relu(x).flatten(1)
+        return F.relu(self.fc(x))
+
+
+class Policy(nn.Module):
+    def __init__(self, num_actions=A):
+        super().__init__()
+        self.trunk = DeepTrunk()
+        self.lstm = nn.LSTM(257, 256)
+        self.pi = nn.Linear(256, num_actions)
+        self.v = nn.Linear(256, 1)
+
+    def forward(self, frames, rewards, dones, state):
+        t, b = frames.shape[:2]
+        feats = self.trunk(frames.flatten(0, 1).float() / 255.0)
+        core_in = torch.cat(
+            [feats, rewards.clamp(-1, 1).reshape(t * b, 1)], -1
+        ).view(t, b, -1)
+        outs = []
+        keep = (~dones).float()
+        for i in range(t):
+            state = tuple(keep[i].view(1, -1, 1) * s for s in state)
+            out, state = self.lstm(core_in[i : i + 1], state)
+            outs.append(out)
+        core_out = torch.cat(outs).flatten(0, 1)
+        return self.pi(core_out).view(t, b, -1), self.v(core_out).view(t, b), state
+
+
+def vtrace_targets(log_rhos, discounts, rewards, values, bootstrap):
+    with torch.no_grad():
+        rhos = log_rhos.exp()
+        cs = rhos.clamp(max=1.0)
+        rho_bar = rhos.clamp(max=1.0)
+        next_values = torch.cat([values[1:], bootstrap[None]])
+        deltas = rho_bar * (rewards + discounts * next_values - values)
+        acc = torch.zeros_like(bootstrap)
+        out = []
+        for i in reversed(range(len(rewards))):
+            acc = deltas[i] + discounts[i] * cs[i] * acc
+            out.append(acc)
+        vs = torch.stack(out[::-1]) + values
+        next_vs = torch.cat([vs[1:], bootstrap[None]])
+        pg_adv = rho_bar * (rewards + discounts * next_vs - values)
+        return vs, pg_adv
+
+
+def learner_step(model, opt, batch, state):
+    logits, baseline, _ = model(
+        batch["frame"], batch["reward"], batch["done"], state
+    )
+    bootstrap = baseline[-1]
+    logits_t, values = logits[:-1], baseline[:-1]
+    actions = batch["action"][1:]
+    rewards = batch["reward"][1:].clamp(-1, 1)
+    discounts = (~batch["done"][1:]).float() * 0.99
+
+    logp_target = F.log_softmax(logits_t, -1).gather(
+        -1, actions.unsqueeze(-1)
+    ).squeeze(-1)
+    logp_behavior = F.log_softmax(batch["policy_logits"][1:], -1).gather(
+        -1, actions.unsqueeze(-1)
+    ).squeeze(-1)
+    vs, pg_adv = vtrace_targets(
+        logp_target - logp_behavior, discounts, rewards, values, bootstrap
+    )
+
+    pg_loss = (-logp_target * pg_adv).sum()
+    v_loss = 0.5 * ((vs - values) ** 2).sum() * 0.5
+    probs = F.softmax(logits_t, -1)
+    ent_loss = 0.0006 * (probs * probs.clamp_min(1e-20).log()).sum()
+    loss = pg_loss + v_loss + ent_loss
+
+    opt.zero_grad()
+    loss.backward()
+    nn.utils.clip_grad_norm_(model.parameters(), 40.0)
+    opt.step()
+    return float(loss.detach())
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument("--write", action="store_true")
+    args = parser.parse_args()
+
+    torch.manual_seed(0)
+    model = Policy()
+    opt = torch.optim.RMSprop(
+        model.parameters(), lr=4.8e-4, alpha=0.99, eps=0.01
+    )
+    batch = {
+        "frame": torch.randint(0, 256, (T + 1, B, 4, 84, 84), dtype=torch.uint8),
+        "reward": torch.randn(T + 1, B),
+        "done": torch.rand(T + 1, B) < 0.01,
+        "action": torch.randint(0, A, (T + 1, B)),
+        "policy_logits": torch.randn(T + 1, B, A),
+    }
+    state = (torch.zeros(1, B, 256), torch.zeros(1, B, 256))
+
+    learner_step(model, opt, batch, state)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        learner_step(model, opt, batch, state)
+    elapsed = time.perf_counter() - t0
+    fps = T * B * args.steps / elapsed
+
+    result = {
+        "torch_cpu_frames_per_sec": round(fps, 1),
+        "step_ms": round(1000 * elapsed / args.steps, 1),
+        "config": f"deep ResNet+LSTM, T={T}, B={B}, torch {torch.__version__}, CPU",
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    print(json.dumps(result))
+    if args.write:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "BASELINE_measured.json"), "w") as f:
+            json.dump(result, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,3 +21,9 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: repeat suite runs skip XLA recompiles.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.expanduser("~/.cache/torchbeast_tpu_xla"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

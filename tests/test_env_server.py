@@ -1,0 +1,200 @@
+"""Env server + actor pool integration over real sockets (reference
+strategy: tests/core_agent_state_test.py — real transport, deterministic
+counting env, inference/learn loops driven inline; asserts the on-policy
+invariants across the full async stack)."""
+
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu.envs import CountingEnv
+from torchbeast_tpu.runtime import wire
+from torchbeast_tpu.runtime.actor_pool import ActorPool
+from torchbeast_tpu.runtime.env_server import EnvServer, parse_address
+from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
+
+EPISODE_LEN = 5
+T = 3
+
+
+@pytest.fixture
+def server_address():
+    path = os.path.join(tempfile.mkdtemp(), "env_server")
+    address = f"unix:{path}"
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), address
+    )
+    server.start()
+    import time
+
+    deadline = time.monotonic() + 5
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError("server did not bind")
+        time.sleep(0.01)
+    yield address
+    server.stop()
+
+
+def test_stream_protocol(server_address):
+    import socket
+
+    family, target = parse_address(server_address)
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    sock.connect(target)
+    try:
+        step = wire.recv_message(sock)
+        assert step["type"] == "step"
+        assert bool(step["done"])  # initial boundary step
+        assert np.asarray(step["frame"]).max() == 0
+        assert np.asarray(step["reward"]).dtype == np.float32
+
+        for t in range(1, EPISODE_LEN + 1):
+            wire.send_message(sock, {"type": "action", "action": 1})
+            step = wire.recv_message(sock)
+            assert int(step["episode_step"]) == t
+        assert bool(step["done"])  # episode boundary
+        assert float(step["episode_return"]) == sum(range(1, EPISODE_LEN + 1))
+
+        # Auto-reset: counters restart on the next step.
+        wire.send_message(sock, {"type": "action", "action": 0})
+        step = wire.recv_message(sock)
+        assert int(step["episode_step"]) == 1
+    finally:
+        sock.close()
+
+
+def test_fresh_env_per_connection(server_address):
+    import socket
+
+    family, target = parse_address(server_address)
+    for _ in range(2):
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        sock.connect(target)
+        step = wire.recv_message(sock)
+        # A fresh env starts at episode_step 0 every time.
+        assert int(step["episode_step"]) == 0
+        sock.close()
+
+
+class CountingPolicyServer:
+    """Inference-side counting 'model': state += 1 per forward, reset where
+    done — the spec model from the reference agent-state test. State is
+    [1, B] (dummy layer dim, batch dim 1) so queue batching/slicing along
+    batch_dim=1 applies to it like a real LSTM state."""
+
+    def __call__(self, env_outputs, agent_state, batch_size):
+        done = np.asarray(env_outputs["done"])  # [1, B]
+        state = np.where(done, 0, np.asarray(agent_state)) + 1  # [1, B]
+        outputs = {
+            "action": np.zeros_like(done, dtype=np.int32),
+            "policy_logits": state[..., None].astype(np.float32),
+            "baseline": state.astype(np.float32),
+        }
+        return outputs, state
+
+
+def run_pool(server_address, num_rollouts=6):
+    learner_queue = BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = DynamicBatcher(batch_dim=1, timeout_ms=20)
+    policy = CountingPolicyServer()
+
+    inf_thread = threading.Thread(
+        target=inference_loop,
+        args=(batcher, policy, 8),
+        daemon=True,
+    )
+    inf_thread.start()
+
+    pool = ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[server_address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+
+    items = []
+    for item in learner_queue:
+        items.append(item)
+        if len(items) >= num_rollouts:
+            break
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    return items
+
+
+def test_actor_pool_invariants(server_address):
+    items = run_pool(server_address)
+    prev = None
+    for item in items:
+        batch = item["batch"]
+        initial_state = item["initial_agent_state"]
+        assert batch["frame"].shape[:2] == (T + 1, 1)
+
+        if prev is not None:
+            # Overlap-by-one across the async stack.
+            for key in batch:
+                np.testing.assert_array_equal(
+                    batch[key][0], prev[key][-1], err_msg=key
+                )
+
+        # Agent-state bookkeeping: first in-rollout forward consumed slot
+        # 0's env output with the recorded initial state.
+        done0 = batch["done"][0]  # [B]
+        expected = np.where(done0, 0, np.asarray(initial_state)[0]) + 1
+        np.testing.assert_array_equal(batch["baseline"][1], expected)
+
+        # Boundary steps carry reset (zero) frames.
+        assert (batch["frame"][batch["done"]] == 0).all()
+
+        # Action pairing: stored action at slot i == last_action at slot i.
+        np.testing.assert_array_equal(
+            batch["action"][1:], batch["last_action"][1:]
+        )
+        prev = batch
+
+
+def test_env_exception_surfaces():
+    class ExplodingEnv:
+        num_actions = 2
+
+        def reset(self):
+            return np.zeros((2, 2), np.uint8)
+
+        def step(self, action):
+            raise RuntimeError("boom")
+
+    path = os.path.join(tempfile.mkdtemp(), "exploding")
+    address = f"unix:{path}"
+    server = EnvServer(ExplodingEnv, address)
+    server.start()
+    import socket
+    import time
+
+    deadline = time.monotonic() + 5
+    while not os.path.exists(path):
+        time.sleep(0.01)
+        if time.monotonic() > deadline:
+            raise TimeoutError
+    try:
+        family, target = parse_address(address)
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        sock.connect(target)
+        wire.recv_message(sock)  # initial step
+        wire.send_message(sock, {"type": "action", "action": 0})
+        msg = wire.recv_message(sock)
+        assert msg["type"] == "error"
+        assert "boom" in msg["message"]
+    finally:
+        sock.close()
+        server.stop()

@@ -1,0 +1,276 @@
+"""BatchingQueue / DynamicBatcher semantics + concurrency stress
+(reference strategy: tests/batching_queue_test.py and
+tests/dynamic_batcher_test.py — construction errors, batched dequeue,
+broken promises, item-conservation under many producers/consumers)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu.runtime import (
+    AsyncError,
+    BatchingQueue,
+    ClosedBatchingQueue,
+    DynamicBatcher,
+)
+
+
+class TestBatchingQueue:
+    def test_construction_errors(self):
+        with pytest.raises(ValueError, match="Min batch size"):
+            BatchingQueue(minimum_batch_size=0)
+        with pytest.raises(ValueError, match="Max batch size"):
+            BatchingQueue(minimum_batch_size=4, maximum_batch_size=2)
+        with pytest.raises(ValueError, match="Max queue size"):
+            BatchingQueue(maximum_queue_size=0)
+
+    def test_enqueue_validation(self):
+        queue = BatchingQueue(batch_dim=1)
+        with pytest.raises(ValueError, match="empty"):
+            queue.enqueue(())
+        with pytest.raises(ValueError, match="dims"):
+            queue.enqueue(np.zeros((3,)))  # 1 dim, batch_dim 1
+
+    def test_double_close_raises(self):
+        queue = BatchingQueue()
+        queue.close()
+        with pytest.raises(RuntimeError, match="closed already"):
+            queue.close()
+
+    def test_enqueue_after_close_raises(self):
+        queue = BatchingQueue()
+        queue.close()
+        with pytest.raises(ClosedBatchingQueue):
+            queue.enqueue(np.zeros((1, 2)))
+
+    def test_batched_dequeue(self):
+        queue = BatchingQueue(batch_dim=0, minimum_batch_size=3)
+        for i in range(3):
+            queue.enqueue({"x": np.full((1, 2), i)})
+        batch, payloads = queue.dequeue_many()
+        assert batch["x"].shape == (3, 2)
+        np.testing.assert_array_equal(batch["x"][:, 0], [0, 1, 2])
+        assert len(payloads) == 3
+
+    def test_iteration_stops_on_close(self):
+        queue = BatchingQueue(minimum_batch_size=1)
+        queue.enqueue(np.zeros((1, 1)))
+        it = iter(queue)
+        next(it)
+        closer = threading.Timer(0.05, queue.close)
+        closer.start()
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_timeout_returns_partial_batch(self):
+        queue = BatchingQueue(minimum_batch_size=4, timeout_ms=50)
+        queue.enqueue(np.zeros((1, 1)))
+        t0 = time.monotonic()
+        batch, payloads = queue.dequeue_many()
+        elapsed = time.monotonic() - t0
+        assert len(payloads) == 1
+        assert 0.02 < elapsed < 2.0
+
+    def test_backpressure_blocks_producer(self):
+        queue = BatchingQueue(maximum_queue_size=2, minimum_batch_size=1)
+        queue.enqueue(np.zeros((1, 1)))
+        queue.enqueue(np.zeros((1, 1)))
+        blocked = threading.Event()
+        passed = threading.Event()
+
+        def producer():
+            blocked.set()
+            queue.enqueue(np.zeros((1, 1)))  # must block until a dequeue
+            passed.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        blocked.wait(1)
+        time.sleep(0.05)
+        assert not passed.is_set()
+        queue.dequeue_many()
+        assert passed.wait(1)
+
+    def test_stress_item_conservation(self):
+        # 16 producers x 250 items through 8 consumers: nothing lost.
+        queue = BatchingQueue(
+            batch_dim=0, minimum_batch_size=1, maximum_batch_size=16
+        )
+        n_producers, items_each = 16, 250
+        received = []
+        received_lock = threading.Lock()
+
+        def producer(pid):
+            for i in range(items_each):
+                queue.enqueue(np.full((1,), pid * items_each + i))
+
+        def consumer():
+            while True:
+                try:
+                    batch, _ = queue.dequeue_many()
+                except (StopIteration, RuntimeError):
+                    return
+                with received_lock:
+                    received.extend(batch.tolist())
+
+        consumers = [
+            threading.Thread(target=consumer, daemon=True) for _ in range(8)
+        ]
+        producers = [
+            threading.Thread(target=producer, args=(p,), daemon=True)
+            for p in range(n_producers)
+        ]
+        for t in consumers + producers:
+            t.start()
+        for t in producers:
+            t.join(30)
+        deadline = time.monotonic() + 30
+        while queue.size() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        queue.close()
+        for t in consumers:
+            t.join(10)
+        assert sorted(received) == list(range(n_producers * items_each))
+
+
+class TestDynamicBatcher:
+    def test_request_response(self):
+        batcher = DynamicBatcher(batch_dim=0)
+        result = {}
+
+        def producer():
+            result["out"] = batcher.compute(np.arange(4).reshape(1, 4))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        batch = next(iter(batcher))
+        inputs = batch.get_inputs()
+        np.testing.assert_array_equal(inputs, [[0, 1, 2, 3]])
+        batch.set_outputs(inputs * 10)
+        t.join(5)
+        np.testing.assert_array_equal(result["out"], [[0, 10, 20, 30]])
+
+    def test_batched_compute_slices_rows(self):
+        batcher = DynamicBatcher(batch_dim=0, minimum_batch_size=3)
+        outs = {}
+
+        def producer(i):
+            outs[i] = batcher.compute(np.full((1, 2), i))
+
+        threads = [
+            threading.Thread(target=producer, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        batch = next(iter(batcher))
+        inputs = batch.get_inputs()
+        assert inputs.shape == (3, 2)
+        batch.set_outputs(inputs + 100)
+        for t in threads:
+            t.join(5)
+        for i in range(3):
+            np.testing.assert_array_equal(outs[i], [[i + 100, i + 100]])
+
+    def test_dropped_batch_breaks_promises(self):
+        batcher = DynamicBatcher(batch_dim=0)
+        caught = {}
+
+        def producer():
+            try:
+                batcher.compute(np.zeros((1, 1)))
+            except AsyncError as e:
+                caught["err"] = e
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        batch = next(iter(batcher))
+        del batch  # dropped without set_outputs
+        t.join(5)
+        assert "err" in caught
+
+    def test_set_outputs_twice_raises(self):
+        batcher = DynamicBatcher(batch_dim=0)
+        t = threading.Thread(
+            target=lambda: batcher.compute(np.zeros((1, 1))), daemon=True
+        )
+        t.start()
+        batch = next(iter(batcher))
+        batch.set_outputs(np.zeros((1, 1)))
+        with pytest.raises(RuntimeError, match="twice"):
+            batch.set_outputs(np.zeros((1, 1)))
+        t.join(5)
+
+    def test_output_batch_size_validated(self):
+        batcher = DynamicBatcher(batch_dim=0)
+        t = threading.Thread(
+            target=lambda: _swallow(batcher.compute, np.zeros((1, 1))),
+            daemon=True,
+        )
+        t.start()
+        batch = next(iter(batcher))
+        with pytest.raises(ValueError, match="size"):
+            batch.set_outputs(np.zeros((5, 1)))
+        batch.set_outputs(np.zeros((1, 1)))
+        t.join(5)
+
+    def test_close_wakes_blocked_producers(self):
+        batcher = DynamicBatcher(batch_dim=0)
+        caught = {}
+
+        def producer():
+            try:
+                batcher.compute(np.zeros((1, 1)))
+            except AsyncError as e:
+                caught["err"] = e
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        batcher.close()
+        t.join(5)
+        assert "err" in caught
+
+    def test_stress_many_producers(self):
+        batcher = DynamicBatcher(
+            batch_dim=0, minimum_batch_size=1, maximum_batch_size=64
+        )
+        n = 64
+        outs = {}
+
+        def producer(i):
+            outs[i] = batcher.compute(np.full((1, 1), i))
+
+        def consumer():
+            served = 0
+            for batch in batcher:
+                inputs = batch.get_inputs()
+                batch.set_outputs(inputs * 2)
+                served += len(batch)
+                if served >= n:
+                    return
+
+        ct = threading.Thread(target=consumer, daemon=True)
+        ct.start()
+        producers = [
+            threading.Thread(target=producer, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in producers:
+            t.start()
+        for t in producers:
+            t.join(20)
+        ct.join(5)
+        assert len(outs) == n
+        for i in range(n):
+            np.testing.assert_array_equal(outs[i], [[2 * i]])
+
+
+def _swallow(fn, *args):
+    try:
+        fn(*args)
+    except Exception:
+        pass

@@ -1,0 +1,100 @@
+"""Wire protocol roundtrips, including the reference's non-contiguous-array
+regression (tests/contiguous_arrays_test.py: transposed arrays must survive
+the wire intact)."""
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu.runtime import wire
+
+
+def roundtrip(value):
+    framed = wire.encode(value)
+    length = int.from_bytes(framed[:4], "little")
+    assert length == len(framed) - 4
+    return wire.decode(framed[4:])
+
+
+def test_scalars_and_strings():
+    assert roundtrip(None) is None
+    assert roundtrip(True) is True
+    assert roundtrip(False) is False
+    assert roundtrip(42) == 42
+    assert roundtrip(-1) == -1
+    assert roundtrip(2.5) == 2.5
+    assert roundtrip("héllo") == "héllo"
+
+
+@pytest.mark.parametrize(
+    "dtype", [np.uint8, np.int32, np.int64, np.float32, np.float64, np.bool_]
+)
+def test_array_roundtrip(dtype):
+    rng = np.random.default_rng(0)
+    arr = (rng.random((3, 4, 5)) * 100).astype(dtype)
+    out = roundtrip(arr)
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_non_contiguous_array_survives():
+    # The reference had a bug here (rpcenv.cc:166-170): transposed numpy
+    # arrays are not C-contiguous and must be normalized before the wire.
+    arr = np.arange(12).reshape(3, 4).T
+    assert not arr.flags["C_CONTIGUOUS"]
+    out = roundtrip(arr)
+    np.testing.assert_array_equal(out, arr)
+    assert out.shape == (4, 3)
+
+
+def test_nested_structures():
+    value = {
+        "step": {
+            "frame": np.zeros((2, 2), np.uint8),
+            "reward": 1.5,
+            "done": False,
+        },
+        "list": [np.ones(3, np.float32), "x", None, 7],
+    }
+    out = roundtrip(value)
+    np.testing.assert_array_equal(out["step"]["frame"], value["step"]["frame"])
+    assert out["step"]["reward"] == 1.5
+    assert out["step"]["done"] is False
+    np.testing.assert_array_equal(out["list"][0], value["list"][0])
+    assert out["list"][1:] == ["x", None, 7]
+
+
+def test_empty_containers():
+    assert roundtrip([]) == []
+    assert roundtrip({}) == {}
+
+
+def test_zero_size_array():
+    out = roundtrip(np.zeros((0, 5), np.float32))
+    assert out.shape == (0, 5)
+
+
+def test_zero_dim_array_keeps_shape():
+    # np.ascontiguousarray promotes 0-d to 1-d; the codec must not.
+    out = roundtrip(np.asarray(np.float32(1.5)))
+    assert out.shape == ()
+    assert out.dtype == np.float32
+    assert float(out) == 1.5
+
+
+def test_trailing_garbage_rejected():
+    framed = wire.encode(1)
+    with pytest.raises(wire.WireError):
+        wire.decode(framed[4:] + b"\x00")
+
+
+def test_unknown_tag_rejected():
+    with pytest.raises(wire.WireError):
+        wire.decode(b"\xff")
+
+
+def test_decoded_arrays_are_views():
+    # Zero-copy on decode: the array's memory belongs to the payload.
+    arr = np.arange(10, dtype=np.int64)
+    framed = wire.encode(arr)
+    out = wire.decode(framed[4:])
+    assert not out.flags["OWNDATA"]

@@ -136,14 +136,20 @@ def compute_loss(
     return total_loss, stats
 
 
-def make_update_step(model, optimizer: optax.GradientTransformation, hp: HParams):
+def make_update_step(
+    model, optimizer: optax.GradientTransformation, hp: HParams,
+    donate: bool = True,
+):
     """Build the jitted learner step.
 
     (params, opt_state, batch, initial_agent_state) ->
         (new_params, new_opt_state, stats)
 
-    params and opt_state are donated: XLA reuses their HBM buffers, so the
-    update is in-place on-device and nothing round-trips to the host.
+    With donate=True (single-threaded drivers), params and opt_state are
+    donated: XLA reuses their HBM buffers, so the update is in-place
+    on-device. Async drivers pass donate=False — inference threads hold
+    references to the live params pytree, and donation would invalidate
+    them mid-flight.
     """
 
     def update_step(params, opt_state, batch, initial_agent_state):
@@ -157,7 +163,7 @@ def make_update_step(model, optimizer: optax.GradientTransformation, hp: HParams
         stats["grad_norm"] = optax.global_norm(grads)
         return params, opt_state, stats
 
-    return jax.jit(update_step, donate_argnums=(0, 1))
+    return jax.jit(update_step, donate_argnums=(0, 1) if donate else ())
 
 
 def make_act_step(model):

@@ -1,0 +1,12 @@
+from torchbeast_tpu.parallel.dp import (  # noqa: F401
+    initialize_distributed,
+    make_parallel_update_step,
+    replicate,
+    shard_batch,
+)
+from torchbeast_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    create_mesh,
+    replicated,
+    state_sharding,
+)

@@ -1,0 +1,111 @@
+"""Data-parallel learner: the jitted update step sharded over the mesh.
+
+Replaces what the reference would have needed NCCL/torch.distributed for
+(it has neither — single learner process, SURVEY.md §2.3). Design: params
+and optimizer state live replicated on every chip; each learner batch
+[T+1, B, ...] is sharded along B over the `data` axis; `jax.jit` with these
+shardings makes XLA compute per-shard gradients and insert the ICI
+all-reduce that keeps params replicated. No hand-written collectives — the
+compiler lays them on the ICI rings.
+
+Multi-host: call `initialize_distributed()` first (jax.distributed over
+DCN), then build the mesh over `jax.devices()` (global). Each host feeds
+its local shard of the batch via `make_global_batch` (device_put to local
+addressable shards + jax.make_array_from_single_device_arrays).
+"""
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import optax
+
+from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu.parallel import mesh as mesh_lib
+
+log = logging.getLogger(__name__)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed.initialize with env-var fallbacks.
+
+    The DCN analog of the reference's "anything gRPC accepts works across
+    machines" story (SURVEY.md §5.8): one coordinator address, N learner
+    processes, each seeing its local TPU chips; collectives ride ICI within
+    a host and DCN across.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "TORCHBEAST_COORDINATOR"
+    )
+    if coordinator_address is None:
+        log.info("No coordinator configured; single-process mode.")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(
+            num_processes or os.environ.get("TORCHBEAST_NUM_PROCESSES", 1)
+        ),
+        process_id=int(
+            process_id or os.environ.get("TORCHBEAST_PROCESS_ID", 0)
+        ),
+    )
+
+
+def make_parallel_update_step(model, optimizer, hp: learner_lib.HParams, mesh):
+    """Data-parallel version of learner.make_update_step.
+
+    Same signature and semantics; gradients are averaged over the `data`
+    axis implicitly by XLA's all-reduce (sum-reduced losses over a sharded
+    batch == the reference's single-learner loss over the full batch).
+    """
+    repl = mesh_lib.replicated(mesh)
+    bsh = mesh_lib.batch_sharding(mesh)
+    ssh = mesh_lib.state_sharding(mesh)
+
+    def update_step(params, opt_state, batch, initial_agent_state):
+        grads, stats = jax.grad(
+            lambda p: learner_lib.compute_loss(
+                model, p, batch, initial_agent_state, hp
+            ),
+            has_aux=True,
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, stats
+
+    # A single NamedSharding acts as a pytree prefix: it applies to every
+    # leaf of the batch dict (all leaves are [T+1, B, ...]).
+    return jax.jit(
+        update_step,
+        in_shardings=(repl, repl, bsh, ssh),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 1),
+    )
+
+
+def shard_batch(mesh, batch: Dict[str, np.ndarray], initial_agent_state: Any):
+    """Host -> device: place a host-global batch with the DP shardings.
+
+    Single-process path: jax.device_put handles splitting across local
+    devices. (The multi-host variant assembles a global array from each
+    host's local shard; that lands with the distributed driver.)
+    """
+    bsh = mesh_lib.batch_sharding(mesh)
+    ssh = mesh_lib.state_sharding(mesh)
+    batch = {k: jax.device_put(v, bsh) for k, v in batch.items()}
+    initial_agent_state = jax.tree_util.tree_map(
+        lambda s: jax.device_put(s, ssh), initial_agent_state
+    )
+    return batch, initial_agent_state
+
+
+def replicate(mesh, tree):
+    """Place params/opt_state replicated on every mesh device."""
+    return jax.device_put(tree, mesh_lib.replicated(mesh))

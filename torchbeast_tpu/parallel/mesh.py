@@ -1,0 +1,56 @@
+"""Device mesh construction and sharding vocabulary.
+
+The reference has NO collective layer at all — its learner is a single
+process and its only multi-device trick is putting the inference model on a
+second GPU (SURVEY.md §2.3). This module is the missing piece built
+first-class: a `jax.sharding.Mesh` over TPU chips (ICI) and hosts (DCN),
+with named axes and `NamedSharding` helpers that the learner step is jitted
+against. XLA inserts the gradient all-reduce (psum over the `data` axis)
+because params are replicated while the batch is sharded.
+
+Axes:
+- `data`: batch-dimension sharding for the learner (gradient all-reduce
+  rides ICI).
+- `model` (optional, size 1 by default): reserved for sharding wide layers;
+  the IMPALA conv nets don't need it, but the axis exists so the same mesh
+  recipe scales to models that do.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(
+    n_devices: Optional[int] = None,
+    model_parallelism: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % model_parallelism != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism="
+            f"{model_parallelism}"
+        )
+    grid = np.asarray(devices).reshape(n // model_parallelism, model_parallelism)
+    return Mesh(grid, ("data", "model"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Time-major [T, B, ...] arrays: shard the batch axis over `data`."""
+    return NamedSharding(mesh, P(None, "data"))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Recurrent state [L, B, H]: shard the batch axis over `data`."""
+    return NamedSharding(mesh, P(None, "data"))

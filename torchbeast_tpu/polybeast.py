@@ -1,0 +1,339 @@
+"""Scalable async IMPALA learner (the reference PolyBeast's role,
+/root/reference/torchbeast/polybeast_learner.py + polybeast.py), TPU-native.
+
+Runtime shape mirrors the reference (SURVEY.md §3.2/§3.3): an ActorPool of
+socket actor loops feeds a DynamicBatcher whose consumer threads run a
+jitted bucket-padded forward on the TPU; completed rollouts flow through a
+BatchingQueue (backpressure = on-policy guarantee) into the learner thread,
+which runs the single jitted update step. Where the reference copies
+weights to a second GPU each step (load_state_dict, polybeast_learner.py:
+369), here actor and learner share one on-device params pytree — weight
+propagation is a reference rebind under the GIL, zero copies.
+
+Run (combined, like the reference's polybeast.py launcher):
+  python -m torchbeast_tpu.polybeast --env Mock --num_servers 4 \
+      --total_steps 20000
+"""
+
+import argparse
+import logging
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+
+from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu import polybeast_env
+from torchbeast_tpu.monobeast import (
+    _init_model_and_params,
+    _probe_env,
+    hparams_from_flags,
+)
+from torchbeast_tpu.runtime.actor_pool import ActorPool
+from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
+from torchbeast_tpu.utils import (
+    FileWriter,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+logging.basicConfig(
+    format=(
+        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
+        "%(message)s"
+    ),
+    level=logging.INFO,
+)
+log = logging.getLogger("torchbeast_tpu.polybeast")
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--pipes_basename", default="unix:/tmp/torchbeast_tpu")
+    parser.add_argument("--num_actors", type=int, default=None,
+                        help="Actor loops (default: one per server).")
+    parser.add_argument("--num_servers", type=int, default=4)
+    parser.add_argument("--env", type=str, default="PongNoFrameskip-v4")
+    parser.add_argument("--mode", default="train", choices=["train"])
+    parser.add_argument("--xpid", default=None)
+    parser.add_argument("--start_servers", dest="start_servers",
+                        action="store_true", default=True,
+                        help="Spawn local env servers (the combined "
+                             "launcher mode).")
+    parser.add_argument("--no_start_servers", dest="start_servers",
+                        action="store_false",
+                        help="Connect to externally-launched servers.")
+    # Training.
+    parser.add_argument("--savedir", default="~/logs/torchbeast_tpu")
+    parser.add_argument("--total_steps", type=int, default=100000)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--unroll_length", type=int, default=80)
+    parser.add_argument("--model", default="deep",
+                        choices=["shallow", "deep"])
+    parser.add_argument("--use_lstm", action="store_true")
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--num_inference_threads", type=int, default=2)
+    parser.add_argument("--max_inference_batch_size", type=int, default=64)
+    parser.add_argument("--inference_timeout_ms", type=float, default=100)
+    parser.add_argument("--max_learner_queue_size", type=int, default=None,
+                        help="Backpressure bound (default: batch_size).")
+    parser.add_argument("--checkpoint_interval_s", type=int, default=600)
+    # Loss / optimizer (same knobs as monobeast).
+    parser.add_argument("--entropy_cost", type=float, default=0.0006)
+    parser.add_argument("--baseline_cost", type=float, default=0.5)
+    parser.add_argument("--discounting", type=float, default=0.99)
+    parser.add_argument("--reward_clipping", default="abs_one",
+                        choices=["abs_one", "none"])
+    parser.add_argument("--learning_rate", type=float, default=4.8e-4)
+    parser.add_argument("--alpha", type=float, default=0.99)
+    parser.add_argument("--momentum", type=float, default=0.0)
+    parser.add_argument("--epsilon", type=float, default=0.01)
+    parser.add_argument("--grad_norm_clipping", type=float, default=40.0)
+    parser.add_argument("--profile_dir", default=None)
+    return parser
+
+
+def train(flags):
+    if flags.xpid is None:
+        flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
+    plogger = FileWriter(
+        xpid=flags.xpid, xp_args=vars(flags), rootdir=flags.savedir
+    )
+    checkpoint_path = os.path.join(
+        os.path.expanduser(flags.savedir), flags.xpid, "model.ckpt"
+    )
+
+    num_actors = flags.num_actors or flags.num_servers
+    addresses = [
+        polybeast_env.server_address(
+            flags.pipes_basename, i % flags.num_servers
+        )
+        for i in range(num_actors)
+    ]
+
+    server_procs = []
+    if flags.start_servers:
+        server_procs = polybeast_env.start_servers(flags)
+        time.sleep(0.5)
+
+    hp = hparams_from_flags(flags)
+    num_actions, frame_shape, frame_dtype = _probe_env_via_server(
+        flags, addresses[0]
+    )
+
+    model, params = _init_model_and_params(
+        flags, num_actions, flags.batch_size, frame_shape, frame_dtype
+    )
+    optimizer = learner_lib.make_optimizer(hp)
+    opt_state = optimizer.init(params)
+
+    step = 0
+    stats = {}
+    if os.path.exists(checkpoint_path):
+        restored = load_checkpoint(
+            checkpoint_path,
+            params_template=params,
+            opt_state_template=opt_state,
+        )
+        params, opt_state = restored["params"], restored["opt_state"]
+        step = restored["step"]
+        stats = restored["stats"]
+        log.info("Resuming preempted job, current stats:\n%s", stats)
+
+    # donate=False: inference threads hold live references to params.
+    update_step = learner_lib.make_update_step(model, optimizer, hp,
+                                               donate=False)
+    act_step = learner_lib.make_act_step(model)
+
+    # Shared mutable state: the learner rebinds these; inference reads them.
+    state = {
+        "params": params,
+        "opt_state": opt_state,
+        "step": step,
+        "stats": dict(stats),
+        "rng": jax.random.PRNGKey(flags.seed),
+        "done": False,
+    }
+    state_lock = threading.Lock()
+
+    learner_queue = BatchingQueue(
+        batch_dim=1,
+        minimum_batch_size=flags.batch_size,
+        maximum_batch_size=flags.batch_size,
+        maximum_queue_size=flags.max_learner_queue_size or flags.batch_size,
+        check_inputs=True,
+    )
+    inference_batcher = DynamicBatcher(
+        batch_dim=1,
+        minimum_batch_size=1,
+        maximum_batch_size=flags.max_inference_batch_size,
+        timeout_ms=flags.inference_timeout_ms,
+    )
+
+    def act_fn(env_outputs, agent_state, batch_size):
+        """Bucket-static jitted forward (called under the inference lock)."""
+        with state_lock:
+            params_now = state["params"]
+            state["rng"], key = jax.random.split(state["rng"])
+        model_inputs = {
+            k: env_outputs[k]
+            for k in ("frame", "reward", "done", "last_action")
+        }
+        # act_step consumes [B, ...] (adds T=1 itself); inputs are [1, B].
+        model_inputs = {k: v[0] for k, v in model_inputs.items()}
+        out, new_state = act_step(params_now, key, model_inputs, agent_state)
+        out = {
+            "action": np.asarray(out.action)[None],
+            "policy_logits": np.asarray(out.policy_logits)[None],
+            "baseline": np.asarray(out.baseline)[None],
+        }
+        return out, new_state
+
+    inference_lock = threading.Lock()  # one lock shared by all threads
+    inference_threads = [
+        threading.Thread(
+            target=inference_loop,
+            args=(
+                inference_batcher,
+                act_fn,
+                flags.max_inference_batch_size,
+            ),
+            kwargs={"lock": inference_lock},
+            daemon=True,
+            name=f"inference-{i}",
+        )
+        for i in range(flags.num_inference_threads)
+    ]
+
+    actors = ActorPool(
+        unroll_length=flags.unroll_length,
+        learner_queue=learner_queue,
+        inference_batcher=inference_batcher,
+        env_server_addresses=addresses,
+        initial_agent_state=model.initial_state(1),
+    )
+    actor_thread = threading.Thread(
+        target=actors.run, daemon=True, name="actorpool"
+    )
+
+    def learner_loop():
+        for item in learner_queue:
+            batch = item["batch"]
+            initial_agent_state = item["initial_agent_state"]
+            with state_lock:
+                params_now, opt_now = state["params"], state["opt_state"]
+            new_params, new_opt, train_stats = update_step(
+                params_now, opt_now, batch, initial_agent_state
+            )
+            train_stats = jax.device_get(train_stats)
+            with state_lock:
+                state["params"], state["opt_state"] = new_params, new_opt
+                state["step"] += flags.unroll_length * flags.batch_size
+                s = learner_lib.episode_stat_postprocess(train_stats)
+                s["step"] = state["step"]
+                s["learner_queue_size"] = learner_queue.size()
+                state["stats"] = s
+            plogger.log(s)
+            if state["step"] >= flags.total_steps:
+                break
+        with state_lock:
+            state["done"] = True
+
+    learner_thread = threading.Thread(
+        target=learner_loop, daemon=True, name="learner"
+    )
+
+    for t in inference_threads:
+        t.start()
+    actor_thread.start()
+    learner_thread.start()
+
+    if flags.profile_dir:
+        jax.profiler.start_trace(flags.profile_dir)
+
+    last_checkpoint = time.time()
+    last_step, last_time = state["step"], time.time()
+    try:
+        while not state["done"]:
+            time.sleep(5)
+            if actors.errors and not state["done"]:
+                raise RuntimeError(
+                    "Actor pool failed"
+                ) from actors.errors[0]
+            with state_lock:
+                now_step = state["step"]
+                stats_now = dict(state["stats"])
+            now = time.time()
+            sps = (now_step - last_step) / (now - last_time)
+            last_step, last_time = now_step, now
+            log.info(
+                "Step %d @ %.1f SPS. Inference batcher size: %d. "
+                "Learner queue size: %d. Loss %.4f. %s",
+                now_step, sps, inference_batcher.size(),
+                learner_queue.size(),
+                stats_now.get("total_loss", float("nan")),
+                f"Return {stats_now['mean_episode_return']:.1f}."
+                if "mean_episode_return" in stats_now else "",
+            )
+            if now - last_checkpoint > flags.checkpoint_interval_s:
+                with state_lock:
+                    save_checkpoint(
+                        checkpoint_path,
+                        params=state["params"],
+                        opt_state=state["opt_state"],
+                        step=state["step"],
+                        flags=vars(flags),
+                        stats=state["stats"],
+                    )
+                last_checkpoint = now
+        successful = True
+    except KeyboardInterrupt:
+        successful = True
+    except BaseException:
+        successful = False
+        raise
+    finally:
+        if flags.profile_dir:
+            jax.profiler.stop_trace()
+        # Shutdown ordering mirrors the reference (polybeast_learner.py:
+        # 587-593): close batcher + queue, join actors, join threads.
+        for closer in (inference_batcher, learner_queue):
+            try:
+                closer.close()
+            except RuntimeError:
+                pass
+        actor_thread.join(timeout=10)
+        learner_thread.join(timeout=10)
+        with state_lock:
+            save_checkpoint(
+                checkpoint_path,
+                params=state["params"],
+                opt_state=state["opt_state"],
+                step=state["step"],
+                flags=vars(flags),
+                stats=state["stats"],
+            )
+        plogger.close(successful=successful)
+        for p in server_procs:
+            p.terminate()
+    log.info("Learning finished after %d steps.", state["step"])
+    return state["stats"]
+
+
+def _probe_env_via_server(flags, address):
+    """Probe action/observation spec locally (servers host the same env)."""
+    del address  # local probe is enough; servers run the same env id
+    return _probe_env(flags)
+
+
+def main(flags):
+    return train(flags)
+
+
+if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    main(make_parser().parse_args())

@@ -1,0 +1,83 @@
+"""Env-server process group driver (the reference's polybeast_env.py role,
+/root/reference/torchbeast/polybeast_env.py:61-89): spawn `num_servers`
+processes, each serving environments on `{pipes_basename}.{i}` over the
+framed-socket protocol.
+
+Run:  python -m torchbeast_tpu.polybeast_env --num_servers 4 --env Mock
+"""
+
+import argparse
+import functools
+import logging
+import multiprocessing as mp
+import time
+
+logging.basicConfig(
+    format=(
+        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
+        "%(message)s"
+    ),
+    level=logging.INFO,
+)
+log = logging.getLogger("torchbeast_tpu.polybeast_env")
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--pipes_basename", default="unix:/tmp/torchbeast_tpu",
+                        help="Basename for the env-server addresses "
+                             "(unix:/path or host:baseport).")
+    parser.add_argument("--num_servers", type=int, default=4)
+    parser.add_argument("--env", type=str, default="PongNoFrameskip-v4",
+                        help="Gym environment (or Mock / Counting).")
+    return parser
+
+
+def server_address(pipes_basename: str, index: int) -> str:
+    """unix:/tmp/x -> unix:/tmp/x.{i};  host:port -> host:{port+i}."""
+    if pipes_basename.startswith("unix:"):
+        return f"{pipes_basename}.{index}"
+    host, _, port = pipes_basename.rpartition(":")
+    return f"{host}:{int(port) + index}"
+
+
+def _serve(env_name: str, address: str):
+    # Child process body. Import here: workers must never inherit JAX state.
+    from torchbeast_tpu.envs import create_env
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    EnvServer(functools.partial(create_env, env_name), address).run()
+
+
+def start_servers(flags, ctx_name: str = "spawn"):
+    ctx = mp.get_context(ctx_name)
+    processes = []
+    for i in range(flags.num_servers):
+        address = server_address(flags.pipes_basename, i)
+        p = ctx.Process(
+            target=_serve, args=(flags.env, address), daemon=True
+        )
+        p.start()
+        processes.append(p)
+    log.info("Starting %d env servers on %s", len(processes),
+             flags.pipes_basename)
+    return processes
+
+
+def main(flags):
+    processes = start_servers(flags)
+    try:
+        while True:
+            time.sleep(10)
+            for i, p in enumerate(processes):
+                if not p.is_alive():
+                    log.error("Env server %d died (exit %s)", i, p.exitcode)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for p in processes:
+            p.terminate()
+
+
+if __name__ == "__main__":
+    main(make_parser().parse_args())

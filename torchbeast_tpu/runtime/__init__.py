@@ -1,0 +1,15 @@
+"""Runtime: queues, dynamic batching, wire protocol, env servers, actor
+pool — the reference's `libtorchbeast` layer (SURVEY.md §2.1 N3-N9),
+re-designed for the framed-socket transport and XLA-static inference.
+
+Python implementations carry the semantics and the test surface; the C++
+hot-path equivalents live under csrc/ and are used when built.
+"""
+
+from torchbeast_tpu.runtime.queues import (  # noqa: F401
+    AsyncError,
+    Batch,
+    BatchingQueue,
+    ClosedBatchingQueue,
+    DynamicBatcher,
+)

@@ -1,0 +1,198 @@
+"""Actor pool: N concurrent loops streaming env steps through dynamic
+inference batching into the learner queue.
+
+The reference's C++ `ActorPool` (/root/reference/src/cc/actorpool.cc:342-564)
+re-designed for the framed-socket transport: each actor loop connects to an
+env-server address, reads the initial Step, and then repeats
+  compute(env_outputs, agent_state) -> action
+  send(action) -> recv(next Step)
+accumulating unroll_length+1 steps per rollout with the same invariants as
+the sync collector (rollout.py): overlap-by-one (the last step of rollout k
+is slot 0 of rollout k+1, actorpool.cc:414,443), agent-output pairing, and
+agent-state carry with `initial_agent_state` captured at rollout entry
+(actorpool.cc:449).
+
+Rollouts are enqueued as (rollout_nest, initial_agent_state) onto the
+learner BatchingQueue batched along time dim 0 with a [T+1, 1, ...] layout,
+so the queue's batch_dim=1 concatenation yields [T+1, B, ...] learner
+batches (reference actorpool.cc:443-447, polybeast_learner.py:306).
+
+Threads instead of std::async tasks: the loops spend their time blocked in
+socket IO and in compute() (both release the GIL); the C++ pool in csrc/
+takes over when Python-thread overhead shows up in profiles.
+"""
+
+import logging
+import socket
+import threading
+import time
+from typing import Any, List
+
+import numpy as np
+
+from torchbeast_tpu import nest
+from torchbeast_tpu.runtime import wire
+from torchbeast_tpu.runtime.env_server import parse_address
+from torchbeast_tpu.runtime.queues import (
+    BatchingQueue,
+    ClosedBatchingQueue,
+    DynamicBatcher,
+)
+
+log = logging.getLogger(__name__)
+
+_ENV_KEYS = (
+    "frame", "reward", "done", "episode_step", "episode_return",
+    "last_action",
+)
+
+
+class ActorPool:
+    def __init__(
+        self,
+        unroll_length: int,
+        learner_queue: BatchingQueue,
+        inference_batcher: DynamicBatcher,
+        env_server_addresses: List[str],
+        initial_agent_state: Any,
+        connect_timeout_s: float = 600,
+    ):
+        self._unroll_length = unroll_length
+        self._learner_queue = learner_queue
+        self._inference_batcher = inference_batcher
+        self._addresses = list(env_server_addresses)
+        self._initial_agent_state = initial_agent_state
+        self._connect_timeout_s = connect_timeout_s
+        self._count = 0
+        self._count_lock = threading.Lock()
+        self._errors: List[BaseException] = []
+
+    def count(self) -> int:
+        """Total env steps taken (reference actorpool.cc:478,557)."""
+        with self._count_lock:
+            return self._count
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return list(self._errors)
+
+    def run(self):
+        """Run one loop per address; blocks until all exit. First error is
+        re-raised (reference surfaces only the first future's exception,
+        actorpool.cc:470-475)."""
+        threads = [
+            threading.Thread(
+                target=self._guarded_loop, args=(i, addr), daemon=True
+            )
+            for i, addr in enumerate(self._addresses)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if self._errors:
+            raise self._errors[0]
+
+    def _guarded_loop(self, index: int, address: str):
+        try:
+            self._loop(index, address)
+        except ClosedBatchingQueue:
+            pass  # clean shutdown (reference actorpool.cc:452-459)
+        except BaseException as e:  # noqa: BLE001
+            log.exception("Actor %d (%s) failed", index, address)
+            self._errors.append(e)
+
+    def _connect(self, address: str) -> socket.socket:
+        """Connect with retries until the deadline (the reference's
+        10-minute WaitForConnected semantics, actorpool.cc:354-372): env
+        servers may still be starting up — a refused/missing socket is a
+        reason to retry, not to die."""
+        family, target = parse_address(address)
+        deadline = time.monotonic() + self._connect_timeout_s
+        last_error = None
+        while time.monotonic() < deadline:
+            sock = socket.socket(family, socket.SOCK_STREAM)
+            sock.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                sock.connect(target)
+            except OSError as e:
+                sock.close()
+                last_error = e
+                time.sleep(0.1)
+                continue
+            sock.settimeout(None)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            return sock
+        raise TimeoutError(
+            f"WaitForConnected() timed out for {address}: {last_error}"
+        )
+
+    @staticmethod
+    def _env_outputs(msg) -> dict:
+        if msg is None:
+            raise ConnectionError("Env server closed the stream")
+        if msg.get("type") == "error":
+            raise RuntimeError(f"Env server error: {msg.get('message')}")
+        # [T=1, B=1] leading dims so rollout stacking and queue batching
+        # are pure concatenations (reference array_pb_to_nest prepends
+        # [1, 1], actorpool.cc:480-491).
+        return {
+            k: np.asarray(msg[k])[None, None] for k in _ENV_KEYS
+        }
+
+    def _loop(self, index: int, address: str):
+        sock = self._connect(address)
+        try:
+            env_outputs = self._env_outputs(wire.recv_message(sock))
+            agent_state = self._initial_agent_state
+            agent_outputs, agent_state = self._compute(
+                env_outputs, agent_state, advance=False
+            )
+            rollout = [(env_outputs, agent_outputs)]
+            initial_agent_state = self._initial_agent_state
+            while True:
+                agent_outputs, agent_state = self._compute(
+                    env_outputs, agent_state, advance=True
+                )
+                action = int(np.asarray(agent_outputs["action"]).reshape(()))
+                wire.send_message(
+                    sock, {"type": "action", "action": action}
+                )
+                env_outputs = self._env_outputs(wire.recv_message(sock))
+                with self._count_lock:
+                    self._count += 1
+                rollout.append((env_outputs, agent_outputs))
+                if len(rollout) == self._unroll_length + 1:
+                    self._enqueue_rollout(rollout, initial_agent_state)
+                    rollout = [rollout[-1]]  # overlap-by-one
+                    initial_agent_state = agent_state
+        finally:
+            sock.close()
+
+    def _compute(self, env_outputs, agent_state, advance: bool):
+        outputs = self._inference_batcher.compute(
+            {"env": env_outputs, "agent_state": agent_state}
+        )
+        new_state = outputs["agent_state"]
+        agent_outputs = outputs["outputs"]
+        if not advance:
+            new_state = agent_state
+        return agent_outputs, new_state
+
+    def _enqueue_rollout(self, rollout, initial_agent_state):
+        env_steps = [env for env, _ in rollout]
+        agent_steps = [agent for _, agent in rollout]
+        stacked = {
+            k: np.concatenate([s[k] for s in env_steps], axis=0)
+            for k in _ENV_KEYS
+        }
+        for key in agent_steps[0]:
+            stacked[key] = np.concatenate(
+                [np.asarray(s[key]) for s in agent_steps], axis=0
+            )
+        self._learner_queue.enqueue(
+            {"batch": stacked, "initial_agent_state": initial_agent_state}
+        )

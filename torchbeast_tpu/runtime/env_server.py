@@ -1,0 +1,138 @@
+"""Environment server: hosts environments behind a streaming socket.
+
+The reference's gRPC `EnvServer` (/root/reference/src/cc/rpcenv.cc:36-211,
+driven by polybeast_env.py:61-77) re-designed over the framed-socket wire
+protocol: each incoming connection gets a FRESH environment instance
+(reference rpcenv.cc:72), the server sends the initial Step, then loops
+recv(Action) -> env.step -> send(Step). Episode accounting and auto-reset
+live in the Environment adapter (envs/environment.py), matching the
+reference's server-side bookkeeping (rpcenv.cc:106-119).
+
+Env exceptions are reported to the client as an error message frame (the
+reference surfaces them as grpc INTERNAL status, rpcenv.cc:76-81).
+
+Addresses: "unix:/path" or "host:port" (same convention as the reference's
+pipes_basename, polybeast_learner.py:40-42).
+"""
+
+import logging
+import os
+import socket
+import threading
+from typing import Callable
+
+import numpy as np
+
+from torchbeast_tpu.envs.environment import Environment
+from torchbeast_tpu.runtime import wire
+
+log = logging.getLogger(__name__)
+
+
+def parse_address(address: str):
+    if address.startswith("unix:"):
+        return socket.AF_UNIX, address[len("unix:") :]
+    host, _, port = address.rpartition(":")
+    return socket.AF_INET, (host or "127.0.0.1", int(port))
+
+
+def _step_to_message(step) -> dict:
+    # 0-d arrays (not python scalars) so dtypes survive the wire exactly:
+    # reward stays float32, done bool, counters int32.
+    return {"type": "step", **{k: np.asarray(v) for k, v in step.items()}}
+
+
+class EnvServer:
+    """Serve env streams; one thread per connection."""
+
+    def __init__(self, env_init: Callable, address: str):
+        self._env_init = env_init
+        self._address = address
+        self._family, self._target = parse_address(address)
+        self._sock = None
+        self._threads = []
+        self._running = False
+
+    def run(self):
+        """Bind and serve until stop() (reference Server.run blocks too,
+        rpcenv.cc:142-156)."""
+        self._sock = socket.socket(self._family, socket.SOCK_STREAM)
+        if self._family == socket.AF_UNIX:
+            try:
+                os.unlink(self._target)
+            except FileNotFoundError:
+                pass
+        else:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._target)
+        self._sock.listen(16)
+        self._running = True
+        log.info("EnvServer listening on %s", self._address)
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break  # socket closed by stop()
+            t = threading.Thread(
+                target=self._serve_stream, args=(conn,), daemon=True
+            )
+            t.start()
+            # Prune finished stream threads so reconnect-heavy workloads
+            # don't grow this list unboundedly.
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def start(self):
+        """Non-blocking run() in a daemon thread."""
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+        if self._family == socket.AF_UNIX:
+            try:
+                os.unlink(self._target)
+            except FileNotFoundError:
+                pass
+
+    def _serve_stream(self, conn: socket.socket):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # unix sockets
+        env = Environment(self._env_init())
+        try:
+            wire.send_message(conn, _step_to_message(env.initial()))
+            while True:
+                msg = wire.recv_message(conn)
+                if msg is None:
+                    break  # client hung up
+                if msg.get("type") != "action":
+                    raise wire.WireError(f"Expected action, got {msg!r}")
+                step = env.step(int(msg["action"]))
+                wire.send_message(conn, _step_to_message(step))
+        except (wire.WireError, ConnectionError, BrokenPipeError) as e:
+            log.debug("Stream ended: %s", e)
+        except Exception as e:  # env raised: report to client, drop stream
+            log.exception("Environment raised")
+            try:
+                wire.send_message(
+                    conn, {"type": "error", "message": f"{type(e).__name__}: {e}"}
+                )
+            except OSError:
+                pass
+        finally:
+            env.close()
+            conn.close()
+
+
+def serve_once(env_init: Callable, address: str):
+    """Convenience: construct and run (blocking)."""
+    EnvServer(env_init, address).run()

@@ -1,0 +1,115 @@
+"""TPU inference server loop: drain the DynamicBatcher with a jitted,
+bucket-padded forward.
+
+The reference's inference threads run the model on whatever batch size the
+batcher produced (polybeast_learner.py:269-285) — fine for CUDA, hostile to
+XLA, where every distinct batch size is a recompile (SURVEY.md §7 hard part
+#1). Here each dynamic batch is padded up to the nearest power-of-two bucket
+(row 0 repeated), the jitted step runs at that static shape (one compile per
+bucket, a handful total), and the outputs are sliced back to the true size
+before set_outputs distributes rows to the waiting actors.
+"""
+
+import logging
+import threading
+from typing import Any, Callable, List
+
+import numpy as np
+
+from torchbeast_tpu import nest
+
+log = logging.getLogger(__name__)
+
+
+def bucket_size(n: int, buckets: List[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"Batch of {n} exceeds largest bucket {buckets[-1]}")
+
+
+def default_buckets(max_batch_size: int) -> List[int]:
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return out
+
+
+def pad_to(tree: Any, size: int, batch_dim: int) -> Any:
+    """Pad every leaf to `size` along batch_dim by repeating the edge row
+    (valid data, so the padded forward can't produce NaNs that would
+    poison batch-norm-style reductions; pad rows are sliced off after)."""
+
+    def pad(arr):
+        arr = np.asarray(arr)
+        n = arr.shape[batch_dim]
+        if n == size:
+            return arr
+        pad_width = [(0, 0)] * arr.ndim
+        pad_width[batch_dim] = (0, size - n)
+        return np.pad(arr, pad_width, mode="edge")
+
+    return nest.map(pad, tree)
+
+
+def slice_to(tree: Any, size: int, batch_dim: int) -> Any:
+    def cut(arr):
+        arr = np.asarray(arr)
+        sl = [slice(None)] * arr.ndim
+        sl[batch_dim] = slice(0, size)
+        return arr[tuple(sl)]
+
+    return nest.map(cut, tree)
+
+
+def inference_loop(
+    inference_batcher,
+    act_fn: Callable,
+    max_batch_size: int,
+    batch_dim: int = 1,
+    lock: threading.Lock = None,
+):
+    """Thread body (run num_inference_threads of these).
+
+    act_fn(env_outputs, agent_state, batch_size) ->
+        (agent_outputs, new_agent_state)   # numpy or device arrays
+
+    act_fn owns params access and rng threading (see polybeast.py). Pass
+    ONE lock shared by every inference thread to serialize model calls
+    (the reference's inference lock, polybeast_learner.py:269, 281-283);
+    with lock=None calls run concurrently (safe for pure jitted act_fns —
+    the device serializes execution anyway).
+
+    A failing act_fn fails only its batch (promises broken with the error
+    so producers wake immediately); the loop continues serving.
+    """
+    buckets = default_buckets(max_batch_size)
+    for batch in inference_batcher:
+        try:
+            inputs = batch.get_inputs()
+            env_outputs, agent_state = inputs["env"], inputs["agent_state"]
+            n = len(batch)
+            padded = bucket_size(n, buckets)
+            env_padded = pad_to(env_outputs, padded, batch_dim)
+            state_padded = pad_to(agent_state, padded, batch_dim)
+            if lock is not None:
+                with lock:
+                    outputs, new_state = act_fn(
+                        env_padded, state_padded, padded
+                    )
+            else:
+                outputs, new_state = act_fn(env_padded, state_padded, padded)
+            outputs = nest.map(np.asarray, outputs)
+            new_state = nest.map(np.asarray, new_state)
+            batch.set_outputs(
+                {
+                    "outputs": slice_to(outputs, n, batch_dim),
+                    "agent_state": slice_to(new_state, n, batch_dim),
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            log.exception("Inference batch failed; continuing")
+            batch.fail(e)

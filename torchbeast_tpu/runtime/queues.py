@@ -1,0 +1,328 @@
+"""Learner-side batching queue and dynamic inference batcher.
+
+Python re-designs of the reference's C++ runtime pieces (the C++ versions
+land under csrc/ for the hot path; these carry the exact semantics and the
+test surface):
+
+- BatchingQueue: the reference's `BatchingQueue<T>`
+  (/root/reference/src/cc/actorpool.cc:57-222). Bounded producer/consumer
+  queue of (nest-of-arrays, payload); `enqueue` blocks when full — the
+  backpressure that keeps rollouts on-policy; `dequeue_many` waits for
+  min_batch_size items (or timeout) and concatenates up to max_batch_size
+  along batch_dim; `close()` drains and wakes waiters; iterating a closed,
+  empty queue raises StopIteration.
+
+- DynamicBatcher: the reference's `DynamicBatcher`
+  (actorpool.cc:224-340). Producers call `compute(inputs)` and block until
+  a consumer picks up the batch via iteration, runs the model, and calls
+  `batch.set_outputs(outputs)`; each producer gets its slice back. Dropping
+  a batch without outputs breaks the promise -> AsyncError at producers.
+  Batch sizes are dynamic in [minimum_batch_size, maximum_batch_size] with
+  a timeout — the TPU-side consumer pads to a bucket size before running
+  XLA (see runtime/inference.py) because variable shapes would recompile.
+"""
+
+import collections
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from torchbeast_tpu import nest
+
+
+class ClosedBatchingQueue(RuntimeError):
+    pass
+
+
+class AsyncError(RuntimeError):
+    pass
+
+
+def _concat_nests(items: List[Any], batch_dim: int):
+    """Concatenate structurally-equal nests of numpy arrays along
+    batch_dim (the reference's batch() helper, actorpool.cc:49-55)."""
+    flats = [nest.flatten(item) for item in items]
+    out = [
+        np.concatenate([f[i] for f in flats], axis=batch_dim)
+        for i in range(len(flats[0]))
+    ]
+    return nest.pack_as(items[0], out)
+
+
+class BatchingQueue:
+    def __init__(
+        self,
+        batch_dim: int = 0,
+        minimum_batch_size: int = 1,
+        maximum_batch_size: Optional[int] = None,
+        timeout_ms: Optional[float] = None,
+        maximum_queue_size: Optional[int] = None,
+        check_inputs: bool = True,
+    ):
+        if minimum_batch_size < 1:
+            raise ValueError("Min batch size must be >= 1")
+        if maximum_batch_size is not None:
+            if maximum_batch_size < minimum_batch_size:
+                raise ValueError(
+                    "Max batch size must be >= min batch size"
+                )
+        if maximum_queue_size is not None and maximum_queue_size < 1:
+            raise ValueError("Max queue size must be >= 1")
+        self._batch_dim = batch_dim
+        self._min = minimum_batch_size
+        self._max = maximum_batch_size or float("inf")
+        self._timeout_s = timeout_ms / 1000 if timeout_ms else None
+        self._max_queue = maximum_queue_size or float("inf")
+        self._check_inputs = check_inputs
+
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._deque = collections.deque()  # (inputs, payload, rows)
+        self._closed = False
+        self._num_enqueued = 0
+
+    def name(self):
+        return type(self).__name__
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._deque)
+
+    def num_enqueued(self) -> int:
+        with self._lock:
+            return self._num_enqueued
+
+    def enqueue(self, inputs: Any, payload: Any = None):
+        leaves = nest.flatten(inputs)
+        if self._check_inputs:
+            if not leaves:
+                raise ValueError("Cannot enqueue empty vector of arrays")
+            for leaf in leaves:
+                arr = np.asarray(leaf)
+                if arr.ndim <= self._batch_dim:
+                    raise ValueError(
+                        f"Enqueued array with {arr.ndim} dims but "
+                        f"batch_dim is {self._batch_dim}"
+                    )
+        # Batch sizes are counted in ROWS along batch_dim (an item may carry
+        # several), so dequeue_many's max matches the consumer's bucket
+        # contract even for multi-row compute() calls.
+        rows = int(np.asarray(leaves[0]).shape[self._batch_dim]) if leaves else 1
+        with self._not_full:
+            if self._closed:
+                raise ClosedBatchingQueue(
+                    "Enqueue to closed batching queue"
+                )
+            while len(self._deque) >= self._max_queue:
+                self._not_full.wait()
+                if self._closed:
+                    raise ClosedBatchingQueue(
+                        "Enqueue to closed batching queue"
+                    )
+            self._deque.append((inputs, payload, rows))
+            self._num_enqueued += 1
+            self._not_empty.notify()
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("Queue was closed already")
+            self._closed = True
+            leftover = len(self._deque)
+            self._deque.clear()
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            return leftover
+
+    def is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def dequeue_many(self) -> Tuple[Any, List[Any]]:
+        """Block for >= minimum_batch_size rows (or any rows after
+        timeout); return (batched nest, payloads). Up to
+        maximum_batch_size rows are concatenated; the first item is always
+        taken so an oversized single item can't deadlock the queue."""
+        with self._not_empty:
+            while True:
+                if sum(r for _, _, r in self._deque) >= self._min:
+                    break
+                if self._closed:
+                    raise StopIteration
+                timed_out = not self._not_empty.wait(timeout=self._timeout_s)
+                if timed_out and self._deque:
+                    break
+            items = [self._deque.popleft()]
+            rows = items[0][2]
+            while self._deque and rows + self._deque[0][2] <= self._max:
+                item = self._deque.popleft()
+                rows += item[2]
+                items.append(item)
+            self._not_full.notify_all()
+        inputs = [it[0] for it in items]
+        payloads = [it[1] for it in items]
+        return _concat_nests(inputs, self._batch_dim), payloads
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch, _ = self.dequeue_many()
+        except StopIteration:
+            raise StopIteration from None
+        return batch
+
+
+class _Promise:
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+
+class Batch:
+    """One pending inference batch: inputs + the promises awaiting rows."""
+
+    def __init__(self, batch_dim: int, inputs: Any, promises: List[_Promise],
+                 sizes: List[int]):
+        self._batch_dim = batch_dim
+        self._inputs = inputs
+        self._promises = promises
+        self._sizes = sizes
+        self._outputs_set = False
+
+    def __len__(self):
+        return sum(self._sizes)
+
+    def get_inputs(self) -> Any:
+        return self._inputs
+
+    def set_outputs(self, outputs: Any):
+        if self._outputs_set:
+            raise RuntimeError("set_outputs called twice")
+        leaves = nest.flatten(outputs)
+        if not leaves:
+            raise ValueError("Empty output")
+        expected = len(self)
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            if arr.ndim <= self._batch_dim:
+                raise ValueError(
+                    f"With batch_dim {self._batch_dim}, output shape "
+                    f"{arr.shape} has too few dims"
+                )
+            if arr.shape[self._batch_dim] != expected:
+                raise ValueError(
+                    f"Output shape {arr.shape} must have size {expected} "
+                    f"in batch_dim {self._batch_dim}"
+                )
+        self._outputs_set = True
+        offset = 0
+        for promise, size in zip(self._promises, self._sizes):
+            sl = [slice(None)] * (self._batch_dim + 1)
+            sl[self._batch_dim] = slice(offset, offset + size)
+            promise.value = nest.map(
+                lambda a: np.asarray(a)[tuple(sl)], outputs
+            )
+            promise.event.set()
+            offset += size
+
+    def fail(self, error: BaseException):
+        """Break every waiting promise with `error` (used by consumers
+        whose model call failed, so producers fail fast instead of
+        timing out)."""
+        if self._outputs_set:
+            return
+        self._outputs_set = True
+        for promise in self._promises:
+            promise.error = AsyncError(
+                f"Inference failed: {type(error).__name__}: {error}"
+            )
+            promise.event.set()
+
+    def __del__(self):
+        if not self._outputs_set:
+            for promise in self._promises:
+                promise.error = AsyncError(
+                    "Batch died before outputs were set"
+                )
+                promise.event.set()
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        batch_dim: int = 1,
+        minimum_batch_size: int = 1,
+        maximum_batch_size: Optional[int] = None,
+        timeout_ms: Optional[float] = None,
+        check_outputs: bool = True,
+    ):
+        self._batch_dim = batch_dim
+        self._queue = BatchingQueue(
+            batch_dim=batch_dim,
+            minimum_batch_size=minimum_batch_size,
+            maximum_batch_size=maximum_batch_size,
+            timeout_ms=timeout_ms,
+        )
+        self._check_outputs = check_outputs
+        self._compute_timeout_s = 600  # reference: 10-min future timeout
+
+    def size(self) -> int:
+        return self._queue.size()
+
+    def close(self):
+        """Close the intake and break every pending promise so blocked
+        compute() callers wake with AsyncError instead of hanging on the
+        10-minute timeout. Closing and draining happen atomically under
+        the queue lock — a concurrent compute() either enqueues before
+        (its promise is broken here) or raises ClosedBatchingQueue."""
+        q = self._queue
+        with q._lock:
+            if q._closed:
+                raise RuntimeError("Queue was closed already")
+            q._closed = True
+            pending = [payload for _, payload, _ in q._deque]
+            leftover = len(q._deque)
+            q._deque.clear()
+            q._not_empty.notify_all()
+            q._not_full.notify_all()
+        for promise, _ in pending:
+            promise.error = AsyncError("Batcher closed with pending requests")
+            promise.event.set()
+        return leftover
+
+    def is_closed(self) -> bool:
+        return self._queue.is_closed()
+
+    def compute(self, inputs: Any) -> Any:
+        """Blocking request/response: returns this caller's output rows."""
+        size = np.asarray(nest.front(inputs)).shape[self._batch_dim]
+        if size > self._queue._max:
+            raise ValueError(
+                f"compute() input has {size} rows along batch_dim, more "
+                f"than maximum_batch_size={self._queue._max}"
+            )
+        promise = _Promise()
+        self._queue.enqueue(inputs, (promise, size))
+        if not promise.event.wait(timeout=self._compute_timeout_s):
+            raise TimeoutError(
+                "Compute response not ready after 10 minutes"
+            )
+        if promise.error is not None:
+            raise promise.error
+        return promise.value
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Batch:
+        batch_inputs, payloads = self._queue.dequeue_many()
+        promises = [p for p, _ in payloads]
+        sizes = [s for _, s in payloads]
+        return Batch(self._batch_dim, batch_inputs, promises, sizes)

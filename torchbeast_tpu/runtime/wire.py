@@ -1,0 +1,215 @@
+"""Wire protocol: framed binary nest-of-arrays messages over sockets.
+
+Plays the role of the reference's proto2 `ArrayNest`/`Step`/`Action` messages
+over gRPC bidi streams (/root/reference/src/proto/rpcenv.proto:21-48,
+nest_serialize.h:22-69). This image has no C++ gRPC, so the transport is a
+deliberately simple length-prefixed framing that is trivial to implement
+identically in C++ (csrc/wire.h) and Python — no IDL, no codegen, zero-copy
+reads on the receiving side where possible.
+
+Frame:   [u32le payload_length][payload]
+Payload (recursive value encoding, little-endian):
+  0x01 ARRAY  : u8 dtype_code, u8 ndim, ndim * i64 shape, C-order raw bytes
+  0x02 LIST   : u32 count, then count values
+  0x03 DICT   : u32 count, then count * (u16 keylen, utf8 key, value)
+  0x04 NONE
+  0x05 INT    : i64
+  0x06 FLOAT  : f64
+  0x07 BOOL   : u8
+  0x08 STRING : u32 len, utf8 bytes
+
+Arrays are always serialized C-contiguous (the reference had a regression
+around non-contiguous numpy arrays, rpcenv.cc:166-170 /
+tests/contiguous_arrays_test.py — here np.ascontiguousarray normalizes on
+encode, and the property is pinned by tests/test_wire.py).
+"""
+
+import io
+import socket
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+TAG_ARRAY = 0x01
+TAG_LIST = 0x02
+TAG_DICT = 0x03
+TAG_NONE = 0x04
+TAG_INT = 0x05
+TAG_FLOAT = 0x06
+TAG_BOOL = 0x07
+TAG_STRING = 0x08
+
+# Stable dtype codes shared with the C++ implementation.
+_DTYPE_CODES = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.float32): 4,
+    np.dtype(np.float64): 5,
+    np.dtype(np.bool_): 6,
+    np.dtype(np.uint16): 7,
+    np.dtype(np.int16): 8,
+    np.dtype(np.uint32): 9,
+    np.dtype(np.uint64): 10,
+    np.dtype(np.float16): 11,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+class WireError(Exception):
+    pass
+
+
+def _encode_value(buf: io.BytesIO, value: Any) -> None:
+    if value is None:
+        buf.write(bytes([TAG_NONE]))
+    elif isinstance(value, bool) or isinstance(value, np.bool_):
+        buf.write(bytes([TAG_BOOL]))
+        buf.write(struct.pack("<B", 1 if value else 0))
+    elif isinstance(value, (int, np.integer)) and not isinstance(
+        value, np.ndarray
+    ):
+        buf.write(bytes([TAG_INT]))
+        buf.write(struct.pack("<q", int(value)))
+    elif isinstance(value, (float, np.floating)):
+        buf.write(bytes([TAG_FLOAT]))
+        buf.write(struct.pack("<d", float(value)))
+    elif isinstance(value, str):
+        raw = value.encode("utf-8")
+        buf.write(bytes([TAG_STRING]))
+        buf.write(struct.pack("<I", len(raw)))
+        buf.write(raw)
+    elif isinstance(value, np.ndarray):
+        # NB: np.ascontiguousarray promotes 0-d to 1-d, so only normalize
+        # when actually needed (0-d arrays are always contiguous).
+        arr = value
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            raise WireError(f"Unsupported array dtype {arr.dtype}")
+        buf.write(bytes([TAG_ARRAY]))
+        buf.write(struct.pack("<BB", code, arr.ndim))
+        buf.write(struct.pack(f"<{arr.ndim}q", *arr.shape))
+        buf.write(arr.tobytes())
+    elif isinstance(value, (list, tuple)):
+        buf.write(bytes([TAG_LIST]))
+        buf.write(struct.pack("<I", len(value)))
+        for v in value:
+            _encode_value(buf, v)
+    elif isinstance(value, dict):
+        buf.write(bytes([TAG_DICT]))
+        buf.write(struct.pack("<I", len(value)))
+        for k, v in value.items():
+            raw = str(k).encode("utf-8")
+            buf.write(struct.pack("<H", len(raw)))
+            buf.write(raw)
+            _encode_value(buf, v)
+    else:
+        raise WireError(f"Cannot serialize {type(value)!r}")
+
+
+def _decode_value(view: memoryview, offset: int):
+    tag = view[offset]
+    offset += 1
+    if tag == TAG_NONE:
+        return None, offset
+    if tag == TAG_BOOL:
+        return bool(view[offset]), offset + 1
+    if tag == TAG_INT:
+        (v,) = struct.unpack_from("<q", view, offset)
+        return v, offset + 8
+    if tag == TAG_FLOAT:
+        (v,) = struct.unpack_from("<d", view, offset)
+        return v, offset + 8
+    if tag == TAG_STRING:
+        (n,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        return bytes(view[offset : offset + n]).decode("utf-8"), offset + n
+    if tag == TAG_ARRAY:
+        code, ndim = struct.unpack_from("<BB", view, offset)
+        offset += 2
+        shape = struct.unpack_from(f"<{ndim}q", view, offset)
+        offset += 8 * ndim
+        dtype = _CODE_DTYPES.get(code)
+        if dtype is None:
+            raise WireError(f"Unknown dtype code {code}")
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        arr = np.frombuffer(
+            view[offset : offset + nbytes], dtype=dtype
+        ).reshape(shape)
+        return arr, offset + nbytes
+    if tag == TAG_LIST:
+        (n,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        out = []
+        for _ in range(n):
+            v, offset = _decode_value(view, offset)
+            out.append(v)
+        return out, offset
+    if tag == TAG_DICT:
+        (n,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        out = {}
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<H", view, offset)
+            offset += 2
+            key = bytes(view[offset : offset + klen]).decode("utf-8")
+            offset += klen
+            v, offset = _decode_value(view, offset)
+            out[key] = v
+        return out, offset
+    raise WireError(f"Unknown tag {tag:#x}")
+
+
+def encode(value: Any) -> bytes:
+    """Value -> framed message bytes (length prefix included)."""
+    buf = io.BytesIO()
+    _encode_value(buf, value)
+    payload = buf.getvalue()
+    return struct.pack("<I", len(payload)) + payload
+
+
+def decode(payload: bytes) -> Any:
+    """Payload bytes (no length prefix) -> value. Arrays are zero-copy
+    views into `payload` (read-only)."""
+    value, offset = _decode_value(memoryview(payload), 0)
+    if offset != len(payload):
+        raise WireError(
+            f"Trailing garbage: decoded {offset} of {len(payload)} bytes"
+        )
+    return value
+
+
+def send_message(sock: socket.socket, value: Any) -> None:
+    sock.sendall(encode(value))
+
+
+def recv_message(sock: socket.socket) -> Optional[Any]:
+    """Read one framed message; None on clean EOF at a frame boundary."""
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack("<I", header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        raise WireError("Connection closed mid-frame")
+    return decode(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes. None on clean EOF before any byte; WireError
+    on EOF mid-read."""
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(remaining)
+        if not chunk:
+            if remaining == n:
+                return None
+            raise WireError("Connection closed mid-frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
