@@ -1,0 +1,234 @@
+// ActorPool: pure-C++ actor loops — the reference's hottest native
+// component (N5, /root/reference/src/cc/actorpool.cc:342-564), re-designed
+// for the framed-socket transport.
+//
+// Each loop: connect to an env server, read the initial Step, then repeat
+// {inference via DynamicBatcher::compute -> send Action -> recv Step},
+// assembling unroll_length+1-step rollouts with the on-policy invariants
+// (overlap-by-one, agent-output pairing, agent-state carry; see
+// torchbeast_tpu/rollout.py for the invariant spec shared with the Python
+// implementation). No Python in the loop: the GIL is only touched by the
+// inference/learner threads that drain the queues from the Python side.
+
+#pragma once
+
+#include <atomic>
+#include <exception>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "client.h"
+#include "queues.h"
+#include "wire.h"
+
+namespace tbt {
+
+inline const std::vector<std::string>& env_keys() {
+  static const std::vector<std::string> keys = {
+      "frame",        "reward",       "done",
+      "episode_step", "episode_return", "last_action"};
+  return keys;
+}
+
+class ActorPool {
+ public:
+  using LearnerQueue = BatchingQueue<int>;  // payload unused
+
+  ActorPool(int64_t unroll_length, std::shared_ptr<LearnerQueue> learner_queue,
+            std::shared_ptr<DynamicBatcher> inference_batcher,
+            std::vector<std::string> addresses, ArrayNest initial_agent_state,
+            double connect_timeout_s = 600)
+      : unroll_length_(unroll_length),
+        learner_queue_(std::move(learner_queue)),
+        inference_batcher_(std::move(inference_batcher)),
+        addresses_(std::move(addresses)),
+        initial_agent_state_(std::move(initial_agent_state)),
+        connect_timeout_s_(connect_timeout_s) {}
+
+  int64_t count() const { return count_.load(); }
+
+  // Blocks until every loop exits; rethrows the first error.
+  void run() {
+    std::vector<std::thread> threads;
+    threads.reserve(addresses_.size());
+    for (const std::string& address : addresses_) {
+      threads.emplace_back([this, address] { guarded_loop(address); });
+    }
+    for (auto& t : threads) t.join();
+    std::lock_guard<std::mutex> lock(error_mu_);
+    if (first_error_) std::rethrow_exception(first_error_);
+  }
+
+  std::string first_error_message() const {
+    std::lock_guard<std::mutex> lock(error_mu_);
+    if (!first_error_) return "";
+    try {
+      std::rethrow_exception(first_error_);
+    } catch (const std::exception& e) {
+      return e.what();
+    } catch (...) {
+      return "unknown error";
+    }
+  }
+
+ private:
+  void guarded_loop(const std::string& address) {
+    try {
+      loop(address);
+    } catch (const ClosedBatchingQueue&) {
+      // clean shutdown
+    } catch (const QueueStopped&) {
+      // clean shutdown
+    } catch (const AsyncError&) {
+      // Clean ONLY when the pipeline is shutting down; a broken promise
+      // mid-training (inference failure) is a real error.
+      if (!inference_batcher_->is_closed() && !learner_queue_->is_closed()) {
+        std::lock_guard<std::mutex> lock(error_mu_);
+        if (!first_error_) first_error_ = std::current_exception();
+      }
+    } catch (...) {
+      std::lock_guard<std::mutex> lock(error_mu_);
+      if (!first_error_) first_error_ = std::current_exception();
+    }
+  }
+
+  // Step message -> env-output nest with [T=1, B=1] leading dims.
+  static ArrayNest env_outputs_from(const wire::ValueNest& msg) {
+    if (!msg.is_dict()) throw SocketError("expected dict Step message");
+    const auto& dict = msg.dict();
+    auto type_it = dict.find("type");
+    if (type_it != dict.end() && type_it->second.is_leaf() &&
+        type_it->second.leaf().kind == wire::Value::Kind::kString &&
+        type_it->second.leaf().s == "error") {
+      auto m = dict.find("message");
+      throw std::runtime_error(
+          "Env server error: " +
+          (m != dict.end() && m->second.is_leaf() ? m->second.leaf().s : ""));
+    }
+    ArrayNest::Dict out;
+    for (const std::string& key : env_keys()) {
+      auto it = dict.find(key);
+      if (it == dict.end() || !it->second.is_leaf() ||
+          it->second.leaf().kind != wire::Value::Kind::kArray)
+        throw SocketError("Step message missing array field: " + key);
+      const Array& a = it->second.leaf().array;
+      std::vector<int64_t> shape = {1, 1};
+      shape.insert(shape.end(), a.shape().begin(), a.shape().end());
+      // Clone: the wire buffer is reused per message; rollout storage
+      // must own its bytes.
+      Array expanded(a.dtype(), shape);
+      std::memcpy(expanded.mutable_data(), a.data(), a.nbytes());
+      out.emplace(key, ArrayNest(std::move(expanded)));
+    }
+    return ArrayNest(std::move(out));
+  }
+
+  struct StepPair {
+    ArrayNest env;
+    ArrayNest agent;
+  };
+
+  void loop(const std::string& address) {
+    FramedSocket sock;
+    sock.connect(address, connect_timeout_s_);
+
+    ArrayNest env_outputs = env_outputs_from(sock.recv());
+    ArrayNest agent_state = initial_agent_state_;
+
+    auto compute = [this](const ArrayNest& env, const ArrayNest& state) {
+      ArrayNest::Dict inputs;
+      inputs.emplace("agent_state", state);
+      inputs.emplace("env", env);
+      ArrayNest result = inference_batcher_->compute(ArrayNest(inputs));
+      const auto& d = result.dict();
+      return std::make_pair(d.at("outputs"), d.at("agent_state"));
+    };
+
+    // Prime the boundary agent output (state advance discarded — the first
+    // in-rollout compute re-consumes this env output for real).
+    auto [agent_outputs, discard] = compute(env_outputs, agent_state);
+    (void)discard;
+
+    std::vector<StepPair> rollout;
+    rollout.push_back({env_outputs, agent_outputs});
+    ArrayNest rollout_initial_state = agent_state;
+
+    while (true) {
+      auto [outputs, new_state] = compute(env_outputs, agent_state);
+      agent_outputs = outputs;
+      agent_state = new_state;
+
+      // Extract the scalar action from outputs["action"] ([1,1]).
+      const Array& action_arr =
+          agent_outputs.dict().at("action").front();
+      int64_t action = read_scalar_i64(action_arr);
+
+      wire::ValueNest::Dict action_msg;
+      action_msg.emplace("type",
+                         wire::ValueNest(wire::Value::of_string("action")));
+      action_msg.emplace("action",
+                         wire::ValueNest(wire::Value::of_int(action)));
+      sock.send(wire::ValueNest(std::move(action_msg)));
+
+      env_outputs = env_outputs_from(sock.recv());
+      count_.fetch_add(1);
+      rollout.push_back({env_outputs, agent_outputs});
+
+      if (static_cast<int64_t>(rollout.size()) == unroll_length_ + 1) {
+        enqueue_rollout(rollout, rollout_initial_state);
+        rollout.erase(rollout.begin(), rollout.end() - 1);  // overlap-by-one
+        rollout_initial_state = agent_state;
+      }
+    }
+  }
+
+  static int64_t read_scalar_i64(const Array& a) {
+    switch (a.dtype()) {
+      case DType::kI32:
+        return *reinterpret_cast<const int32_t*>(a.data());
+      case DType::kI64:
+        return *reinterpret_cast<const int64_t*>(a.data());
+      case DType::kU8:
+        return *a.data();
+      default:
+        throw std::invalid_argument("action must be integer typed");
+    }
+  }
+
+  void enqueue_rollout(const std::vector<StepPair>& rollout,
+                       const ArrayNest& initial_state) {
+    std::vector<ArrayNest> envs, agents;
+    envs.reserve(rollout.size());
+    agents.reserve(rollout.size());
+    for (const StepPair& p : rollout) {
+      envs.push_back(p.env);
+      agents.push_back(p.agent);
+    }
+    // Stack along time dim 0 -> [T+1, 1, ...].
+    ArrayNest env_stack = batch_nests(envs, 0);
+    ArrayNest agent_stack = batch_nests(agents, 0);
+
+    ArrayNest::Dict batch = env_stack.dict();
+    for (const auto& [k, v] : agent_stack.dict()) batch.emplace(k, v);
+
+    ArrayNest::Dict item;
+    item.emplace("batch", ArrayNest(std::move(batch)));
+    item.emplace("initial_agent_state", initial_state);
+    learner_queue_->enqueue(ArrayNest(std::move(item)), 0);
+  }
+
+  const int64_t unroll_length_;
+  std::shared_ptr<LearnerQueue> learner_queue_;
+  std::shared_ptr<DynamicBatcher> inference_batcher_;
+  const std::vector<std::string> addresses_;
+  const ArrayNest initial_agent_state_;
+  const double connect_timeout_s_;
+
+  std::atomic<int64_t> count_{0};
+  mutable std::mutex error_mu_;
+  std::exception_ptr first_error_;
+};
+
+}  // namespace tbt

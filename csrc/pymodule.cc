@@ -1,0 +1,693 @@
+// _tbt_core: CPython bindings for the native runtime (reference components
+// N2/N9, /root/reference/nest/nest/nest_pybind.cc + src/cc/libtorchbeast.cc
+// — written against the raw CPython/numpy C API since pybind11 is not in
+// this image).
+//
+// Exposes BatchingQueue, DynamicBatcher (+Batch), ActorPool. Conversions:
+//   python -> C++: dict/list/tuple -> Nest, numpy array -> Array wrapping
+//     the numpy buffer zero-copy (a shared_ptr owner decrefs under the GIL)
+//   C++ -> python: Array -> numpy array wrapping the C++ buffer zero-copy
+//     (a capsule owner keeps the shared_ptr alive)
+// All blocking calls release the GIL, so C++ actor threads and Python
+// inference/learner threads interleave freely.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "actor_pool.h"
+#include "queues.h"
+
+namespace {
+
+using tbt::Array;
+using tbt::ArrayNest;
+using tbt::DType;
+
+PyObject* ClosedBatchingQueueError;
+PyObject* AsyncErrorError;
+
+// ---------------------------------------------------------------- dtypes
+int dtype_to_npy(DType d) {
+  switch (d) {
+    case DType::kU8: return NPY_UINT8;
+    case DType::kI8: return NPY_INT8;
+    case DType::kI32: return NPY_INT32;
+    case DType::kI64: return NPY_INT64;
+    case DType::kF32: return NPY_FLOAT32;
+    case DType::kF64: return NPY_FLOAT64;
+    case DType::kBool: return NPY_BOOL;
+    case DType::kU16: return NPY_UINT16;
+    case DType::kI16: return NPY_INT16;
+    case DType::kU32: return NPY_UINT32;
+    case DType::kU64: return NPY_UINT64;
+    case DType::kF16: return NPY_FLOAT16;
+  }
+  return -1;
+}
+
+bool npy_to_dtype(int npy, DType* out) {
+  switch (npy) {
+    case NPY_UINT8: *out = DType::kU8; return true;
+    case NPY_INT8: *out = DType::kI8; return true;
+    case NPY_INT32: *out = DType::kI32; return true;
+    case NPY_INT64: *out = DType::kI64; return true;
+    case NPY_FLOAT32: *out = DType::kF32; return true;
+    case NPY_FLOAT64: *out = DType::kF64; return true;
+    case NPY_BOOL: *out = DType::kBool; return true;
+    case NPY_UINT16: *out = DType::kU16; return true;
+    case NPY_INT16: *out = DType::kI16; return true;
+    case NPY_UINT32: *out = DType::kU32; return true;
+    case NPY_UINT64: *out = DType::kU64; return true;
+    case NPY_FLOAT16: *out = DType::kF16; return true;
+    default: return false;
+  }
+}
+
+// ------------------------------------------------- python -> C++ nest
+// Decref-under-GIL owner for buffers borrowed from numpy.
+std::shared_ptr<void> py_owner(PyObject* obj) {
+  Py_INCREF(obj);
+  return std::shared_ptr<void>(obj, [](void* p) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_DECREF(static_cast<PyObject*>(p));
+    PyGILState_Release(gil);
+  });
+}
+
+bool nest_from_py(PyObject* obj, ArrayNest* out) {
+  if (PyDict_Check(obj)) {
+    ArrayNest::Dict dict;
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(obj, &pos, &key, &value)) {
+      if (!PyUnicode_Check(key)) {
+        PyErr_SetString(PyExc_TypeError, "nest dict keys must be str");
+        return false;
+      }
+      ArrayNest sub;
+      if (!nest_from_py(value, &sub)) return false;
+      dict.emplace(PyUnicode_AsUTF8(key), std::move(sub));
+    }
+    *out = ArrayNest(std::move(dict));
+    return true;
+  }
+  if (PyList_Check(obj) || PyTuple_Check(obj)) {
+    PyObject* seq = PySequence_Fast(obj, "expected sequence");
+    if (!seq) return false;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    ArrayNest::List list;
+    list.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      ArrayNest sub;
+      if (!nest_from_py(PySequence_Fast_GET_ITEM(seq, i), &sub)) {
+        Py_DECREF(seq);
+        return false;
+      }
+      list.push_back(std::move(sub));
+    }
+    Py_DECREF(seq);
+    *out = ArrayNest(std::move(list));
+    return true;
+  }
+  // Leaf: coerce to a C-contiguous numpy array, zero-copy when possible.
+  PyArrayObject* arr = reinterpret_cast<PyArrayObject*>(
+      PyArray_FROM_OF(obj, NPY_ARRAY_C_CONTIGUOUS | NPY_ARRAY_ALIGNED));
+  if (!arr) return false;
+  DType dtype;
+  if (!npy_to_dtype(PyArray_TYPE(arr), &dtype)) {
+    PyErr_Format(PyExc_TypeError, "unsupported array dtype %d",
+                 PyArray_TYPE(arr));
+    Py_DECREF(arr);
+    return false;
+  }
+  std::vector<int64_t> shape(PyArray_NDIM(arr));
+  for (int i = 0; i < PyArray_NDIM(arr); ++i) shape[i] = PyArray_DIM(arr, i);
+  *out = ArrayNest(Array(dtype, std::move(shape), PyArray_DATA(arr),
+                         py_owner(reinterpret_cast<PyObject*>(arr))));
+  Py_DECREF(arr);
+  return true;
+}
+
+// ------------------------------------------------- C++ -> python nest
+PyObject* array_to_py(const Array& a) {
+  std::vector<npy_intp> dims(a.shape().begin(), a.shape().end());
+  // The capsule keeps a heap-allocated Array (sharing the buffer) alive.
+  Array* keeper = new Array(a);
+  PyObject* capsule = PyCapsule_New(
+      keeper, nullptr,
+      [](PyObject* cap) {
+        delete static_cast<Array*>(PyCapsule_GetPointer(cap, nullptr));
+      });
+  if (!capsule) {
+    delete keeper;
+    return nullptr;
+  }
+  PyObject* arr = PyArray_SimpleNewFromData(
+      static_cast<int>(dims.size()), dims.data(), dtype_to_npy(a.dtype()),
+      const_cast<uint8_t*>(keeper->data()));
+  if (!arr) {
+    Py_DECREF(capsule);
+    return nullptr;
+  }
+  if (PyArray_SetBaseObject(reinterpret_cast<PyArrayObject*>(arr), capsule) <
+      0) {
+    Py_DECREF(arr);
+    return nullptr;
+  }
+  return arr;
+}
+
+PyObject* nest_to_py(const ArrayNest& nest) {
+  if (nest.is_leaf()) return array_to_py(nest.leaf());
+  if (nest.is_list()) {
+    PyObject* tuple = PyTuple_New(nest.list().size());
+    if (!tuple) return nullptr;
+    for (size_t i = 0; i < nest.list().size(); ++i) {
+      PyObject* item = nest_to_py(nest.list()[i]);
+      if (!item) {
+        Py_DECREF(tuple);
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(tuple, i, item);
+    }
+    return tuple;
+  }
+  PyObject* dict = PyDict_New();
+  if (!dict) return nullptr;
+  for (const auto& [key, sub] : nest.dict()) {
+    PyObject* item = nest_to_py(sub);
+    if (!item || PyDict_SetItemString(dict, key.c_str(), item) < 0) {
+      Py_XDECREF(item);
+      Py_DECREF(dict);
+      return nullptr;
+    }
+    Py_DECREF(item);
+  }
+  return dict;
+}
+
+void set_py_error();
+
+// Run fn with the GIL released, catching C++ exceptions INSIDE the no-GIL
+// region (an exception unwinding past Py_END_ALLOW_THREADS would skip the
+// GIL re-acquire and corrupt the interpreter). Returns false with the
+// Python error set on failure.
+template <typename F>
+bool call_nogil(F&& fn) {
+  std::exception_ptr err;
+  Py_BEGIN_ALLOW_THREADS
+  try {
+    fn();
+  } catch (...) {
+    err = std::current_exception();
+  }
+  Py_END_ALLOW_THREADS
+  if (err) {
+    try {
+      std::rethrow_exception(err);
+    } catch (...) {
+      set_py_error();
+    }
+    return false;
+  }
+  return true;
+}
+
+// Translate in-flight C++ exceptions to Python exceptions.
+void set_py_error() {
+  try {
+    throw;
+  } catch (const tbt::ClosedBatchingQueue& e) {
+    PyErr_SetString(ClosedBatchingQueueError, e.what());
+  } catch (const tbt::QueueStopped&) {
+    PyErr_SetNone(PyExc_StopIteration);
+  } catch (const tbt::AsyncError& e) {
+    PyErr_SetString(AsyncErrorError, e.what());
+  } catch (const std::invalid_argument& e) {
+    PyErr_SetString(PyExc_ValueError, e.what());
+  } catch (const std::out_of_range& e) {
+    PyErr_SetString(PyExc_IndexError, e.what());
+  } catch (const std::exception& e) {
+    PyErr_SetString(PyExc_RuntimeError, e.what());
+  } catch (...) {
+    PyErr_SetString(PyExc_RuntimeError, "unknown C++ exception");
+  }
+}
+
+// ---------------------------------------------------------------- Queue
+using LearnerQueue = tbt::ActorPool::LearnerQueue;
+
+struct PyBatchingQueue {
+  PyObject_HEAD
+  std::shared_ptr<LearnerQueue> queue;
+};
+
+struct PyDynamicBatcher {
+  PyObject_HEAD
+  std::shared_ptr<tbt::DynamicBatcher> batcher;
+};
+
+struct PyBatch {
+  PyObject_HEAD
+  std::unique_ptr<tbt::DynamicBatcher::Batch> batch;
+};
+
+struct PyActorPool {
+  PyObject_HEAD
+  std::shared_ptr<tbt::ActorPool> pool;
+};
+
+extern PyTypeObject PyBatchType;
+
+// --- BatchingQueue
+int queue_init(PyBatchingQueue* self, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {"batch_dim",      "minimum_batch_size",
+                                 "maximum_batch_size", "timeout_ms",
+                                 "maximum_queue_size", "check_inputs",
+                                 nullptr};
+  long long batch_dim = 0, min_bs = 1;
+  PyObject *max_bs_obj = Py_None, *timeout_obj = Py_None,
+           *max_queue_obj = Py_None;
+  int check_inputs = 1;
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "|LLOOOp", const_cast<char**>(kwlist), &batch_dim,
+          &min_bs, &max_bs_obj, &timeout_obj, &max_queue_obj, &check_inputs))
+    return -1;
+  try {
+    int64_t max_bs = max_bs_obj == Py_None
+                         ? std::numeric_limits<int64_t>::max()
+                         : PyLong_AsLongLong(max_bs_obj);
+    std::optional<int64_t> timeout_ms, max_queue;
+    if (timeout_obj != Py_None)
+      timeout_ms = static_cast<int64_t>(PyFloat_AsDouble(timeout_obj));
+    if (max_queue_obj != Py_None)
+      max_queue = PyLong_AsLongLong(max_queue_obj);
+    if (PyErr_Occurred()) return -1;
+    self->queue = std::make_shared<LearnerQueue>(
+        batch_dim, min_bs, max_bs, timeout_ms, max_queue, check_inputs != 0);
+    return 0;
+  } catch (...) {
+    set_py_error();
+    return -1;
+  }
+}
+
+PyObject* queue_enqueue(PyBatchingQueue* self, PyObject* arg) {
+  ArrayNest nest;
+  if (!nest_from_py(arg, &nest)) return nullptr;
+  auto queue = self->queue;
+  if (!call_nogil([&] { queue->enqueue(std::move(nest), 0); }))
+    return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject* queue_dequeue_many(PyBatchingQueue* self, PyObject*) {
+  std::pair<ArrayNest, std::vector<int>> result;
+  auto queue = self->queue;
+  if (!call_nogil([&] { result = queue->dequeue_many(); })) return nullptr;
+  PyObject* nest = nest_to_py(result.first);
+  if (!nest) return nullptr;
+  return Py_BuildValue("(Nn)", nest,
+                       static_cast<Py_ssize_t>(result.second.size()));
+}
+
+PyObject* queue_close(PyBatchingQueue* self, PyObject*) {
+  try {
+    self->queue->close();
+  } catch (...) {
+    set_py_error();
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* queue_size(PyBatchingQueue* self, PyObject*) {
+  return PyLong_FromLongLong(self->queue->size());
+}
+
+PyObject* queue_is_closed(PyBatchingQueue* self, PyObject*) {
+  return PyBool_FromLong(self->queue->is_closed());
+}
+
+PyObject* queue_iter(PyObject* self) {
+  Py_INCREF(self);
+  return self;
+}
+
+PyObject* queue_iternext(PyBatchingQueue* self) {
+  std::pair<ArrayNest, std::vector<int>> result;
+  auto queue = self->queue;
+  if (!call_nogil([&] { result = queue->dequeue_many(); })) return nullptr;
+  return nest_to_py(result.first);
+}
+
+void queue_dealloc(PyBatchingQueue* self) {
+  self->queue.~shared_ptr();
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* queue_new(PyTypeObject* type, PyObject*, PyObject*) {
+  PyBatchingQueue* self =
+      reinterpret_cast<PyBatchingQueue*>(type->tp_alloc(type, 0));
+  if (self) new (&self->queue) std::shared_ptr<LearnerQueue>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+PyMethodDef queue_methods[] = {
+    {"enqueue", reinterpret_cast<PyCFunction>(queue_enqueue), METH_O, nullptr},
+    {"dequeue_many", reinterpret_cast<PyCFunction>(queue_dequeue_many),
+     METH_NOARGS, nullptr},
+    {"close", reinterpret_cast<PyCFunction>(queue_close), METH_NOARGS,
+     nullptr},
+    {"size", reinterpret_cast<PyCFunction>(queue_size), METH_NOARGS, nullptr},
+    {"is_closed", reinterpret_cast<PyCFunction>(queue_is_closed), METH_NOARGS,
+     nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject PyBatchingQueueType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// --- Batch
+PyObject* batch_get_inputs(PyBatch* self, PyObject*) {
+  if (!self->batch) {
+    PyErr_SetString(PyExc_RuntimeError, "Batch already consumed");
+    return nullptr;
+  }
+  return nest_to_py(self->batch->inputs());
+}
+
+PyObject* batch_set_outputs(PyBatch* self, PyObject* arg) {
+  if (!self->batch) {
+    PyErr_SetString(PyExc_RuntimeError, "Batch already consumed");
+    return nullptr;
+  }
+  ArrayNest nest;
+  if (!nest_from_py(arg, &nest)) return nullptr;
+  try {
+    // Deep-copy outputs: promises may outlive the numpy arrays.
+    ArrayNest owned = nest.map([](const Array& a) { return a.clone(); });
+    self->batch->set_outputs(owned);
+  } catch (...) {
+    set_py_error();
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* batch_fail(PyBatch* self, PyObject* arg) {
+  if (!self->batch) Py_RETURN_NONE;
+  const char* message = PyUnicode_Check(arg) ? PyUnicode_AsUTF8(arg)
+                                             : "inference failed";
+  self->batch->fail(message ? message : "inference failed");
+  Py_RETURN_NONE;
+}
+
+Py_ssize_t batch_len(PyBatch* self) {
+  return self->batch ? static_cast<Py_ssize_t>(self->batch->size()) : 0;
+}
+
+void batch_dealloc(PyBatch* self) {
+  self->batch.~unique_ptr();
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyMethodDef batch_methods[] = {
+    {"get_inputs", reinterpret_cast<PyCFunction>(batch_get_inputs),
+     METH_NOARGS, nullptr},
+    {"set_outputs", reinterpret_cast<PyCFunction>(batch_set_outputs), METH_O,
+     nullptr},
+    {"fail", reinterpret_cast<PyCFunction>(batch_fail), METH_O, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PySequenceMethods batch_as_sequence = {
+    reinterpret_cast<lenfunc>(batch_len),  // sq_length
+};
+
+PyTypeObject PyBatchType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// --- DynamicBatcher
+int batcher_init(PyDynamicBatcher* self, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {"batch_dim", "minimum_batch_size",
+                                 "maximum_batch_size", "timeout_ms", nullptr};
+  long long batch_dim = 1, min_bs = 1;
+  PyObject *max_bs_obj = Py_None, *timeout_obj = Py_None;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|LLOO",
+                                   const_cast<char**>(kwlist), &batch_dim,
+                                   &min_bs, &max_bs_obj, &timeout_obj))
+    return -1;
+  try {
+    int64_t max_bs = max_bs_obj == Py_None
+                         ? std::numeric_limits<int64_t>::max()
+                         : PyLong_AsLongLong(max_bs_obj);
+    std::optional<int64_t> timeout_ms;
+    if (timeout_obj != Py_None)
+      timeout_ms = static_cast<int64_t>(PyFloat_AsDouble(timeout_obj));
+    if (PyErr_Occurred()) return -1;
+    self->batcher = std::make_shared<tbt::DynamicBatcher>(
+        batch_dim, min_bs, max_bs, timeout_ms);
+    return 0;
+  } catch (...) {
+    set_py_error();
+    return -1;
+  }
+}
+
+PyObject* batcher_compute(PyDynamicBatcher* self, PyObject* arg) {
+  ArrayNest nest;
+  if (!nest_from_py(arg, &nest)) return nullptr;
+  ArrayNest result;
+  auto batcher = self->batcher;
+  if (!call_nogil([&] { result = batcher->compute(std::move(nest)); }))
+    return nullptr;
+  return nest_to_py(result);
+}
+
+PyObject* batcher_iternext(PyDynamicBatcher* self) {
+  std::unique_ptr<tbt::DynamicBatcher::Batch> batch;
+  auto batcher = self->batcher;
+  if (!call_nogil([&] { batch = batcher->get_batch(); })) return nullptr;
+  PyBatch* out =
+      reinterpret_cast<PyBatch*>(PyBatchType.tp_alloc(&PyBatchType, 0));
+  if (!out) return nullptr;
+  new (&out->batch)
+      std::unique_ptr<tbt::DynamicBatcher::Batch>(std::move(batch));
+  return reinterpret_cast<PyObject*>(out);
+}
+
+PyObject* batcher_close(PyDynamicBatcher* self, PyObject*) {
+  try {
+    self->batcher->close();
+  } catch (...) {
+    set_py_error();
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* batcher_size(PyDynamicBatcher* self, PyObject*) {
+  return PyLong_FromLongLong(self->batcher->size());
+}
+
+PyObject* batcher_is_closed(PyDynamicBatcher* self, PyObject*) {
+  return PyBool_FromLong(self->batcher->is_closed());
+}
+
+void batcher_dealloc(PyDynamicBatcher* self) {
+  self->batcher.~shared_ptr();
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* batcher_new(PyTypeObject* type, PyObject*, PyObject*) {
+  PyDynamicBatcher* self =
+      reinterpret_cast<PyDynamicBatcher*>(type->tp_alloc(type, 0));
+  if (self) new (&self->batcher) std::shared_ptr<tbt::DynamicBatcher>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+PyMethodDef batcher_methods[] = {
+    {"compute", reinterpret_cast<PyCFunction>(batcher_compute), METH_O,
+     nullptr},
+    {"close", reinterpret_cast<PyCFunction>(batcher_close), METH_NOARGS,
+     nullptr},
+    {"size", reinterpret_cast<PyCFunction>(batcher_size), METH_NOARGS,
+     nullptr},
+    {"is_closed", reinterpret_cast<PyCFunction>(batcher_is_closed),
+     METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject PyDynamicBatcherType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// --- ActorPool
+int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {
+      "unroll_length",     "learner_queue", "inference_batcher",
+      "env_server_addresses", "initial_agent_state", "connect_timeout_s",
+      nullptr};
+  long long unroll_length = 0;
+  PyObject *queue_obj, *batcher_obj, *addresses_obj, *state_obj;
+  double connect_timeout_s = 600;
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "LO!O!OO|d", const_cast<char**>(kwlist),
+          &unroll_length, &PyBatchingQueueType, &queue_obj,
+          &PyDynamicBatcherType, &batcher_obj, &addresses_obj, &state_obj,
+          &connect_timeout_s))
+    return -1;
+  std::vector<std::string> addresses;
+  PyObject* seq = PySequence_Fast(addresses_obj, "addresses must be a sequence");
+  if (!seq) return -1;
+  for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); ++i) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyUnicode_Check(item)) {
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_TypeError, "addresses must be strings");
+      return -1;
+    }
+    addresses.push_back(PyUnicode_AsUTF8(item));
+  }
+  Py_DECREF(seq);
+  ArrayNest state;
+  if (!nest_from_py(state_obj, &state)) return -1;
+  try {
+    // Deep-copy the state: actor threads use it GIL-free.
+    ArrayNest owned = state.map([](const Array& a) { return a.clone(); });
+    self->pool = std::make_shared<tbt::ActorPool>(
+        unroll_length,
+        reinterpret_cast<PyBatchingQueue*>(queue_obj)->queue,
+        reinterpret_cast<PyDynamicBatcher*>(batcher_obj)->batcher,
+        std::move(addresses), std::move(owned), connect_timeout_s);
+    return 0;
+  } catch (...) {
+    set_py_error();
+    return -1;
+  }
+}
+
+PyObject* pool_run(PyActorPool* self, PyObject*) {
+  auto pool = self->pool;
+  if (!call_nogil([&] { pool->run(); })) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject* pool_count(PyActorPool* self, PyObject*) {
+  return PyLong_FromLongLong(self->pool->count());
+}
+
+PyObject* pool_first_error_message(PyActorPool* self, PyObject*) {
+  std::string msg = self->pool->first_error_message();
+  if (msg.empty()) Py_RETURN_NONE;
+  return PyUnicode_FromString(msg.c_str());
+}
+
+void pool_dealloc(PyActorPool* self) {
+  self->pool.~shared_ptr();
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* pool_new(PyTypeObject* type, PyObject*, PyObject*) {
+  PyActorPool* self = reinterpret_cast<PyActorPool*>(type->tp_alloc(type, 0));
+  if (self) new (&self->pool) std::shared_ptr<tbt::ActorPool>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+PyMethodDef pool_methods[] = {
+    {"run", reinterpret_cast<PyCFunction>(pool_run), METH_NOARGS, nullptr},
+    {"count", reinterpret_cast<PyCFunction>(pool_count), METH_NOARGS,
+     nullptr},
+    {"first_error_message",
+     reinterpret_cast<PyCFunction>(pool_first_error_message), METH_NOARGS,
+     nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject PyActorPoolType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// ---------------------------------------------------------------- module
+PyModuleDef module_def = {
+    PyModuleDef_HEAD_INIT, "_tbt_core",
+    "Native runtime core (queues, dynamic batcher, actor pool)", -1, nullptr,
+};
+
+void init_type(PyTypeObject* type, const char* name, size_t basicsize,
+               newfunc tp_new, initproc tp_init, destructor tp_dealloc,
+               PyMethodDef* methods, getiterfunc tp_iter,
+               iternextfunc tp_iternext, PySequenceMethods* as_seq) {
+  type->tp_name = name;
+  type->tp_basicsize = static_cast<Py_ssize_t>(basicsize);
+  type->tp_flags = Py_TPFLAGS_DEFAULT;
+  type->tp_new = tp_new;
+  type->tp_init = tp_init;
+  type->tp_dealloc = tp_dealloc;
+  type->tp_methods = methods;
+  type->tp_iter = tp_iter;
+  type->tp_iternext = tp_iternext;
+  type->tp_as_sequence = as_seq;
+}
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__tbt_core(void) {
+  import_array();
+
+  init_type(&PyBatchingQueueType, "_tbt_core.BatchingQueue",
+            sizeof(PyBatchingQueue), queue_new,
+            reinterpret_cast<initproc>(queue_init),
+            reinterpret_cast<destructor>(queue_dealloc), queue_methods,
+            queue_iter, reinterpret_cast<iternextfunc>(queue_iternext),
+            nullptr);
+  init_type(&PyBatchType, "_tbt_core.Batch", sizeof(PyBatch), nullptr,
+            nullptr, reinterpret_cast<destructor>(batch_dealloc),
+            batch_methods, nullptr, nullptr, &batch_as_sequence);
+  init_type(&PyDynamicBatcherType, "_tbt_core.DynamicBatcher",
+            sizeof(PyDynamicBatcher), batcher_new,
+            reinterpret_cast<initproc>(batcher_init),
+            reinterpret_cast<destructor>(batcher_dealloc), batcher_methods,
+            queue_iter, reinterpret_cast<iternextfunc>(batcher_iternext),
+            nullptr);
+  init_type(&PyActorPoolType, "_tbt_core.ActorPool", sizeof(PyActorPool),
+            pool_new, reinterpret_cast<initproc>(pool_init),
+            reinterpret_cast<destructor>(pool_dealloc), pool_methods, nullptr,
+            nullptr, nullptr);
+
+  if (PyType_Ready(&PyBatchingQueueType) < 0 ||
+      PyType_Ready(&PyBatchType) < 0 ||
+      PyType_Ready(&PyDynamicBatcherType) < 0 ||
+      PyType_Ready(&PyActorPoolType) < 0)
+    return nullptr;
+
+  PyObject* module = PyModule_Create(&module_def);
+  if (!module) return nullptr;
+
+  ClosedBatchingQueueError = PyErr_NewException(
+      "_tbt_core.ClosedBatchingQueue", PyExc_RuntimeError, nullptr);
+  AsyncErrorError =
+      PyErr_NewException("_tbt_core.AsyncError", PyExc_RuntimeError, nullptr);
+
+  Py_INCREF(&PyBatchingQueueType);
+  Py_INCREF(&PyBatchType);
+  Py_INCREF(&PyDynamicBatcherType);
+  Py_INCREF(&PyActorPoolType);
+  PyModule_AddObject(module, "BatchingQueue",
+                     reinterpret_cast<PyObject*>(&PyBatchingQueueType));
+  PyModule_AddObject(module, "Batch",
+                     reinterpret_cast<PyObject*>(&PyBatchType));
+  PyModule_AddObject(module, "DynamicBatcher",
+                     reinterpret_cast<PyObject*>(&PyDynamicBatcherType));
+  PyModule_AddObject(module, "ActorPool",
+                     reinterpret_cast<PyObject*>(&PyActorPoolType));
+  PyModule_AddObject(module, "ClosedBatchingQueue", ClosedBatchingQueueError);
+  PyModule_AddObject(module, "AsyncError", AsyncErrorError);
+  return module;
+}

@@ -1,0 +1,294 @@
+// BatchingQueue + DynamicBatcher: the native learner-queue and inference
+// batcher (reference components N3/N4, /root/reference/src/cc/actorpool.cc
+// 57-340 — re-designed torch-free over tbt::Array nests; semantics match
+// the Python implementations in torchbeast_tpu/runtime/queues.py, which
+// carry the test surface).
+
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <future>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <stdexcept>
+#include <vector>
+
+#include "array.h"
+#include "nest.h"
+
+namespace tbt {
+
+using ArrayNest = Nest<Array>;
+
+class ClosedBatchingQueue : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+class QueueStopped : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+class AsyncError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+// Concatenate structurally-equal nests leaf-wise along batch_dim.
+inline ArrayNest batch_nests(const std::vector<ArrayNest>& nests,
+                             int64_t batch_dim) {
+  return Nest<Array>::zip(nests).map(
+      [batch_dim](const std::vector<Array>& leaves) {
+        return concatenate(leaves, batch_dim);
+      });
+}
+
+template <typename Payload>
+class BatchingQueue {
+ public:
+  struct Item {
+    ArrayNest inputs;
+    Payload payload;
+    int64_t rows;
+  };
+
+  BatchingQueue(int64_t batch_dim, int64_t min_batch_size,
+                int64_t max_batch_size, std::optional<int64_t> timeout_ms,
+                std::optional<int64_t> max_queue_size, bool check_inputs)
+      : batch_dim_(batch_dim),
+        min_(min_batch_size),
+        max_(max_batch_size),
+        timeout_ms_(timeout_ms),
+        max_queue_(max_queue_size),
+        check_inputs_(check_inputs) {
+    if (min_ < 1) throw std::invalid_argument("Min batch size must be >= 1");
+    if (max_ < min_)
+      throw std::invalid_argument("Max batch size must be >= min batch size");
+    if (max_queue_ && *max_queue_ < 1)
+      throw std::invalid_argument("Max queue size must be >= 1");
+  }
+
+  int64_t size() const {
+    std::unique_lock<std::mutex> lock(mu_);
+    return static_cast<int64_t>(deque_.size());
+  }
+
+  bool is_closed() const {
+    std::unique_lock<std::mutex> lock(mu_);
+    return closed_;
+  }
+
+  void enqueue(ArrayNest inputs, Payload payload) {
+    int64_t rows = 1;
+    if (check_inputs_) {
+      bool any = false;
+      inputs.for_each([&](const Array& a) {
+        if (a.ndim() <= batch_dim_)
+          throw std::invalid_argument(
+              "Enqueued array has too few dims for batch_dim");
+        any = true;
+      });
+      if (!any)
+        throw std::invalid_argument("Cannot enqueue empty vector of arrays");
+    }
+    if (!inputs.empty()) rows = inputs.front().dim(batch_dim_);
+
+    std::unique_lock<std::mutex> lock(mu_);
+    if (closed_) throw ClosedBatchingQueue("Enqueue to closed batching queue");
+    while (max_queue_ && static_cast<int64_t>(deque_.size()) >= *max_queue_) {
+      can_enqueue_.wait(lock);
+      if (closed_)
+        throw ClosedBatchingQueue("Enqueue to closed batching queue");
+    }
+    deque_.push_back(Item{std::move(inputs), std::move(payload), rows});
+    ++num_enqueued_;
+    can_dequeue_.notify_one();
+  }
+
+  // Blocks for >= min rows (or any after timeout). Throws QueueStopped when
+  // closed and drained.
+  std::pair<ArrayNest, std::vector<Payload>> dequeue_many() {
+    std::vector<Item> items;
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      while (true) {
+        int64_t rows = 0;
+        for (const Item& it : deque_) rows += it.rows;
+        if (rows >= min_) break;
+        if (closed_) throw QueueStopped("queue closed");
+        if (timeout_ms_) {
+          bool timed_out = can_dequeue_.wait_for(
+                               lock, std::chrono::milliseconds(*timeout_ms_)) ==
+                           std::cv_status::timeout;
+          if (timed_out && !deque_.empty()) break;
+        } else {
+          can_dequeue_.wait(lock);
+        }
+      }
+      items.push_back(std::move(deque_.front()));
+      deque_.pop_front();
+      int64_t rows = items.front().rows;
+      while (!deque_.empty() && rows + deque_.front().rows <= max_) {
+        rows += deque_.front().rows;
+        items.push_back(std::move(deque_.front()));
+        deque_.pop_front();
+      }
+      can_enqueue_.notify_all();
+    }
+    std::vector<ArrayNest> inputs;
+    std::vector<Payload> payloads;
+    inputs.reserve(items.size());
+    payloads.reserve(items.size());
+    for (Item& it : items) {
+      inputs.push_back(std::move(it.inputs));
+      payloads.push_back(std::move(it.payload));
+    }
+    return {batch_nests(inputs, batch_dim_), std::move(payloads)};
+  }
+
+  // Returns leftover items; their payloads, so callers can fail promises.
+  std::vector<Payload> close() {
+    std::unique_lock<std::mutex> lock(mu_);
+    if (closed_) throw std::runtime_error("Queue was closed already");
+    closed_ = true;
+    std::vector<Payload> leftover;
+    for (Item& it : deque_) leftover.push_back(std::move(it.payload));
+    deque_.clear();
+    can_dequeue_.notify_all();
+    can_enqueue_.notify_all();
+    return leftover;
+  }
+
+  int64_t batch_dim() const { return batch_dim_; }
+  int64_t max_batch_size() const { return max_; }
+
+ private:
+  const int64_t batch_dim_, min_, max_;
+  const std::optional<int64_t> timeout_ms_, max_queue_;
+  const bool check_inputs_;
+
+  mutable std::mutex mu_;
+  std::condition_variable can_dequeue_, can_enqueue_;
+  std::deque<Item> deque_;
+  bool closed_ = false;
+  int64_t num_enqueued_ = 0;
+};
+
+class DynamicBatcher {
+ public:
+  struct Request {
+    std::shared_ptr<std::promise<ArrayNest>> promise;
+    int64_t rows;
+  };
+
+  class Batch {
+   public:
+    Batch(int64_t batch_dim, ArrayNest inputs, std::vector<Request> requests)
+        : batch_dim_(batch_dim),
+          inputs_(std::move(inputs)),
+          requests_(std::move(requests)) {}
+
+    ~Batch() {
+      if (!outputs_set_) {
+        for (Request& r : requests_) {
+          r.promise->set_exception(std::make_exception_ptr(
+              AsyncError("Batch died before outputs were set")));
+        }
+      }
+    }
+
+    int64_t size() const {
+      int64_t n = 0;
+      for (const Request& r : requests_) n += r.rows;
+      return n;
+    }
+
+    const ArrayNest& inputs() const { return inputs_; }
+
+    void set_outputs(const ArrayNest& outputs) {
+      if (outputs_set_) throw std::runtime_error("set_outputs called twice");
+      int64_t expected = size();
+      bool any = false;
+      outputs.for_each([&](const Array& a) {
+        if (a.ndim() <= batch_dim_)
+          throw std::invalid_argument("output has too few dims");
+        if (a.dim(batch_dim_) != expected)
+          throw std::invalid_argument("output batch size mismatch");
+        any = true;
+      });
+      if (!any) throw std::invalid_argument("empty output");
+      outputs_set_ = true;
+      int64_t offset = 0;
+      for (Request& r : requests_) {
+        int64_t start = offset, count = r.rows;
+        ArrayNest mine = outputs.map([&](const Array& a) {
+          return slice(a, batch_dim_, start, count);
+        });
+        r.promise->set_value(std::move(mine));
+        offset += count;
+      }
+    }
+
+    void fail(const std::string& message) {
+      if (outputs_set_) return;
+      outputs_set_ = true;
+      for (Request& r : requests_) {
+        r.promise->set_exception(
+            std::make_exception_ptr(AsyncError(message)));
+      }
+    }
+
+   private:
+    int64_t batch_dim_;
+    ArrayNest inputs_;
+    std::vector<Request> requests_;
+    bool outputs_set_ = false;
+  };
+
+  DynamicBatcher(int64_t batch_dim, int64_t min_batch_size,
+                 int64_t max_batch_size, std::optional<int64_t> timeout_ms)
+      : batch_dim_(batch_dim),
+        queue_(batch_dim, min_batch_size, max_batch_size, timeout_ms,
+               std::nullopt, /*check_inputs=*/true) {}
+
+  int64_t size() const { return queue_.size(); }
+  bool is_closed() const { return queue_.is_closed(); }
+
+  ArrayNest compute(ArrayNest inputs,
+                    int64_t timeout_s = 600 /* reference: 10 min */) {
+    int64_t rows = inputs.front().dim(batch_dim_);
+    if (rows > queue_.max_batch_size())
+      throw std::invalid_argument("compute() exceeds maximum_batch_size");
+    Request req{std::make_shared<std::promise<ArrayNest>>(), rows};
+    auto future = req.promise->get_future();
+    queue_.enqueue(std::move(inputs), std::move(req));
+    if (future.wait_for(std::chrono::seconds(timeout_s)) ==
+        std::future_status::timeout) {
+      throw std::runtime_error("Compute response not ready after timeout");
+    }
+    return future.get();
+  }
+
+  // Blocks; throws QueueStopped when closed.
+  std::unique_ptr<Batch> get_batch() {
+    auto [inputs, requests] = queue_.dequeue_many();
+    return std::make_unique<Batch>(batch_dim_, std::move(inputs),
+                                   std::move(requests));
+  }
+
+  void close() {
+    std::vector<Request> pending = queue_.close();
+    for (Request& r : pending) {
+      r.promise->set_exception(std::make_exception_ptr(
+          AsyncError("Batcher closed with pending requests")));
+    }
+  }
+
+ private:
+  int64_t batch_dim_;
+  BatchingQueue<Request> queue_;
+};
+
+}  // namespace tbt

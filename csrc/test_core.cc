@@ -1,0 +1,270 @@
+// Assert-based tests for the native core (no gtest in this image).
+// Mirrors the reference's C++ test coverage (actorpool_test.cc: queue
+// construct/close/enqueue/dequeue semantics; nest_serialize_test.cc:
+// codec roundtrips) plus batcher promise semantics and a threaded stress.
+
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <thread>
+#include <vector>
+
+#include "array.h"
+#include "nest.h"
+#include "queues.h"
+#include "wire.h"
+
+using namespace tbt;
+
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    if (!(cond)) {                                                          \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,         \
+                   __LINE__, #cond);                                        \
+      std::abort();                                                         \
+    }                                                                       \
+  } while (0)
+
+#define CHECK_THROWS(expr, ExceptionType)                                   \
+  do {                                                                      \
+    bool caught = false;                                                    \
+    try {                                                                   \
+      expr;                                                                 \
+    } catch (const ExceptionType&) {                                        \
+      caught = true;                                                        \
+    }                                                                       \
+    CHECK(caught);                                                          \
+  } while (0)
+
+static Array make_array(DType dtype, std::vector<int64_t> shape,
+                        int64_t fill) {
+  Array a(dtype, shape);
+  if (dtype == DType::kI64) {
+    int64_t* p = reinterpret_cast<int64_t*>(a.mutable_data());
+    for (int64_t i = 0; i < a.numel(); ++i) p[i] = fill;
+  } else if (dtype == DType::kF32) {
+    float* p = reinterpret_cast<float*>(a.mutable_data());
+    for (int64_t i = 0; i < a.numel(); ++i) p[i] = static_cast<float>(fill);
+  } else {
+    std::memset(a.mutable_data(), static_cast<int>(fill), a.nbytes());
+  }
+  return a;
+}
+
+static void test_array_concat_slice() {
+  Array a = make_array(DType::kI64, {1, 2}, 1);
+  Array b = make_array(DType::kI64, {1, 2}, 2);
+  Array cat0 = concatenate({a, b}, 0);
+  CHECK(cat0.shape() == (std::vector<int64_t>{2, 2}));
+  const int64_t* p = reinterpret_cast<const int64_t*>(cat0.data());
+  CHECK(p[0] == 1 && p[1] == 1 && p[2] == 2 && p[3] == 2);
+
+  Array cat1 = concatenate({a, b}, 1);
+  CHECK(cat1.shape() == (std::vector<int64_t>{1, 4}));
+  p = reinterpret_cast<const int64_t*>(cat1.data());
+  CHECK(p[0] == 1 && p[1] == 1 && p[2] == 2 && p[3] == 2);
+
+  Array s = slice(cat0, 0, 1, 1);
+  CHECK(s.shape() == (std::vector<int64_t>{1, 2}));
+  CHECK(reinterpret_cast<const int64_t*>(s.data())[0] == 2);
+
+  Array s1 = slice(cat1, 1, 1, 2);
+  CHECK(s1.shape() == (std::vector<int64_t>{1, 2}));
+  p = reinterpret_cast<const int64_t*>(s1.data());
+  CHECK(p[0] == 1 && p[1] == 2);
+
+  CHECK_THROWS(concatenate({a, make_array(DType::kF32, {1, 2}, 0)}, 0),
+               std::invalid_argument);
+  std::printf("array concat/slice ok\n");
+}
+
+static void test_nest_ops() {
+  ArrayNest::Dict d;
+  d.emplace("x", ArrayNest(make_array(DType::kI64, {2}, 5)));
+  d.emplace("y", ArrayNest(ArrayNest::List{
+                     ArrayNest(make_array(DType::kI64, {1}, 7))}));
+  ArrayNest nest(d);
+
+  CHECK(!nest.empty());
+  CHECK(nest.front().dim(0) == 2);
+  CHECK(nest.flatten().size() == 2);
+
+  ArrayNest doubled = nest.map([](const Array& a) {
+    Array out = a.clone();
+    int64_t* p = reinterpret_cast<int64_t*>(out.mutable_data());
+    for (int64_t i = 0; i < out.numel(); ++i) p[i] *= 2;
+    return out;
+  });
+  CHECK(reinterpret_cast<const int64_t*>(doubled.front().data())[0] == 10);
+
+  // pack_as roundtrip
+  auto flat = doubled.flatten();
+  ArrayNest packed = nest.pack_as(flat);
+  CHECK(reinterpret_cast<const int64_t*>(packed.front().data())[0] == 10);
+  CHECK_THROWS(nest.pack_as(std::vector<Array>{}), std::invalid_argument);
+
+  // map2 structure mismatch
+  CHECK_THROWS(
+      ArrayNest::map2([](const Array& a, const Array&) { return a; }, nest,
+                      ArrayNest(make_array(DType::kI64, {1}, 0))),
+      std::invalid_argument);
+  std::printf("nest ops ok\n");
+}
+
+static void test_wire_roundtrip() {
+  wire::ValueNest::Dict msg;
+  msg.emplace("type", wire::ValueNest(wire::Value::of_string("step")));
+  msg.emplace("reward", wire::ValueNest(wire::Value::of(
+                            make_array(DType::kF32, {}, 3))));
+  msg.emplace("frame", wire::ValueNest(wire::Value::of(
+                           make_array(DType::kU8, {2, 2, 1}, 9))));
+  wire::ValueNest::List lst;
+  lst.push_back(wire::ValueNest(wire::Value::of_int(-42)));
+  lst.push_back(wire::ValueNest(wire::Value{}));
+  msg.emplace("extras", wire::ValueNest(std::move(lst)));
+
+  std::vector<uint8_t> framed = wire::encode(wire::ValueNest(msg));
+  uint32_t length = framed[0] | (framed[1] << 8) | (framed[2] << 16) |
+                    (framed[3] << 24);
+  CHECK(length == framed.size() - 4);
+
+  auto payload = std::make_shared<std::vector<uint8_t>>(framed.begin() + 4,
+                                                        framed.end());
+  wire::ValueNest out =
+      wire::decode(payload->data(), payload->size(), payload);
+  const auto& dict = out.dict();
+  CHECK(dict.at("type").leaf().s == "step");
+  const Array& frame = dict.at("frame").leaf().array;
+  CHECK(frame.shape() == (std::vector<int64_t>{2, 2, 1}));
+  CHECK(frame.data()[0] == 9);
+  const Array& reward = dict.at("reward").leaf().array;
+  CHECK(reward.ndim() == 0);  // 0-d survives (the Python-side regression)
+  CHECK(dict.at("extras").list()[0].leaf().i == -42);
+  CHECK(dict.at("extras").list()[1].leaf().kind ==
+        wire::Value::Kind::kNone);
+
+  // Truncated payload raises.
+  CHECK_THROWS(wire::decode(payload->data(), payload->size() - 1, payload),
+               wire::WireError);
+  std::printf("wire roundtrip ok\n");
+}
+
+static void test_batching_queue() {
+  CHECK_THROWS(BatchingQueue<int>(0, 0, 1, {}, {}, true),
+               std::invalid_argument);
+  CHECK_THROWS(BatchingQueue<int>(0, 4, 2, {}, {}, true),
+               std::invalid_argument);
+
+  BatchingQueue<int> queue(0, 3, 8, {}, {}, true);
+  for (int i = 0; i < 3; ++i) {
+    queue.enqueue(ArrayNest(make_array(DType::kI64, {1, 2}, i)), i);
+  }
+  auto [batch, payloads] = queue.dequeue_many();
+  CHECK(batch.front().shape() == (std::vector<int64_t>{3, 2}));
+  CHECK(payloads == (std::vector<int>{0, 1, 2}));
+
+  queue.close();
+  CHECK_THROWS(queue.enqueue(ArrayNest(make_array(DType::kI64, {1}, 0)), 0),
+               ClosedBatchingQueue);
+  CHECK_THROWS(queue.dequeue_many(), QueueStopped);
+  CHECK_THROWS(queue.close(), std::runtime_error);
+  std::printf("batching queue ok\n");
+}
+
+static void test_queue_stress() {
+  BatchingQueue<int64_t> queue(0, 1, 16, {}, {}, true);
+  constexpr int kProducers = 8, kItems = 200;
+  std::vector<std::thread> producers;
+  std::atomic<int64_t> total{0};
+  std::set<int64_t> seen;
+  std::mutex seen_mu;
+
+  std::vector<std::thread> consumers;
+  for (int c = 0; c < 4; ++c) {
+    consumers.emplace_back([&] {
+      while (true) {
+        try {
+          auto [batch, payloads] = queue.dequeue_many();
+          std::lock_guard<std::mutex> lock(seen_mu);
+          for (int64_t p : payloads) seen.insert(p);
+          total += static_cast<int64_t>(payloads.size());
+        } catch (const QueueStopped&) {
+          return;
+        }
+      }
+    });
+  }
+  for (int p = 0; p < kProducers; ++p) {
+    producers.emplace_back([&queue, p] {
+      for (int i = 0; i < kItems; ++i) {
+        queue.enqueue(ArrayNest(make_array(DType::kI64, {1}, i)),
+                      static_cast<int64_t>(p) * kItems + i);
+      }
+    });
+  }
+  for (auto& t : producers) t.join();
+  while (queue.size() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  queue.close();
+  for (auto& t : consumers) t.join();
+  CHECK(total == kProducers * kItems);
+  CHECK(seen.size() == kProducers * kItems);
+  std::printf("queue stress ok (%lld items)\n",
+              static_cast<long long>(total.load()));
+}
+
+static void test_dynamic_batcher() {
+  DynamicBatcher batcher(/*batch_dim=*/0, 1, 64, /*timeout_ms=*/20);
+
+  std::thread producer([&batcher] {
+    ArrayNest out = batcher.compute(ArrayNest(make_array(DType::kI64, {1, 2}, 3)));
+    const Array& a = out.front();
+    CHECK(a.shape() == (std::vector<int64_t>{1, 2}));
+    CHECK(reinterpret_cast<const int64_t*>(a.data())[0] == 6);
+  });
+
+  auto batch = batcher.get_batch();
+  CHECK(batch->size() == 1);
+  ArrayNest outputs = batch->inputs().map([](const Array& a) {
+    Array out = a.clone();
+    int64_t* p = reinterpret_cast<int64_t*>(out.mutable_data());
+    for (int64_t i = 0; i < out.numel(); ++i) p[i] *= 2;
+    return out;
+  });
+  batch->set_outputs(outputs);
+  CHECK_THROWS(batch->set_outputs(outputs), std::runtime_error);
+  producer.join();
+
+  // Dropped batch breaks the promise.
+  std::thread victim([&batcher] {
+    CHECK_THROWS(
+        batcher.compute(ArrayNest(make_array(DType::kI64, {1, 1}, 0))),
+        AsyncError);
+  });
+  batcher.get_batch().reset();  // drop without outputs
+  victim.join();
+
+  // close() wakes pending compute callers.
+  std::thread pending([&batcher] {
+    CHECK_THROWS(
+        batcher.compute(ArrayNest(make_array(DType::kI64, {1, 1}, 0))),
+        AsyncError);
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  batcher.close();
+  pending.join();
+  std::printf("dynamic batcher ok\n");
+}
+
+int main() {
+  test_array_concat_slice();
+  test_nest_ops();
+  test_wire_roundtrip();
+  test_batching_queue();
+  test_queue_stress();
+  test_dynamic_batcher();
+  std::printf("ALL NATIVE CORE TESTS PASSED\n");
+  return 0;
+}

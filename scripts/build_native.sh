@@ -1,0 +1,15 @@
+#!/bin/bash
+# Build + test the native runtime: C++ unit tests then the Python extension.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== C++ core tests"
+g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core \
+    2>&1 | head -30 || { mkdir -p build; g++ -std=c++17 -O2 -Wall -pthread \
+    csrc/test_core.cc -o build/test_core; }
+./build/test_core
+
+echo "== Python extension"
+touch csrc/pymodule.cc  # setuptools doesn't track header deps
+python setup.py build_ext --inplace --build-temp build/ext
+python -c "import _tbt_core; print('extension OK:', _tbt_core.__file__)"

@@ -1,0 +1,34 @@
+"""Build the native runtime extension:  python setup.py build_ext --inplace
+
+Builds `_tbt_core` (csrc/pymodule.cc + headers) with the CPython/numpy C
+API — no pybind11, no torch, no gRPC (the reference's CMake stack,
+/root/reference/CMakeLists.txt, pulled all three; this runtime needs none).
+The pure-Python package works without the extension; runtime/native.py
+picks it up when present.
+"""
+
+import numpy
+from setuptools import Extension, setup
+
+setup(
+    name="torchbeast_tpu",
+    version="0.1.0",
+    packages=[
+        "torchbeast_tpu",
+        "torchbeast_tpu.envs",
+        "torchbeast_tpu.models",
+        "torchbeast_tpu.ops",
+        "torchbeast_tpu.parallel",
+        "torchbeast_tpu.runtime",
+        "torchbeast_tpu.utils",
+    ],
+    ext_modules=[
+        Extension(
+            "_tbt_core",
+            sources=["csrc/pymodule.cc"],
+            include_dirs=["csrc", numpy.get_include()],
+            extra_compile_args=["-std=c++17", "-O2", "-Wall", "-pthread"],
+            language="c++",
+        )
+    ],
+)

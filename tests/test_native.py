@@ -1,0 +1,285 @@
+"""Native (_tbt_core) runtime: same semantic surface as the Python
+queues/actor-pool tests, driven through the C extension. Skipped when the
+extension isn't built (scripts/build_native.sh)."""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu.runtime.native import import_native
+
+core = import_native()
+pytestmark = pytest.mark.skipif(
+    core is None, reason="_tbt_core not built (run scripts/build_native.sh)"
+)
+
+
+class TestNativeBatchingQueue:
+    def test_construction_errors(self):
+        with pytest.raises(ValueError):
+            core.BatchingQueue(minimum_batch_size=0)
+        with pytest.raises(ValueError):
+            core.BatchingQueue(minimum_batch_size=4, maximum_batch_size=2)
+
+    def test_enqueue_dequeue_roundtrip(self):
+        queue = core.BatchingQueue(batch_dim=0, minimum_batch_size=2)
+        queue.enqueue({"x": np.full((1, 3), 1.5, np.float32)})
+        queue.enqueue({"x": np.full((1, 3), 2.5, np.float32)})
+        batch, count = queue.dequeue_many()
+        assert count == 2
+        assert batch["x"].shape == (2, 3)
+        np.testing.assert_array_equal(batch["x"][:, 0], [1.5, 2.5])
+
+    def test_close_semantics(self):
+        queue = core.BatchingQueue()
+        queue.close()
+        with pytest.raises(core.ClosedBatchingQueue):
+            queue.enqueue(np.zeros((1, 1)))
+        with pytest.raises(RuntimeError):
+            queue.close()
+        with pytest.raises(StopIteration):
+            next(iter(queue))
+
+    def test_validation(self):
+        queue = core.BatchingQueue(batch_dim=1)
+        with pytest.raises(ValueError):
+            queue.enqueue(np.zeros(3))  # too few dims
+
+    def test_iteration_blocks_until_item(self):
+        queue = core.BatchingQueue(batch_dim=0, minimum_batch_size=1)
+        out = {}
+
+        def consumer():
+            out["batch"] = next(iter(queue))
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        queue.enqueue([np.full((1, 2), 7, np.int64)])
+        t.join(5)
+        np.testing.assert_array_equal(out["batch"][0], [[7, 7]])
+
+    def test_stress(self):
+        queue = core.BatchingQueue(
+            batch_dim=0, minimum_batch_size=1, maximum_batch_size=16
+        )
+        n_producers, items = 8, 100
+        got = []
+        lock = threading.Lock()
+
+        def producer(p):
+            for i in range(items):
+                queue.enqueue(np.full((1,), p * items + i, np.int64))
+
+        def consumer():
+            while True:
+                try:
+                    batch, _ = queue.dequeue_many()
+                except StopIteration:
+                    return
+                with lock:
+                    got.extend(batch.tolist())
+
+        consumers = [
+            threading.Thread(target=consumer, daemon=True) for _ in range(4)
+        ]
+        producers = [
+            threading.Thread(target=producer, args=(p,), daemon=True)
+            for p in range(n_producers)
+        ]
+        for t in consumers + producers:
+            t.start()
+        for t in producers:
+            t.join(30)
+        deadline = time.monotonic() + 30
+        while queue.size() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        queue.close()
+        for t in consumers:
+            t.join(10)
+        assert sorted(got) == list(range(n_producers * items))
+
+
+class TestNativeDynamicBatcher:
+    def test_request_response(self):
+        batcher = core.DynamicBatcher(batch_dim=0)
+        result = {}
+
+        def producer():
+            result["out"] = batcher.compute(
+                {"x": np.arange(4, dtype=np.float32).reshape(1, 4)}
+            )
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        batch = next(iter(batcher))
+        inputs = batch.get_inputs()
+        assert len(batch) == 1
+        batch.set_outputs({"y": inputs["x"] * 10})
+        t.join(5)
+        np.testing.assert_array_equal(result["out"]["y"], [[0, 10, 20, 30]])
+
+    def test_batched_rows_sliced_back(self):
+        batcher = core.DynamicBatcher(batch_dim=0, minimum_batch_size=3)
+        outs = {}
+
+        def producer(i):
+            outs[i] = batcher.compute(np.full((1, 2), i, np.int64))
+
+        threads = [
+            threading.Thread(target=producer, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        batch = next(iter(batcher))
+        inputs = batch.get_inputs()
+        assert inputs.shape == (3, 2)
+        batch.set_outputs(inputs + 100)
+        for t in threads:
+            t.join(5)
+        for i in range(3):
+            np.testing.assert_array_equal(outs[i], [[i + 100, i + 100]])
+
+    def test_dropped_batch_breaks_promise(self):
+        batcher = core.DynamicBatcher(batch_dim=0)
+        caught = {}
+
+        def producer():
+            try:
+                batcher.compute(np.zeros((1, 1)))
+            except core.AsyncError as e:
+                caught["err"] = e
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        batch = next(iter(batcher))
+        del batch
+        t.join(5)
+        assert "err" in caught
+
+    def test_close_wakes_producers(self):
+        batcher = core.DynamicBatcher(batch_dim=0)
+        caught = {}
+
+        def producer():
+            try:
+                batcher.compute(np.zeros((1, 1)))
+            except core.AsyncError as e:
+                caught["err"] = e
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        batcher.close()
+        t.join(5)
+        assert "err" in caught
+
+    def test_set_outputs_twice_raises(self):
+        batcher = core.DynamicBatcher(batch_dim=0)
+        t = threading.Thread(
+            target=lambda: batcher.compute(np.zeros((1, 1))), daemon=True
+        )
+        t.start()
+        batch = next(iter(batcher))
+        batch.set_outputs(np.zeros((1, 1)))
+        with pytest.raises(RuntimeError):
+            batch.set_outputs(np.zeros((1, 1)))
+        t.join(5)
+
+
+EPISODE_LEN = 5
+T = 3
+
+
+def test_native_actor_pool_end_to_end():
+    """Full reference architecture: C++ actor loops against a Python env
+    server, Python inference thread serving the native batcher, rollouts
+    into the native learner queue — with the on-policy invariants held."""
+    from torchbeast_tpu.envs import CountingEnv
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    path = os.path.join(tempfile.mkdtemp(), "native_env")
+    address = f"unix:{path}"
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), address
+    )
+    server.start()
+    deadline = time.monotonic() + 5
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError("server did not bind")
+        time.sleep(0.01)
+
+    learner_queue = core.BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = core.DynamicBatcher(batch_dim=1, timeout_ms=20)
+
+    def inference():
+        while True:
+            try:
+                batch = next(iter(batcher))
+            except StopIteration:
+                return
+            inputs = batch.get_inputs()
+            done = inputs["env"]["done"]  # [1, B]
+            state = np.where(done, 0, inputs["agent_state"]) + 1  # [1, B]
+            batch.set_outputs(
+                {
+                    "outputs": {
+                        "action": np.zeros_like(done, np.int32),
+                        "policy_logits": state[..., None].astype(np.float32),
+                        "baseline": state.astype(np.float32),
+                    },
+                    "agent_state": state.astype(np.int64),
+                }
+            )
+
+    inf_thread = threading.Thread(target=inference, daemon=True)
+    inf_thread.start()
+
+    pool = core.ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+
+    items = []
+    it = iter(learner_queue)
+    while len(items) < 6:
+        items.append(next(it))
+
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    server.stop()
+
+    assert pool.count() >= 6 * T
+    prev = None
+    for item in items:
+        batch = item["batch"]
+        initial_state = item["initial_agent_state"]
+        assert batch["frame"].shape[:2] == (T + 1, 1)
+        if prev is not None:
+            for key in batch:
+                np.testing.assert_array_equal(
+                    batch[key][0], prev[key][-1], err_msg=key
+                )
+        done0 = batch["done"][0]
+        expected = np.where(done0, 0, initial_state[0]) + 1
+        np.testing.assert_array_equal(batch["baseline"][1], expected)
+        assert (batch["frame"][batch["done"].astype(bool)] == 0).all()
+        np.testing.assert_array_equal(
+            batch["action"][1:], batch["last_action"][1:]
+        )
+        prev = batch

@@ -41,3 +41,32 @@ def test_polybeast_train_lstm(tmp_path):
     stats = polybeast.train(flags)
     assert stats["step"] >= 60
     assert np.isfinite(stats["total_loss"])
+
+
+def test_polybeast_train_native_runtime(tmp_path):
+    from torchbeast_tpu.runtime.native import available
+
+    if not available():
+        import pytest
+
+        pytest.skip("_tbt_core not built")
+    flags = make_flags(tmp_path, xpid="poly-native", native_runtime=True,
+                       use_lstm=True)
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 60
+    assert np.isfinite(stats["total_loss"])
+
+
+def test_polybeast_train_native_feedforward(tmp_path):
+    # The default (no-LSTM) path carries an EMPTY agent-state nest through
+    # the whole C++ pipeline — distinct empty-nest round-trip coverage.
+    from torchbeast_tpu.runtime.native import available
+
+    if not available():
+        import pytest
+
+        pytest.skip("_tbt_core not built")
+    flags = make_flags(tmp_path, xpid="poly-native-ff", native_runtime=True)
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 60
+    assert np.isfinite(stats["total_loss"])

@@ -76,6 +76,10 @@ def make_parser():
     parser.add_argument("--use_lstm", action="store_true")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--num_inference_threads", type=int, default=2)
+    parser.add_argument("--native_runtime", action="store_true",
+                        help="Use the C++ queues/batcher/actor-pool "
+                             "(_tbt_core; build with "
+                             "scripts/build_native.sh).")
     parser.add_argument("--max_inference_batch_size", type=int, default=64)
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
@@ -159,14 +163,28 @@ def train(flags):
     }
     state_lock = threading.Lock()
 
-    learner_queue = BatchingQueue(
+    if flags.native_runtime:
+        from torchbeast_tpu.runtime.native import import_native
+
+        core = import_native()
+        if core is None:
+            raise RuntimeError(
+                "--native_runtime requested but _tbt_core is not built; "
+                "run scripts/build_native.sh"
+            )
+        queue_mod = core
+        log.info("Using native (C++) runtime")
+    else:
+        import torchbeast_tpu.runtime as queue_mod
+
+    learner_queue = queue_mod.BatchingQueue(
         batch_dim=1,
         minimum_batch_size=flags.batch_size,
         maximum_batch_size=flags.batch_size,
         maximum_queue_size=flags.max_learner_queue_size or flags.batch_size,
         check_inputs=True,
     )
-    inference_batcher = DynamicBatcher(
+    inference_batcher = queue_mod.DynamicBatcher(
         batch_dim=1,
         minimum_batch_size=1,
         maximum_batch_size=flags.max_inference_batch_size,
@@ -208,7 +226,8 @@ def train(flags):
         for i in range(flags.num_inference_threads)
     ]
 
-    actors = ActorPool(
+    pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
+    actors = pool_cls(
         unroll_length=flags.unroll_length,
         learner_queue=learner_queue,
         inference_batcher=inference_batcher,
@@ -259,10 +278,21 @@ def train(flags):
     try:
         while not state["done"]:
             time.sleep(5)
-            if actors.errors and not state["done"]:
+            pool_errors = getattr(actors, "errors", [])
+            if pool_errors and not state["done"]:
                 raise RuntimeError(
                     "Actor pool failed"
-                ) from actors.errors[0]
+                ) from pool_errors[0]
+            # Native pool: errors are recorded C++-side while surviving
+            # loops keep running; poll them so one dead actor surfaces
+            # within 5s (same visibility as the Python pool's .errors).
+            first_error = getattr(actors, "first_error_message", None)
+            if first_error is not None and not state["done"]:
+                msg = first_error()
+                if msg:
+                    raise RuntimeError(f"Actor pool failed: {msg}")
+            if not actor_thread.is_alive() and not state["done"]:
+                raise RuntimeError("Actor pool exited unexpectedly")
             with state_lock:
                 now_step = state["step"]
                 stats_now = dict(state["stats"])

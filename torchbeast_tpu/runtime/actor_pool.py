@@ -34,6 +34,7 @@ from torchbeast_tpu import nest
 from torchbeast_tpu.runtime import wire
 from torchbeast_tpu.runtime.env_server import parse_address
 from torchbeast_tpu.runtime.queues import (
+    AsyncError,
     BatchingQueue,
     ClosedBatchingQueue,
     DynamicBatcher,
@@ -98,6 +99,17 @@ class ActorPool:
             self._loop(index, address)
         except ClosedBatchingQueue:
             pass  # clean shutdown (reference actorpool.cc:452-459)
+        except AsyncError as e:
+            # Clean only when the pipeline is actually shutting down; a
+            # broken promise mid-training (inference failure) is real.
+            if (
+                self._inference_batcher.is_closed()
+                or self._learner_queue.is_closed()
+            ):
+                pass
+            else:
+                log.exception("Actor %d (%s) failed", index, address)
+                self._errors.append(e)
         except BaseException as e:  # noqa: BLE001
             log.exception("Actor %d (%s) failed", index, address)
             self._errors.append(e)

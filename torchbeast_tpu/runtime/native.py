@@ -1,0 +1,33 @@
+"""Native runtime loader.
+
+`import_native()` returns the `_tbt_core` C extension (C++ BatchingQueue /
+DynamicBatcher / ActorPool — actor loops run GIL-free in C++ threads) when
+built, else None; `available()` tells you which. Drivers select with
+`--native_runtime` (polybeast.py). The Python implementations in queues.py /
+actor_pool.py remain the semantic reference and the fallback.
+
+Build: bash scripts/build_native.sh   (setup.py build_ext --inplace)
+"""
+
+from typing import Optional
+
+
+_cached = False
+_module = None
+
+
+def import_native() -> Optional[object]:
+    global _cached, _module
+    if not _cached:
+        _cached = True
+        try:
+            import _tbt_core
+
+            _module = _tbt_core
+        except ImportError:
+            _module = None
+    return _module
+
+
+def available() -> bool:
+    return import_native() is not None
