@@ -1,0 +1,83 @@
+"""FileWriter: dynamic CSV schema, resume-append, metadata
+(reference capability: core/file_writer.py — SURVEY.md §5.5)."""
+
+import csv
+import json
+
+from torchbeast_tpu.utils import FileWriter, Timings
+
+
+def read_rows(path):
+    with open(path) as f:
+        return list(csv.DictReader(f))
+
+
+def test_basic_logging_and_files(tmp_path):
+    fw = FileWriter(xpid="xp", xp_args={"a": 1}, rootdir=str(tmp_path))
+    fw.log({"loss": 1.0, "step": 100})
+    fw.log({"loss": 0.5, "step": 200})
+    fw.close()
+
+    base = tmp_path / "xp"
+    rows = read_rows(base / "logs.csv")
+    assert len(rows) == 2
+    assert rows[0]["loss"] == "1.0"
+    assert rows[1]["step"] == "200"
+    assert (base / "fields.csv").exists()
+    meta = json.loads((base / "meta.json").read_text())
+    assert meta["args"] == {"a": 1}
+    assert meta["successful"] is True
+    assert (tmp_path / "latest").exists()
+
+
+def test_dynamic_schema_widens(tmp_path):
+    fw = FileWriter(xpid="xp", rootdir=str(tmp_path))
+    fw.log({"loss": 1.0})
+    fw.log({"loss": 0.9, "mean_episode_return": 5.0})
+    fw.close()
+    rows = read_rows(tmp_path / "xp" / "logs.csv")
+    assert rows[0].get("mean_episode_return") in (None, "")
+    assert rows[1]["mean_episode_return"] == "5.0"
+    # fields.csv records one row per schema version.
+    with open(tmp_path / "xp" / "fields.csv") as f:
+        versions = list(csv.reader(f))
+    assert len(versions) == 2
+    assert "mean_episode_return" in versions[1]
+
+
+def test_resume_continues_tick(tmp_path):
+    fw = FileWriter(xpid="xp", rootdir=str(tmp_path))
+    fw.log({"loss": 1.0})
+    fw.log({"loss": 0.9})
+    fw.close()
+
+    fw2 = FileWriter(xpid="xp", rootdir=str(tmp_path))
+    fw2.log({"loss": 0.8})
+    fw2.close()
+    rows = read_rows(tmp_path / "xp" / "logs.csv")
+    assert [r["_tick"] for r in rows] == ["0", "1", "2"]
+
+
+def test_unsuccessful_close(tmp_path):
+    fw = FileWriter(xpid="xp", rootdir=str(tmp_path))
+    fw.close(successful=False)
+    meta = json.loads((tmp_path / "xp" / "meta.json").read_text())
+    assert meta["successful"] is False
+
+
+def test_timings_mean_and_summary():
+    import time
+
+    t = Timings()
+    for _ in range(3):
+        t.reset()
+        time.sleep(0.01)
+        t.time("a")
+        time.sleep(0.02)
+        t.time("b")
+    means = t.means()
+    assert 0.005 < means["a"] < 0.05
+    assert means["b"] > means["a"]
+    summary = t.summary("prefix: ")
+    assert "a:" in summary and "b:" in summary and "%" in summary
+    assert set(t.stds()) == {"a", "b"}

@@ -59,6 +59,8 @@ def test_mesh_shapes():
     assert mesh.devices.shape == (4, 2)
     with pytest.raises(ValueError):
         create_mesh(8, model_parallelism=3)
+    with pytest.raises(ValueError, match="visible"):
+        create_mesh(64)  # more than the 8 virtual devices
 
 
 def test_parallel_update_matches_single_device(setup):

@@ -57,6 +57,17 @@ def test_polybeast_train_native_runtime(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+def test_polybeast_train_data_parallel(tmp_path):
+    # 4-way DP learner over the virtual CPU mesh inside the async driver.
+    flags = make_flags(
+        tmp_path, xpid="poly-dp", num_learner_devices="4", batch_size="4",
+        num_servers="4",
+    )
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 60
+    assert np.isfinite(stats["total_loss"])
+
+
 def test_polybeast_train_native_feedforward(tmp_path):
     # The default (no-LSTM) path carries an EMPTY agent-state nest through
     # the whole C++ pipeline — distinct empty-nest round-trip coverage.

@@ -57,12 +57,16 @@ def initialize_distributed(
     )
 
 
-def make_parallel_update_step(model, optimizer, hp: learner_lib.HParams, mesh):
+def make_parallel_update_step(
+    model, optimizer, hp: learner_lib.HParams, mesh, donate: bool = True
+):
     """Data-parallel version of learner.make_update_step.
 
     Same signature and semantics; gradients are averaged over the `data`
     axis implicitly by XLA's all-reduce (sum-reduced losses over a sharded
     batch == the reference's single-learner loss over the full batch).
+    donate=False for async drivers whose inference threads hold live
+    references to params (see learner.make_update_step).
     """
     repl = mesh_lib.replicated(mesh)
     bsh = mesh_lib.batch_sharding(mesh)
@@ -86,22 +90,31 @@ def make_parallel_update_step(model, optimizer, hp: learner_lib.HParams, mesh):
         update_step,
         in_shardings=(repl, repl, bsh, ssh),
         out_shardings=(repl, repl, repl),
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1) if donate else (),
     )
 
 
 def shard_batch(mesh, batch: Dict[str, np.ndarray], initial_agent_state: Any):
-    """Host -> device: place a host-global batch with the DP shardings.
+    """Host -> device: place a batch with the DP shardings.
 
-    Single-process path: jax.device_put handles splitting across local
-    devices. (The multi-host variant assembles a global array from each
-    host's local shard; that lands with the distributed driver.)
+    Single-process: jax.device_put splits across local devices. Multi-host
+    (jax.process_count() > 1): each process passes its LOCAL batch shard
+    (local_batch_size = global / process_count) and
+    jax.make_array_from_process_local_data assembles the global array —
+    device_put with a global sharding would fail on non-addressable
+    devices.
     """
     bsh = mesh_lib.batch_sharding(mesh)
     ssh = mesh_lib.state_sharding(mesh)
-    batch = {k: jax.device_put(v, bsh) for k, v in batch.items()}
+    if jax.process_count() > 1:
+        put_b = lambda v: jax.make_array_from_process_local_data(bsh, v)  # noqa: E731
+        put_s = lambda v: jax.make_array_from_process_local_data(ssh, v)  # noqa: E731
+    else:
+        put_b = lambda v: jax.device_put(v, bsh)  # noqa: E731
+        put_s = lambda v: jax.device_put(v, ssh)  # noqa: E731
+    batch = {k: put_b(np.asarray(v)) for k, v in batch.items()}
     initial_agent_state = jax.tree_util.tree_map(
-        lambda s: jax.device_put(s, ssh), initial_agent_state
+        lambda s: put_s(np.asarray(s)), initial_agent_state
     )
     return batch, initial_agent_state
 

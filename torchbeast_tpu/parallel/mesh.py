@@ -31,6 +31,11 @@ def create_mesh(
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"Requested a {n_devices}-device mesh but only "
+                f"{len(devices)} devices are visible"
+            )
         devices = devices[:n_devices]
     n = len(devices)
     if n % model_parallelism != 0:

@@ -36,6 +36,7 @@ from torchbeast_tpu.runtime.inference import inference_loop
 from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
 from torchbeast_tpu.utils import (
     FileWriter,
+    Timings,
     load_checkpoint,
     save_checkpoint,
 )
@@ -80,6 +81,16 @@ def make_parser():
                         help="Use the C++ queues/batcher/actor-pool "
                              "(_tbt_core; build with "
                              "scripts/build_native.sh).")
+    parser.add_argument("--num_learner_devices", type=int, default=1,
+                        help="Data-parallel learner over this many chips "
+                             "(params replicated, batch sharded over the "
+                             "mesh's data axis, ICI all-reduce for grads). "
+                             "batch_size must be divisible by it.")
+    parser.add_argument("--coordinator_address", default=None,
+                        help="Multi-host: jax.distributed coordinator "
+                             "(host:port); also reads "
+                             "TORCHBEAST_COORDINATOR / _NUM_PROCESSES / "
+                             "_PROCESS_ID env vars.")
     parser.add_argument("--max_inference_batch_size", type=int, default=64)
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
@@ -101,6 +112,11 @@ def make_parser():
 
 
 def train(flags):
+    from torchbeast_tpu.parallel import initialize_distributed
+
+    # No-ops (with a log line) when no coordinator is configured by flag
+    # or TORCHBEAST_COORDINATOR env.
+    initialize_distributed(flags.coordinator_address)
     if flags.xpid is None:
         flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
     plogger = FileWriter(
@@ -148,8 +164,33 @@ def train(flags):
         log.info("Resuming preempted job, current stats:\n%s", stats)
 
     # donate=False: inference threads hold live references to params.
-    update_step = learner_lib.make_update_step(model, optimizer, hp,
-                                               donate=False)
+    mesh = None
+    if flags.num_learner_devices > 1:
+        from torchbeast_tpu.parallel import (
+            create_mesh,
+            make_parallel_update_step,
+            replicate,
+            shard_batch,
+        )
+
+        if flags.batch_size % flags.num_learner_devices != 0:
+            raise ValueError(
+                f"batch_size {flags.batch_size} not divisible by "
+                f"num_learner_devices {flags.num_learner_devices}"
+            )
+        mesh = create_mesh(flags.num_learner_devices)
+        update_step = make_parallel_update_step(
+            model, optimizer, hp, mesh, donate=False
+        )
+        params = replicate(mesh, params)
+        opt_state = replicate(mesh, opt_state)
+        shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
+        log.info("Data-parallel learner over %d devices",
+                 flags.num_learner_devices)
+    else:
+        update_step = learner_lib.make_update_step(model, optimizer, hp,
+                                                   donate=False)
+        shard = None
     act_step = learner_lib.make_act_step(model)
 
     # Shared mutable state: the learner rebinds these; inference reads them.
@@ -238,16 +279,30 @@ def train(flags):
         target=actors.run, daemon=True, name="actorpool"
     )
 
+    timings = Timings()
+
     def learner_loop():
-        for item in learner_queue:
+        queue_iter = iter(learner_queue)
+        while True:
+            # reset BEFORE blocking so 'dequeue' measures the actual queue
+            # wait (actor starvation shows up here).
+            timings.reset()
+            try:
+                item = next(queue_iter)
+            except StopIteration:
+                break
             batch = item["batch"]
             initial_agent_state = item["initial_agent_state"]
+            if shard is not None:
+                batch, initial_agent_state = shard(batch, initial_agent_state)
+            timings.time("dequeue")
             with state_lock:
                 params_now, opt_now = state["params"], state["opt_state"]
             new_params, new_opt, train_stats = update_step(
                 params_now, opt_now, batch, initial_agent_state
             )
             train_stats = jax.device_get(train_stats)
+            timings.time("learn")
             with state_lock:
                 state["params"], state["opt_state"] = new_params, new_opt
                 state["step"] += flags.unroll_length * flags.batch_size
@@ -299,12 +354,16 @@ def train(flags):
             now = time.time()
             sps = (now_step - last_step) / (now - last_time)
             last_step, last_time = now_step, now
+            means = timings.means()
             log.info(
                 "Step %d @ %.1f SPS. Inference batcher size: %d. "
-                "Learner queue size: %d. Loss %.4f. %s",
+                "Learner queue size: %d. Loss %.4f. "
+                "[dequeue %.0fms learn %.0fms] %s",
                 now_step, sps, inference_batcher.size(),
                 learner_queue.size(),
                 stats_now.get("total_loss", float("nan")),
+                1000 * means.get("dequeue", 0.0),
+                1000 * means.get("learn", 0.0),
                 f"Return {stats_now['mean_episode_return']:.1f}."
                 if "mean_episode_return" in stats_now else "",
             )
