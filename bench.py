@@ -65,28 +65,40 @@ def run_bench():
     # truth for the model/batch schema).
     import __graft_entry__
 
-    model, params, batch, state = __graft_entry__._flagship(
-        batch_size=B, t=T
-    )
-    hp = learner_lib.HParams(batch_size=B, unroll_length=T)
-    optimizer = learner_lib.make_optimizer(hp)
-    opt_state = optimizer.init(params)
-    update_step = learner_lib.make_update_step(model, optimizer, hp)
+    def measure(dtype):
+        model, params, batch, state = __graft_entry__._flagship(
+            batch_size=B, t=T, dtype=dtype
+        )
+        hp = learner_lib.HParams(batch_size=B, unroll_length=T)
+        optimizer = learner_lib.make_optimizer(hp)
+        opt_state = optimizer.init(params)
+        update_step = learner_lib.make_update_step(model, optimizer, hp)
 
-    batch = jax.device_put(batch)
-    state = jax.device_put(state)
+        batch_d = jax.device_put(batch)
+        state_d = jax.device_put(state)
 
-    for _ in range(warmup):
-        params, opt_state, stats = update_step(params, opt_state, batch, state)
-    jax.block_until_ready(stats["total_loss"])
+        for _ in range(warmup):
+            params, opt_state, stats = update_step(
+                params, opt_state, batch_d, state_d
+            )
+        jax.block_until_ready(stats["total_loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, stats = update_step(params, opt_state, batch, state)
-    jax.block_until_ready(stats["total_loss"])
-    elapsed = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, stats = update_step(
+                params, opt_state, batch_d, state_d
+            )
+        jax.block_until_ready(stats["total_loss"])
+        elapsed = time.perf_counter() - t0
+        return T * B * steps / elapsed, 1000 * elapsed / steps
 
-    frames_per_sec = T * B * steps / elapsed
+    import jax.numpy as jnp
+
+    frames_per_sec, step_ms = measure(jnp.float32)
+    # bf16 trunk variant: only worth the extra compile on an accelerator.
+    bf16_frames_per_sec = None
+    if platform != "cpu":
+        bf16_frames_per_sec, _ = measure(jnp.bfloat16)
 
     baseline = None
     baseline_path = os.path.join(
@@ -107,7 +119,10 @@ def run_bench():
             round(frames_per_sec / baseline, 2) if baseline else None
         ),
         "platform": platform,
-        "step_ms": round(1000 * elapsed / steps, 2),
+        "step_ms": round(step_ms, 2),
+        "bf16_value": (
+            round(bf16_frames_per_sec, 1) if bf16_frames_per_sec else None
+        ),
     }
     print(json.dumps(result))
 

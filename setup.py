@@ -10,18 +10,9 @@ picks it up when present.
 import numpy
 from setuptools import Extension, setup
 
+# Name/version/packages/scripts live in pyproject.toml; this file only adds
+# what declarative metadata can't: the C extension.
 setup(
-    name="torchbeast_tpu",
-    version="0.1.0",
-    packages=[
-        "torchbeast_tpu",
-        "torchbeast_tpu.envs",
-        "torchbeast_tpu.models",
-        "torchbeast_tpu.ops",
-        "torchbeast_tpu.parallel",
-        "torchbeast_tpu.runtime",
-        "torchbeast_tpu.utils",
-    ],
     ext_modules=[
         Extension(
             "_tbt_core",

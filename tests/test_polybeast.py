@@ -57,6 +57,24 @@ def test_polybeast_train_native_runtime(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+def test_polybeast_test_mode(tmp_path):
+    # Train a checkpoint, then greedy-evaluate it via the poly CLI (the
+    # reference's poly test() raises NotImplementedError).
+    flags = make_flags(tmp_path)
+    polybeast.train(flags)
+    tflags = make_flags(tmp_path, mode="test", num_test_episodes="1")
+    returns = polybeast.main(tflags)
+    assert len(returns) == 1
+    assert returns[0] == 200.0  # Mock: 200 steps x reward 1.0
+
+
+def test_polybeast_bf16_trunk(tmp_path):
+    flags = make_flags(tmp_path, xpid="poly-bf16", model_dtype="bfloat16")
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 60
+    assert np.isfinite(stats["total_loss"])
+
+
 def test_polybeast_train_data_parallel(tmp_path):
     # 4-way DP learner over the virtual CPU mesh inside the async driver.
     flags = make_flags(

@@ -68,6 +68,10 @@ def make_parser():
                         help="Model family (Mono used shallow; Poly deep).")
     parser.add_argument("--use_lstm", action="store_true",
                         help="Use LSTM in the agent model.")
+    parser.add_argument("--model_dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="Conv/fc trunk compute dtype (bfloat16 rides "
+                             "the MXU; params and losses stay float32).")
     parser.add_argument("--serial_envs", action="store_true",
                         help="Step envs in-process (tests/cheap envs).")
     parser.add_argument("--seed", type=int, default=1234)
@@ -139,8 +143,16 @@ def _probe_env(flags):
 
 def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                            frame_dtype=np.uint8):
+    import jax.numpy as jnp
+
+    dtype = (
+        jnp.bfloat16
+        if getattr(flags, "model_dtype", "float32") == "bfloat16"
+        else jnp.float32
+    )
     model = create_model(
-        flags.model, num_actions=num_actions, use_lstm=flags.use_lstm
+        flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
+        dtype=dtype,
     )
     dummy = {
         "frame": np.zeros((1, batch_size) + tuple(frame_shape), frame_dtype),
@@ -382,9 +394,13 @@ def main(flags):
     return test(flags)
 
 
-if __name__ == "__main__":
+def cli():
     # Make the JAX_PLATFORMS env var authoritative even when a site hook
     # (e.g. a TPU-plugin sitecustomize) already forced a platform list.
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     main(make_parser().parse_args())
+
+
+if __name__ == "__main__":
+    cli()

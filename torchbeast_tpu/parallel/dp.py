@@ -46,14 +46,14 @@ def initialize_distributed(
     if coordinator_address is None:
         log.info("No coordinator configured; single-process mode.")
         return
+    if num_processes is None:
+        num_processes = int(os.environ.get("TORCHBEAST_NUM_PROCESSES", 1))
+    if process_id is None:  # NB: 0 is a valid id — test None explicitly
+        process_id = int(os.environ.get("TORCHBEAST_PROCESS_ID", 0))
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
-        num_processes=int(
-            num_processes or os.environ.get("TORCHBEAST_NUM_PROCESSES", 1)
-        ),
-        process_id=int(
-            process_id or os.environ.get("TORCHBEAST_PROCESS_ID", 0)
-        ),
+        num_processes=int(num_processes),
+        process_id=int(process_id),
     )
 
 

@@ -58,7 +58,8 @@ def make_parser():
                         help="Actor loops (default: one per server).")
     parser.add_argument("--num_servers", type=int, default=4)
     parser.add_argument("--env", type=str, default="PongNoFrameskip-v4")
-    parser.add_argument("--mode", default="train", choices=["train"])
+    parser.add_argument("--mode", default="train", choices=["train", "test"])
+    parser.add_argument("--num_test_episodes", type=int, default=10)
     parser.add_argument("--xpid", default=None)
     parser.add_argument("--start_servers", dest="start_servers",
                         action="store_true", default=True,
@@ -75,6 +76,10 @@ def make_parser():
     parser.add_argument("--model", default="deep",
                         choices=["shallow", "deep"])
     parser.add_argument("--use_lstm", action="store_true")
+    parser.add_argument("--model_dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="Conv/fc trunk compute dtype (bfloat16 rides "
+                             "the MXU; params and losses stay float32).")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--num_inference_threads", type=int, default=2)
     parser.add_argument("--native_runtime", action="store_true",
@@ -419,10 +424,21 @@ def _probe_env_via_server(flags, address):
 
 
 def main(flags):
+    if flags.mode == "test":
+        # Greedy checkpoint evaluation — shared with the mono driver. (The
+        # reference's poly test() is a NotImplementedError,
+        # polybeast_learner.py:596-597; here it just works.)
+        from torchbeast_tpu import monobeast
+
+        return monobeast.test(flags)
     return train(flags)
 
 
-if __name__ == "__main__":
+def cli():
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     main(make_parser().parse_args())
+
+
+if __name__ == "__main__":
+    cli()

@@ -79,5 +79,9 @@ def main(flags):
             p.terminate()
 
 
-if __name__ == "__main__":
+def cli():
     main(make_parser().parse_args())
+
+
+if __name__ == "__main__":
+    cli()
