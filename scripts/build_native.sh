@@ -1,13 +1,21 @@
 #!/bin/bash
 # Build + test the native runtime: C++ unit tests then the Python extension.
+# --tsan additionally runs the C++ tests under ThreadSanitizer (the
+# reference ships no race detection at all, SURVEY.md §5.2).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+mkdir -p build
 
 echo "== C++ core tests"
-g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core \
-    2>&1 | head -30 || { mkdir -p build; g++ -std=c++17 -O2 -Wall -pthread \
-    csrc/test_core.cc -o build/test_core; }
+g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core
 ./build/test_core
+
+if [[ "${1:-}" == "--tsan" ]]; then
+    echo "== C++ core tests (ThreadSanitizer)"
+    g++ -std=c++17 -O1 -g -Wall -pthread -fsanitize=thread \
+        csrc/test_core.cc -o build/test_core_tsan
+    ./build/test_core_tsan
+fi
 
 echo "== Python extension"
 touch csrc/pymodule.cc  # setuptools doesn't track header deps

@@ -193,6 +193,36 @@ class TestNativeDynamicBatcher:
         t.join(5)
 
 
+def test_conversion_does_not_leak_references():
+    """enqueue/dequeue roundtrips must not leak refs to the input arrays
+    (reference parity: nest refcount tests, nest/nest_test.py:126-166)."""
+    import gc
+    import sys
+
+    arr = np.arange(6, dtype=np.float32).reshape(1, 6)
+    baseline_rc = sys.getrefcount(arr)
+
+    queue = core.BatchingQueue(batch_dim=0, minimum_batch_size=1)
+    for _ in range(10):
+        queue.enqueue({"x": arr})
+        out, _ = queue.dequeue_many()
+        del out
+    queue.close()
+    del queue
+    gc.collect()
+    assert sys.getrefcount(arr) == baseline_rc
+
+    # And decoded outputs keep their buffer alive independently.
+    queue = core.BatchingQueue(batch_dim=0, minimum_batch_size=1)
+    src = np.full((1, 4), 7.0)
+    queue.enqueue(src)
+    out, _ = queue.dequeue_many()
+    del src
+    gc.collect()
+    np.testing.assert_array_equal(out, [[7.0, 7.0, 7.0, 7.0]])
+    queue.close()
+
+
 EPISODE_LEN = 5
 T = 3
 

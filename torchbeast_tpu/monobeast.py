@@ -250,8 +250,10 @@ def train(flags):
 
             # Split the [T+1, num_actors] unroll into learner batches of
             # batch_size columns; aggregate stats over ALL sub-batches
-            # (losses averaged, episode sums/counts summed).
-            sub_stats = []
+            # (losses averaged, episode sums/counts summed). Stats stay on
+            # device until all sub-updates are dispatched — XLA's async
+            # dispatch overlaps the fetch with the next update.
+            device_stats = []
             for i in range(0, B, flags.batch_size):
                 sub = {
                     k: v[:, i : i + flags.batch_size] for k, v in batch.items()
@@ -262,8 +264,9 @@ def train(flags):
                 params_cell[0], opt_state, train_stats = update_step(
                     params_cell[0], opt_state, sub, sub_state
                 )
-                sub_stats.append(jax.device_get(train_stats))
+                device_stats.append(train_stats)
                 step += T * flags.batch_size
+            sub_stats = jax.device_get(device_stats)  # one batched transfer
             timings.time("learn")
 
             agg = {}

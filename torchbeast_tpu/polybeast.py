@@ -287,7 +287,33 @@ def train(flags):
     timings = Timings()
 
     def learner_loop():
+        try:
+            _learner_loop_body()
+        finally:
+            # Always mark done — an async XLA error surfacing in the
+            # delayed flush must stop the monitor loop, not wedge it.
+            with state_lock:
+                state["done"] = True
+
+    def _learner_loop_body():
         queue_iter = iter(learner_queue)
+        # One-step-delayed stats fetch: device_get on the PREVIOUS update's
+        # stats happens after the current one is dispatched, so the host
+        # never stalls XLA's async pipeline (the reference's equivalent
+        # overlap came from extra learner threads + a lock).
+        pending = None  # (device_stats, step_after_that_update)
+
+        def flush(pending_entry):
+            device_stats, at_step = pending_entry
+            s = learner_lib.episode_stat_postprocess(
+                jax.device_get(device_stats)
+            )
+            s["step"] = at_step
+            s["learner_queue_size"] = learner_queue.size()
+            with state_lock:
+                state["stats"] = s
+            plogger.log(s)
+
         while True:
             # reset BEFORE blocking so 'dequeue' measures the actual queue
             # wait (actor starvation shows up here).
@@ -306,20 +332,18 @@ def train(flags):
             new_params, new_opt, train_stats = update_step(
                 params_now, opt_now, batch, initial_agent_state
             )
-            train_stats = jax.device_get(train_stats)
-            timings.time("learn")
             with state_lock:
                 state["params"], state["opt_state"] = new_params, new_opt
                 state["step"] += flags.unroll_length * flags.batch_size
-                s = learner_lib.episode_stat_postprocess(train_stats)
-                s["step"] = state["step"]
-                s["learner_queue_size"] = learner_queue.size()
-                state["stats"] = s
-            plogger.log(s)
-            if state["step"] >= flags.total_steps:
+                now_step = state["step"]
+            if pending is not None:
+                flush(pending)
+            pending = (train_stats, now_step)
+            timings.time("learn")
+            if now_step >= flags.total_steps:
                 break
-        with state_lock:
-            state["done"] = True
+        if pending is not None:
+            flush(pending)
 
     learner_thread = threading.Thread(
         target=learner_loop, daemon=True, name="learner"
