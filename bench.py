@@ -100,6 +100,28 @@ def run_bench():
     if platform != "cpu":
         bf16_frames_per_sec, _ = measure(jnp.bfloat16)
 
+    # Inference throughput at the largest bucket (the actor-side hot path).
+    def measure_inference(batch_size=64, n=20):
+        model, params, batch, _ = __graft_entry__._flagship(
+            batch_size=batch_size, t=0
+        )
+        act_step = learner_lib.make_act_step(model)
+        env_output = {
+            k: jax.device_put(batch[k][0])
+            for k in ("frame", "reward", "done", "last_action")
+        }
+        state = jax.device_put(model.initial_state(batch_size))
+        key = jax.random.PRNGKey(0)
+        out, state = act_step(params, key, env_output, state)  # compile
+        jax.block_until_ready(out.action)
+        t0 = time.perf_counter()
+        for i in range(n):
+            out, state = act_step(params, key, env_output, state)
+        jax.block_until_ready(out.action)
+        return batch_size * n / (time.perf_counter() - t0)
+
+    inference_sps = measure_inference(n=20 if platform != "cpu" else 3)
+
     baseline = None
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BASELINE_measured.json"
@@ -123,6 +145,7 @@ def run_bench():
         "bf16_value": (
             round(bf16_frames_per_sec, 1) if bf16_frames_per_sec else None
         ),
+        "inference_steps_per_sec": round(inference_sps, 1),
     }
     print(json.dumps(result))
 

@@ -68,6 +68,25 @@ def test_stream_protocol(server_address):
         sock.close()
 
 
+def test_remote_env_spec_probe(server_address):
+    """Learners probe num_actions/frame spec from the server's initial
+    step — split deployments may lack env deps on the learner host."""
+    import argparse
+
+    from torchbeast_tpu.polybeast import _probe_env_via_server
+
+    # A locally-unresolvable env id: if the remote probe silently falls
+    # back to the local probe, create_env raises and the test fails loudly
+    # instead of passing via the fallback.
+    flags = argparse.Namespace(env="DefinitelyNotInstalledNoFrameskip-v4")
+    num_actions, frame_shape, frame_dtype = _probe_env_via_server(
+        flags, server_address, timeout_s=10
+    )
+    assert num_actions == 2  # CountingEnv default
+    assert tuple(frame_shape) == (48, 48, 1)
+    assert frame_dtype == np.uint8
+
+
 def test_fresh_env_per_connection(server_address):
     import socket
 

@@ -10,6 +10,14 @@ from torchbeast_tpu.envs.environment import Environment  # noqa: F401
 from torchbeast_tpu.envs.mock import CountingEnv, MockEnv  # noqa: F401
 
 
+def num_actions_of(env) -> int:
+    """Discrete action count of a raw env (our minimal protocol's
+    `num_actions` attribute, or a gym(nasium) `action_space.n`)."""
+    if hasattr(env, "num_actions"):
+        return int(env.num_actions)
+    return int(env.action_space.n)
+
+
 def create_env(name: str, **kwargs):
     if name == "Mock":
         return MockEnv(**kwargs)

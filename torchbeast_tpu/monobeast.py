@@ -128,13 +128,11 @@ def _make_pool(flags, num_envs):
 
 def _probe_env(flags):
     """One throwaway env instance -> (num_actions, frame shape/dtype)."""
-    probe = create_env(flags.env)
-    if hasattr(probe, "num_actions"):
-        n = probe.num_actions
-    else:
-        n = probe.action_space.n
+    from torchbeast_tpu.envs import num_actions_of
     from torchbeast_tpu.envs.environment import Environment
 
+    probe = create_env(flags.env)
+    n = num_actions_of(probe)
     frame = Environment(probe).initial()["frame"]
     if hasattr(probe, "close"):
         probe.close()

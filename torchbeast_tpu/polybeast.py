@@ -441,9 +441,48 @@ def train(flags):
     return state["stats"]
 
 
-def _probe_env_via_server(flags, address):
-    """Probe action/observation spec locally (servers host the same env)."""
-    del address  # local probe is enough; servers run the same env id
+def _probe_env_via_server(flags, address, timeout_s: float = 60.0):
+    """Probe action/observation spec from a running env server (split
+    deployments may not have the env deps on the learner host); fall back
+    to a local probe when no server is reachable (e.g. unit tests calling
+    train() with start_servers but slow spawns — the local env id is the
+    same)."""
+    import socket as socket_lib
+
+    from torchbeast_tpu.runtime import wire
+    from torchbeast_tpu.runtime.env_server import parse_address
+
+    family, target = parse_address(address)
+    deadline = time.monotonic() + timeout_s
+    last_error = None
+    while time.monotonic() < deadline:
+        sock = socket_lib.socket(family, socket_lib.SOCK_STREAM)
+        sock.settimeout(5)
+        try:
+            sock.connect(target)
+            step = wire.recv_message(sock)
+            if not isinstance(step, dict) or step.get("type") == "error":
+                # Deterministic server-side failure (env construction
+                # raised) or a server that predates spec advertisement:
+                # retrying would rebuild the env ~5x/sec for nothing.
+                last_error = RuntimeError(f"server replied {step!r:.200}")
+                break
+            if "num_actions" not in step:
+                last_error = KeyError(
+                    "server does not advertise num_actions"
+                )
+                break
+            frame = np.asarray(step["frame"])
+            return int(step["num_actions"]), frame.shape, frame.dtype
+        except OSError as e:  # not up yet — retry until deadline
+            last_error = e
+            time.sleep(0.2)
+        finally:
+            sock.close()
+    log.warning(
+        "Could not probe env spec from %s (%s); probing locally.",
+        address, last_error,
+    )
     return _probe_env(flags)
 
 

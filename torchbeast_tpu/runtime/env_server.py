@@ -107,9 +107,18 @@ class EnvServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass  # unix sockets
-        env = Environment(self._env_init())
+        raw_env = self._env_init()
+        env = Environment(raw_env)
         try:
-            wire.send_message(conn, _step_to_message(env.initial()))
+            # The initial Step doubles as the env spec: remote learners
+            # probe num_actions/frame shape from it instead of having to
+            # build the env locally (split deployments may not have the
+            # env deps on the learner host).
+            from torchbeast_tpu.envs import num_actions_of
+
+            initial = _step_to_message(env.initial())
+            initial["num_actions"] = num_actions_of(raw_env)
+            wire.send_message(conn, initial)
             while True:
                 msg = wire.recv_message(conn)
                 if msg is None:
