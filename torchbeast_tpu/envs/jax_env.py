@@ -1,0 +1,153 @@
+"""Jittable (on-device) environments for the Anakin trainer.
+
+The reference runs every environment on CPU behind IPC (its only option —
+Atari is C++/OpenCV). For envs expressible in JAX, the Podracer "Anakin"
+pattern (arXiv:2104.06272) instead steps the env INSIDE the jitted training
+program: `lax.scan` over the unroll, vmap over the batch, zero host
+round-trips. This module defines the env protocol and a classic benchmark
+env (Catch, from bsuite) plus the episode-accounting wrapper that produces
+the same EnvOutput fields the learner batch expects (frame, reward, done,
+episode_return, episode_step, last_action).
+
+Protocol (functional, gymnax-style):
+    env.reset(key)            -> state            (pytree)
+    env.step(state, action)   -> (state, frame, reward, done)
+    env.num_actions, env.frame_shape
+Auto-reset lives in the wrapper so `scan` never branches on done.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CatchState(NamedTuple):
+    ball_row: jnp.ndarray  # i32
+    ball_col: jnp.ndarray  # i32
+    paddle_col: jnp.ndarray  # i32
+    key: jnp.ndarray
+
+
+class CatchJax:
+    """Catch (bsuite): a ball falls down a rows x cols board; move the
+    paddle to be under it. Reward +1 on catch, -1 on miss, at episode end
+    (rows - 1 steps). Fully branch-free and jittable."""
+
+    def __init__(self, rows: int = 10, cols: int = 5):
+        self.rows = rows
+        self.cols = cols
+        self.num_actions = 3  # left, stay, right
+        self.frame_shape = (rows, cols, 1)
+
+    def reset(self, key) -> CatchState:
+        key, sub = jax.random.split(key)
+        ball_col = jax.random.randint(sub, (), 0, self.cols)
+        return CatchState(
+            ball_row=jnp.int32(0),
+            ball_col=ball_col.astype(jnp.int32),
+            paddle_col=jnp.int32(self.cols // 2),
+            key=key,
+        )
+
+    def step(self, state: CatchState, action):
+        paddle = jnp.clip(
+            state.paddle_col + action.astype(jnp.int32) - 1, 0, self.cols - 1
+        )
+        ball_row = state.ball_row + 1
+        done = ball_row >= self.rows - 1
+        reward = jnp.where(
+            done,
+            jnp.where(paddle == state.ball_col, 1.0, -1.0),
+            0.0,
+        ).astype(jnp.float32)
+        new_state = CatchState(
+            ball_row=ball_row, ball_col=state.ball_col,
+            paddle_col=paddle, key=state.key,
+        )
+        return new_state, self.observe(new_state), reward, done
+
+    def observe(self, state: CatchState):
+        frame = jnp.zeros((self.rows, self.cols), jnp.uint8)
+        frame = frame.at[
+            jnp.clip(state.ball_row, 0, self.rows - 1), state.ball_col
+        ].set(255)
+        frame = frame.at[self.rows - 1, state.paddle_col].set(255)
+        return frame[..., None]
+
+
+class AccountedState(NamedTuple):
+    env_state: Any
+    episode_return: jnp.ndarray
+    episode_step: jnp.ndarray
+
+
+class JaxEnvironment:
+    """Episode accounting + auto-reset around a jittable env — the
+    on-device analog of envs/environment.py: produces the same EnvOutput
+    dict fields with the same semantics (counters reported WITH the done
+    step; auto-reset before the next step)."""
+
+    def __init__(self, env):
+        self.env = env
+        self.num_actions = env.num_actions
+        self.frame_shape = env.frame_shape
+
+    def initial(self, key) -> Tuple[AccountedState, dict]:
+        env_state = self.env.reset(key)
+        out = {
+            "frame": self.env.observe(env_state),
+            "reward": jnp.float32(0.0),
+            "done": jnp.bool_(True),  # boundary-step convention
+            "episode_return": jnp.float32(0.0),
+            "episode_step": jnp.int32(0),
+            "last_action": jnp.int32(0),
+        }
+        return AccountedState(env_state, jnp.float32(0.0), jnp.int32(0)), out
+
+    def step(self, state: AccountedState, action) -> Tuple[AccountedState, dict]:
+        env_state, frame, reward, done = self.env.step(
+            state.env_state, action
+        )
+        episode_return = state.episode_return + reward
+        episode_step = state.episode_step + 1
+
+        # Auto-reset: compute the reset branch unconditionally (cheap,
+        # branch-free) and select. Counters restart AFTER the done step.
+        reset_state = self.env.reset(env_state.key)
+        next_env_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c), reset_state, env_state
+        )
+        frame = jnp.where(done, self.env.observe(reset_state), frame)
+
+        out = {
+            "frame": frame,
+            "reward": reward,
+            "done": done,
+            "episode_return": episode_return,
+            "episode_step": episode_step,
+            "last_action": action.astype(jnp.int32),
+        }
+        next_state = AccountedState(
+            env_state=next_env_state,
+            episode_return=jnp.where(done, 0.0, episode_return).astype(
+                jnp.float32
+            ),
+            episode_step=jnp.where(done, 0, episode_step).astype(jnp.int32),
+        )
+        return next_state, out
+
+
+_JAX_ENVS = {
+    "Catch": CatchJax,
+}
+
+
+def create_jax_env(name: str, **kwargs) -> JaxEnvironment:
+    try:
+        cls = _JAX_ENVS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown jittable env {name!r}; available: {sorted(_JAX_ENVS)}"
+        ) from None
+    return JaxEnvironment(cls(**kwargs))

@@ -166,6 +166,18 @@ def make_update_step(
     return jax.jit(update_step, donate_argnums=(0, 1) if donate else ())
 
 
+def act_body(model, params, rng, env_output, agent_state):
+    """Unjitted T=1 acting step on `[B, ...]` env outputs: adds/strips the
+    time axis around the time-major model. Shared by make_act_step (jitted
+    host path) and the anakin trainer (called inside its outer jit)."""
+    batched = {k: v[None] for k, v in env_output.items()}
+    out, new_state = model.apply(
+        params, batched, agent_state, rngs={"action": rng}
+    )
+    out = jax.tree_util.tree_map(lambda x: x[0], out)
+    return out, new_state
+
+
 def make_act_step(model):
     """Build the jitted batched acting step.
 
@@ -182,12 +194,7 @@ def make_act_step(model):
 
     @jax.jit
     def act_step(params, rng, env_output, agent_state):
-        batched = {k: v[None] for k, v in env_output.items()}
-        out, new_state = model.apply(
-            params, batched, agent_state, rngs={"action": rng}
-        )
-        out = jax.tree_util.tree_map(lambda x: x[0], out)
-        return out, new_state
+        return act_body(model, params, rng, env_output, agent_state)
 
     return act_step
 

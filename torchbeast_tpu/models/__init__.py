@@ -7,6 +7,7 @@ MonoBeast's AtariNet (monobeast.py:545) and PolyBeast's deep ResNet
 
 from torchbeast_tpu.models.atari_net import AtariNet  # noqa: F401
 from torchbeast_tpu.models.cores import LSTMCore  # noqa: F401
+from torchbeast_tpu.models.mlp import MLPNet  # noqa: F401
 from torchbeast_tpu.models.resnet import ResNet  # noqa: F401
 
 _REGISTRY = {
@@ -14,6 +15,7 @@ _REGISTRY = {
     "atari": AtariNet,
     "deep": ResNet,
     "resnet": ResNet,
+    "mlp": MLPNet,
 }
 
 

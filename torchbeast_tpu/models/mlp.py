@@ -1,0 +1,54 @@
+"""Small MLP policy — for low-dimensional / tiny-frame envs (e.g. the
+jittable Catch env used by the Anakin trainer). Not a reference model
+family (the reference ships only conv nets); same interface: flatten the
+frame, optional reward/last-action inputs, shared RecurrentPolicyHead.
+"""
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
+
+
+class MLPNet(nn.Module):
+    num_actions: int
+    use_lstm: bool = False
+    hidden_sizes: Sequence[int] = (128, 128)
+    dtype: Any = jnp.float32
+
+    @property
+    def core_size(self) -> int:
+        return self.hidden_sizes[-1] + self.num_actions + 1
+
+    @nn.compact
+    def __call__(self, inputs, core_state=(), *, sample_action: bool = True):
+        frame = inputs["frame"]  # [T, B, ...]
+        T, B = frame.shape[:2]
+        x = frame.reshape((T * B, -1)).astype(self.dtype) / 255.0
+        for size in self.hidden_sizes:
+            x = nn.relu(nn.Dense(size, dtype=self.dtype)(x))
+        x = x.astype(jnp.float32)
+
+        one_hot_last_action = jax.nn.one_hot(
+            inputs["last_action"].reshape(T * B), self.num_actions
+        )
+        clipped_reward = jnp.clip(
+            inputs["reward"].astype(jnp.float32), -1, 1
+        ).reshape(T * B, 1)
+        core_input = jnp.concatenate(
+            [x, clipped_reward, one_hot_last_action], axis=-1
+        )
+
+        return RecurrentPolicyHead(
+            num_actions=self.num_actions,
+            use_lstm=self.use_lstm,
+            hidden_size=self.core_size,
+            num_layers=1,
+            name="head",
+        )(core_input, inputs["done"], core_state, T, B, sample_action)
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        return lstm_initial_state(self.use_lstm, 1, self.core_size, batch_size)
