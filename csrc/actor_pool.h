@@ -39,15 +39,17 @@ class ActorPool {
   ActorPool(int64_t unroll_length, std::shared_ptr<LearnerQueue> learner_queue,
             std::shared_ptr<DynamicBatcher> inference_batcher,
             std::vector<std::string> addresses, ArrayNest initial_agent_state,
-            double connect_timeout_s = 600)
+            double connect_timeout_s = 600, int64_t max_reconnects = 0)
       : unroll_length_(unroll_length),
         learner_queue_(std::move(learner_queue)),
         inference_batcher_(std::move(inference_batcher)),
         addresses_(std::move(addresses)),
         initial_agent_state_(std::move(initial_agent_state)),
-        connect_timeout_s_(connect_timeout_s) {}
+        connect_timeout_s_(connect_timeout_s),
+        max_reconnects_(max_reconnects) {}
 
   int64_t count() const { return count_.load(); }
+  int64_t reconnect_count() const { return reconnect_count_.load(); }
 
   // Blocks until every loop exits; rethrows the first error.
   void run() {
@@ -75,22 +77,47 @@ class ActorPool {
 
  private:
   void guarded_loop(const std::string& address) {
-    try {
-      loop(address);
-    } catch (const ClosedBatchingQueue&) {
-      // clean shutdown
-    } catch (const QueueStopped&) {
-      // clean shutdown
-    } catch (const AsyncError&) {
-      // Clean ONLY when the pipeline is shutting down; a broken promise
-      // mid-training (inference failure) is a real error.
-      if (!inference_batcher_->is_closed() && !learner_queue_->is_closed()) {
+    int64_t reconnects = 0;
+    int64_t progress = 0;  // this actor's env steps across reconnects
+    while (true) {
+      int64_t steps_at_connect = progress;
+      try {
+        loop(address, &progress);
+        return;
+      } catch (const ClosedBatchingQueue&) {
+        return;  // clean shutdown
+      } catch (const QueueStopped&) {
+        return;  // clean shutdown
+      } catch (const AsyncError&) {
+        // Clean ONLY when the pipeline is shutting down; a broken promise
+        // mid-training (inference failure) is a real error.
+        if (!inference_batcher_->is_closed() &&
+            !learner_queue_->is_closed()) {
+          std::lock_guard<std::mutex> lock(error_mu_);
+          if (!first_error_) first_error_ = std::current_exception();
+        }
+        return;
+      } catch (const SocketError&) {
+        // Transport failure (env-server death / stream cut): optionally
+        // reconnect with a fresh env + reset agent state. During pipeline
+        // shutdown exit cleanly; a full recovery (>= one unroll streamed
+        // since the last connect) earns the budget back.
+        if (inference_batcher_->is_closed() || learner_queue_->is_closed())
+          return;
+        if (progress - steps_at_connect >= unroll_length_) reconnects = 0;
+        if (reconnects < max_reconnects_) {
+          ++reconnects;
+          reconnect_count_.fetch_add(1);
+          continue;
+        }
         std::lock_guard<std::mutex> lock(error_mu_);
         if (!first_error_) first_error_ = std::current_exception();
+        return;
+      } catch (...) {
+        std::lock_guard<std::mutex> lock(error_mu_);
+        if (!first_error_) first_error_ = std::current_exception();
+        return;
       }
-    } catch (...) {
-      std::lock_guard<std::mutex> lock(error_mu_);
-      if (!first_error_) first_error_ = std::current_exception();
     }
   }
 
@@ -130,7 +157,7 @@ class ActorPool {
     ArrayNest agent;
   };
 
-  void loop(const std::string& address) {
+  void loop(const std::string& address, int64_t* progress) {
     FramedSocket sock;
     sock.connect(address, connect_timeout_s_);
 
@@ -173,6 +200,7 @@ class ActorPool {
       sock.send(wire::ValueNest(std::move(action_msg)));
 
       env_outputs = env_outputs_from(sock.recv());
+      ++(*progress);
       count_.fetch_add(1);
       rollout.push_back({env_outputs, agent_outputs});
 
@@ -225,8 +253,10 @@ class ActorPool {
   const std::vector<std::string> addresses_;
   const ArrayNest initial_agent_state_;
   const double connect_timeout_s_;
+  const int64_t max_reconnects_;
 
   std::atomic<int64_t> count_{0};
+  std::atomic<int64_t> reconnect_count_{0};
   mutable std::mutex error_mu_;
   std::exception_ptr first_error_;
 };

@@ -534,15 +534,15 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
   static const char* kwlist[] = {
       "unroll_length",     "learner_queue", "inference_batcher",
       "env_server_addresses", "initial_agent_state", "connect_timeout_s",
-      nullptr};
-  long long unroll_length = 0;
+      "max_reconnects", nullptr};
+  long long unroll_length = 0, max_reconnects = 0;
   PyObject *queue_obj, *batcher_obj, *addresses_obj, *state_obj;
   double connect_timeout_s = 600;
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "LO!O!OO|d", const_cast<char**>(kwlist),
+          args, kwargs, "LO!O!OO|dL", const_cast<char**>(kwlist),
           &unroll_length, &PyBatchingQueueType, &queue_obj,
           &PyDynamicBatcherType, &batcher_obj, &addresses_obj, &state_obj,
-          &connect_timeout_s))
+          &connect_timeout_s, &max_reconnects))
     return -1;
   std::vector<std::string> addresses;
   PyObject* seq = PySequence_Fast(addresses_obj, "addresses must be a sequence");
@@ -566,7 +566,8 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
         unroll_length,
         reinterpret_cast<PyBatchingQueue*>(queue_obj)->queue,
         reinterpret_cast<PyDynamicBatcher*>(batcher_obj)->batcher,
-        std::move(addresses), std::move(owned), connect_timeout_s);
+        std::move(addresses), std::move(owned), connect_timeout_s,
+        max_reconnects);
     return 0;
   } catch (...) {
     set_py_error();
@@ -582,6 +583,10 @@ PyObject* pool_run(PyActorPool* self, PyObject*) {
 
 PyObject* pool_count(PyActorPool* self, PyObject*) {
   return PyLong_FromLongLong(self->pool->count());
+}
+
+PyObject* pool_reconnect_count(PyActorPool* self, PyObject*) {
+  return PyLong_FromLongLong(self->pool->reconnect_count());
 }
 
 PyObject* pool_first_error_message(PyActorPool* self, PyObject*) {
@@ -608,6 +613,8 @@ PyMethodDef pool_methods[] = {
     {"first_error_message",
      reinterpret_cast<PyCFunction>(pool_first_error_message), METH_NOARGS,
      nullptr},
+    {"reconnect_count", reinterpret_cast<PyCFunction>(pool_reconnect_count),
+     METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject PyActorPoolType = {

@@ -21,22 +21,27 @@ EPISODE_LEN = 5
 T = 3
 
 
-@pytest.fixture
-def server_address():
-    path = os.path.join(tempfile.mkdtemp(), "env_server")
-    address = f"unix:{path}"
-    server = EnvServer(
-        lambda: CountingEnv(episode_length=EPISODE_LEN), address
-    )
-    server.start()
+def start_counting_server(path):
+    """Start an EnvServer on unix:{path} and wait for it to bind."""
     import time
 
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), f"unix:{path}"
+    )
+    server.start()
     deadline = time.monotonic() + 5
     while not os.path.exists(path):
         if time.monotonic() > deadline:
             raise TimeoutError("server did not bind")
         time.sleep(0.01)
-    yield address
+    return server
+
+
+@pytest.fixture
+def server_address():
+    path = os.path.join(tempfile.mkdtemp(), "env_server")
+    server = start_counting_server(path)
+    yield f"unix:{path}"
     server.stop()
 
 
@@ -181,6 +186,52 @@ def test_actor_pool_invariants(server_address):
             batch["action"][1:], batch["last_action"][1:]
         )
         prev = batch
+
+
+def test_actor_reconnects_after_server_restart():
+    """Elastic actors: killing the env server mid-stream and restarting it
+    must not kill the pool when max_reconnects > 0."""
+    path = os.path.join(tempfile.mkdtemp(), "elastic_env")
+    address = f"unix:{path}"
+    server = start_counting_server(path)
+    learner_queue = BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = DynamicBatcher(batch_dim=1, timeout_ms=20)
+    inf_thread = threading.Thread(
+        target=inference_loop,
+        args=(batcher, CountingPolicyServer(), 8),
+        daemon=True,
+    )
+    inf_thread.start()
+
+    pool = ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+        max_reconnects=3,
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+
+    it = iter(learner_queue)
+    next(it)  # at least one rollout through the first connection
+
+    server.stop()  # cut the stream mid-training
+    server = start_counting_server(path)
+
+    # The actor must reconnect and keep producing rollouts.
+    for _ in range(3):
+        next(it)
+    assert pool.errors == []
+    assert pool.reconnects >= 1  # the cut stream really forced a reconnect
+
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    server.stop()
 
 
 def test_env_exception_surfaces():

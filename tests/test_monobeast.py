@@ -58,6 +58,27 @@ def test_test_mode(tmp_path):
     assert all(r == 200.0 for r in returns)
 
 
+def test_mono_learns_catch(tmp_path):
+    """End-to-end learning check on a real task: the sync driver must
+    learn Catch well above chance (~-0.3) within a small frame budget."""
+    flags = monobeast.make_parser().parse_args([
+        "--env", "Catch",
+        "--model", "mlp",
+        "--num_actors", "16",
+        "--batch_size", "16",
+        "--unroll_length", "9",
+        "--total_steps", "80000",
+        "--serial_envs",
+        "--learning_rate", "2e-3",
+        "--entropy_cost", "0.01",
+        "--savedir", str(tmp_path),
+        "--xpid", "catch-learn",
+        "--checkpoint_interval_s", "100000",
+    ])
+    stats = monobeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.5
+
+
 def test_unaligned_actors_rejected(tmp_path):
     flags = make_flags(tmp_path, num_actors="3")
     try:

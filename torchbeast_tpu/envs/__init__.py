@@ -7,7 +7,11 @@ and gets the DeepMind preprocessing stack.
 """
 
 from torchbeast_tpu.envs.environment import Environment  # noqa: F401
-from torchbeast_tpu.envs.mock import CountingEnv, MockEnv  # noqa: F401
+from torchbeast_tpu.envs.mock import (  # noqa: F401
+    CatchEnv,
+    CountingEnv,
+    MockEnv,
+)
 
 
 def num_actions_of(env) -> int:
@@ -23,6 +27,8 @@ def create_env(name: str, **kwargs):
         return MockEnv(**kwargs)
     if name == "Counting":
         return CountingEnv(**kwargs)
+    if name == "Catch":
+        return CatchEnv(**kwargs)
     from torchbeast_tpu.envs.atari import create_atari_env
 
     return create_atari_env(name, **kwargs)

@@ -30,6 +30,46 @@ class MockEnv:
         return frame, 1.0, done
 
 
+class CatchEnv:
+    """Host-side (numpy) Catch — same rules as the jittable CatchJax
+    (envs/jax_env.py): ball falls rows-1 steps; move the paddle under it;
+    +1/-1 at episode end. A real learnable task for end-to-end learning
+    tests of the host drivers (Mock/Counting carry no learnable signal)."""
+
+    def __init__(self, rows=10, cols=5, seed=None):
+        self.rows, self.cols = rows, cols
+        self.num_actions = 3
+        # seed=None: each instance draws OS entropy, so parallel actors
+        # see independent ball trajectories (pass a seed for determinism).
+        self._rng = np.random.default_rng(seed)
+        self._ball_row = 0
+        self._ball_col = 0
+        self._paddle_col = cols // 2
+
+    def _frame(self):
+        frame = np.zeros((self.rows, self.cols, 1), np.uint8)
+        frame[min(self._ball_row, self.rows - 1), self._ball_col, 0] = 255
+        frame[self.rows - 1, self._paddle_col, 0] = 255
+        return frame
+
+    def reset(self):
+        self._ball_row = 0
+        self._ball_col = int(self._rng.integers(0, self.cols))
+        self._paddle_col = self.cols // 2
+        return self._frame()
+
+    def step(self, action):
+        self._paddle_col = int(
+            np.clip(self._paddle_col + int(action) - 1, 0, self.cols - 1)
+        )
+        self._ball_row += 1
+        done = self._ball_row >= self.rows - 1
+        reward = 0.0
+        if done:
+            reward = 1.0 if self._paddle_col == self._ball_col else -1.0
+        return self._frame(), reward, done
+
+
 class CountingEnv:
     """Frame value == step index within the episode; done every N steps.
 

@@ -64,8 +64,9 @@ def make_parser():
     parser.add_argument("--unroll_length", type=int, default=80,
                         help="The unroll length (time dimension).")
     parser.add_argument("--model", default="shallow",
-                        choices=["shallow", "deep"],
-                        help="Model family (Mono used shallow; Poly deep).")
+                        choices=["shallow", "deep", "mlp"],
+                        help="Model family (Mono used shallow; Poly deep; "
+                             "mlp for tiny frames).")
     parser.add_argument("--use_lstm", action="store_true",
                         help="Use LSTM in the agent model.")
     parser.add_argument("--model_dtype", default="float32",

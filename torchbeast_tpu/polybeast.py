@@ -74,7 +74,7 @@ def make_parser():
     parser.add_argument("--batch_size", type=int, default=8)
     parser.add_argument("--unroll_length", type=int, default=80)
     parser.add_argument("--model", default="deep",
-                        choices=["shallow", "deep"])
+                        choices=["shallow", "deep", "mlp"])
     parser.add_argument("--use_lstm", action="store_true")
     parser.add_argument("--model_dtype", default="float32",
                         choices=["float32", "bfloat16"],
@@ -100,6 +100,10 @@ def make_parser():
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
+    parser.add_argument("--max_actor_reconnects", type=int, default=0,
+                        help="Elastic actors: reconnect up to N times per "
+                             "actor on env-server transport failure "
+                             "(0 = fail fast like the reference).")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600)
     # Loss / optimizer (same knobs as monobeast).
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
@@ -279,6 +283,7 @@ def train(flags):
         inference_batcher=inference_batcher,
         env_server_addresses=addresses,
         initial_agent_state=model.initial_state(1),
+        max_reconnects=flags.max_actor_reconnects,
     )
     actor_thread = threading.Thread(
         target=actors.run, daemon=True, name="actorpool"

@@ -57,6 +57,7 @@ class ActorPool:
         env_server_addresses: List[str],
         initial_agent_state: Any,
         connect_timeout_s: float = 600,
+        max_reconnects: int = 0,
     ):
         self._unroll_length = unroll_length
         self._learner_queue = learner_queue
@@ -64,7 +65,14 @@ class ActorPool:
         self._addresses = list(env_server_addresses)
         self._initial_agent_state = initial_agent_state
         self._connect_timeout_s = connect_timeout_s
+        # Elastic actors (beyond the reference's fail-fast): on a TRANSPORT
+        # failure (env-server death / stream cut), an actor may reconnect
+        # up to max_reconnects times with a fresh env + reset agent state
+        # (the partial rollout is discarded; learner batches stay valid).
+        # Deterministic env errors (error frames) remain fatal.
+        self._max_reconnects = max_reconnects
         self._count = 0
+        self._reconnects = 0
         self._count_lock = threading.Lock()
         self._errors: List[BaseException] = []
 
@@ -76,6 +84,15 @@ class ActorPool:
     @property
     def errors(self) -> List[BaseException]:
         return list(self._errors)
+
+    @property
+    def reconnects(self) -> int:
+        with self._count_lock:
+            return self._reconnects
+
+    def reconnect_count(self) -> int:
+        """Method form matching the native pool's API."""
+        return self.reconnects
 
     def run(self):
         """Run one loop per address; blocks until all exit. First error is
@@ -95,24 +112,59 @@ class ActorPool:
             raise self._errors[0]
 
     def _guarded_loop(self, index: int, address: str):
-        try:
-            self._loop(index, address)
-        except ClosedBatchingQueue:
-            pass  # clean shutdown (reference actorpool.cc:452-459)
-        except AsyncError as e:
-            # Clean only when the pipeline is actually shutting down; a
-            # broken promise mid-training (inference failure) is real.
-            if (
-                self._inference_batcher.is_closed()
-                or self._learner_queue.is_closed()
-            ):
-                pass
-            else:
+        reconnects = 0
+        progress = [0]  # this actor's env steps (across reconnects)
+        while True:
+            steps_at_connect = progress[0]
+            try:
+                self._loop(index, address, progress)
+                return
+            except ClosedBatchingQueue:
+                return  # clean shutdown (reference actorpool.cc:452-459)
+            except AsyncError as e:
+                # Clean only when the pipeline is actually shutting down;
+                # a broken promise mid-training (inference failure) is real.
+                if (
+                    self._inference_batcher.is_closed()
+                    or self._learner_queue.is_closed()
+                ):
+                    return
                 log.exception("Actor %d (%s) failed", index, address)
                 self._errors.append(e)
-        except BaseException as e:  # noqa: BLE001
-            log.exception("Actor %d (%s) failed", index, address)
-            self._errors.append(e)
+                return
+            except (ConnectionError, TimeoutError, OSError,
+                    wire.WireError) as e:
+                # Transport failure: the env server died or the stream was
+                # cut. During pipeline shutdown that's expected — exit
+                # cleanly instead of burning the reconnect budget against
+                # deliberately-stopped servers.
+                if (
+                    self._inference_batcher.is_closed()
+                    or self._learner_queue.is_closed()
+                ):
+                    return
+                # A full recovery (at least one unroll streamed since the
+                # last connect) earns the budget back — long runs survive
+                # any number of spaced-out server redeploys.
+                if progress[0] - steps_at_connect >= self._unroll_length:
+                    reconnects = 0
+                if reconnects < self._max_reconnects:
+                    reconnects += 1
+                    with self._count_lock:
+                        self._reconnects += 1
+                    log.warning(
+                        "Actor %d (%s): transport failure (%s); "
+                        "reconnect %d/%d",
+                        index, address, e, reconnects, self._max_reconnects,
+                    )
+                    continue
+                log.exception("Actor %d (%s) failed", index, address)
+                self._errors.append(e)
+                return
+            except BaseException as e:  # noqa: BLE001
+                log.exception("Actor %d (%s) failed", index, address)
+                self._errors.append(e)
+                return
 
     def _connect(self, address: str) -> socket.socket:
         """Connect with retries until the deadline (the reference's
@@ -155,7 +207,8 @@ class ActorPool:
             k: np.asarray(msg[k])[None, None] for k in _ENV_KEYS
         }
 
-    def _loop(self, index: int, address: str):
+    def _loop(self, index: int, address: str, progress=None):
+        progress = progress if progress is not None else [0]
         sock = self._connect(address)
         try:
             env_outputs = self._env_outputs(wire.recv_message(sock))
@@ -174,6 +227,7 @@ class ActorPool:
                     sock, {"type": "action", "action": action}
                 )
                 env_outputs = self._env_outputs(wire.recv_message(sock))
+                progress[0] += 1
                 with self._count_lock:
                     self._count += 1
                 rollout.append((env_outputs, agent_outputs))

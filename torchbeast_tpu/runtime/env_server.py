@@ -51,6 +51,8 @@ class EnvServer:
         self._family, self._target = parse_address(address)
         self._sock = None
         self._threads = []
+        self._conns = []
+        self._conns_lock = threading.Lock()
         self._running = False
 
     def run(self):
@@ -73,6 +75,13 @@ class EnvServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 break  # socket closed by stop()
+            # Register the conn BEFORE spawning its thread so a concurrent
+            # stop() can never miss a just-accepted stream.
+            with self._conns_lock:
+                if not self._running:
+                    conn.close()
+                    break
+                self._conns.append(conn)
             t = threading.Thread(
                 target=self._serve_stream, args=(conn,), daemon=True
             )
@@ -89,13 +98,24 @@ class EnvServer:
         self._threads.append(t)
 
     def stop(self):
-        self._running = False
+        with self._conns_lock:
+            self._running = False
         if self._sock is not None:
             try:
                 self._sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             self._sock.close()
+        # Sever live streams too — stop() means stop, and clients with
+        # reconnect enabled treat the cut as a transport failure.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
         if self._family == socket.AF_UNIX:
             try:
                 os.unlink(self._target)
@@ -140,6 +160,9 @@ class EnvServer:
         finally:
             env.close()
             conn.close()
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
 
 
 def serve_once(env_init: Callable, address: str):
