@@ -161,6 +161,19 @@ def test_outputs_carry_no_gradient():
     np.testing.assert_allclose(grads, np.zeros((4, 2)))
 
 
+def test_shape_mismatch_raises():
+    # Reference parity (tests/vtrace_test.py:243-260): inconsistent
+    # time/batch shapes must fail loudly, not broadcast silently.
+    with pytest.raises((ValueError, TypeError), match="[Ss]hape|broadcast"):
+        vtrace.from_importance_weights(
+            log_rhos=jnp.zeros((5, 4)),
+            discounts=jnp.zeros((5, 4)),
+            rewards=jnp.zeros((7, 4)),  # wrong T
+            values=jnp.zeros((5, 4)),
+            bootstrap_value=jnp.zeros((4,)),
+        )
+
+
 def test_jit_and_scan_compile():
     jitted = jax.jit(vtrace.from_importance_weights)
     out = jitted(

@@ -10,3 +10,7 @@ from torchbeast_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     state_sharding,
 )
+from torchbeast_tpu.parallel.tp import (  # noqa: F401
+    dense_kernel_shardings,
+    place_params,
+)

@@ -58,19 +58,26 @@ def initialize_distributed(
 
 
 def make_parallel_update_step(
-    model, optimizer, hp: learner_lib.HParams, mesh, donate: bool = True
+    model, optimizer, hp: learner_lib.HParams, mesh, donate: bool = True,
+    param_shardings: Optional[Any] = None,
 ):
-    """Data-parallel version of learner.make_update_step.
+    """Data/tensor-parallel version of learner.make_update_step.
 
     Same signature and semantics; gradients are averaged over the `data`
     axis implicitly by XLA's all-reduce (sum-reduced losses over a sharded
     batch == the reference's single-learner loss over the full batch).
     donate=False for async drivers whose inference threads hold live
     references to params (see learner.make_update_step).
+
+    param_shardings (optional): a params-pytree of NamedShardings (see
+    parallel/tp.py) to shard weights over the mesh's `model` axis;
+    defaults to fully replicated params. Optimizer state follows the same
+    sharding (optax state mirrors the params structure leaf-wise).
     """
     repl = mesh_lib.replicated(mesh)
     bsh = mesh_lib.batch_sharding(mesh)
     ssh = mesh_lib.state_sharding(mesh)
+    psh = repl if param_shardings is None else param_shardings
 
     def update_step(params, opt_state, batch, initial_agent_state):
         grads, stats = jax.grad(
@@ -85,11 +92,14 @@ def make_parallel_update_step(
         return params, opt_state, stats
 
     # A single NamedSharding acts as a pytree prefix: it applies to every
-    # leaf of the batch dict (all leaves are [T+1, B, ...]).
+    # leaf of the batch dict (all leaves are [T+1, B, ...]). Optimizer
+    # state shardings are left to the compiler (jax.jit infers them from
+    # the params shardings when params are sharded).
+    opt_sh = repl if param_shardings is None else None
     return jax.jit(
         update_step,
-        in_shardings=(repl, repl, bsh, ssh),
-        out_shardings=(repl, repl, repl),
+        in_shardings=(psh, opt_sh, bsh, ssh),
+        out_shardings=(psh, opt_sh, repl),
         donate_argnums=(0, 1) if donate else (),
     )
 

@@ -1,0 +1,41 @@
+"""Tensor-parallel param shardings over the mesh's `model` axis.
+
+The reference has no tensor parallelism (its nets are small conv+LSTM,
+SURVEY.md §2.3) and these nets don't need it either — but the mesh carries
+a `model` axis precisely so wider models can shard without changing the
+training loop. This module derives a params-pytree of NamedShardings:
+matrix kernels shard their OUTPUT dim over `model`; biases and conv
+kernels stay replicated (conv channels here are far below MXU tile sizes).
+XLA inserts the all-gathers/reduce-scatters implied by the shardings — no
+hand-written collectives.
+
+Used by make_parallel_update_step(..., param_shardings=...) and
+demonstrated in __graft_entry__.dryrun_multichip on a (data x model) mesh.
+"""
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def dense_kernel_shardings(mesh: Mesh, params: Any) -> Any:
+    """params-pytree of NamedShardings: 2-D kernels -> P(None, "model"),
+    everything else replicated."""
+    model_size = mesh.shape["model"]
+
+    def rule(leaf):
+        if (
+            model_size > 1
+            and hasattr(leaf, "ndim")
+            and leaf.ndim == 2
+            and leaf.shape[1] % model_size == 0
+        ):
+            return NamedSharding(mesh, P(None, "model"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, params)
+
+
+def place_params(mesh: Mesh, params: Any, shardings: Any) -> Any:
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
