@@ -1,0 +1,171 @@
+"""Transformer policy: shapes, the cache-consistency invariant (batch
+forward == step-by-step forward with carried KV cache), and episode-
+boundary isolation."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.models import TransformerNet, create_model
+
+T, B, A = 6, 2, 4
+FRAME = (8, 8, 1)
+
+
+def make_inputs(seed=0, t=T, done=None):
+    rng = np.random.default_rng(seed)
+    if done is None:
+        done = np.zeros((t, B), bool)
+    return {
+        "frame": jnp.asarray(
+            rng.integers(0, 256, (t, B) + FRAME, dtype=np.uint8)
+        ),
+        "reward": jnp.asarray(rng.standard_normal((t, B)).astype(np.float32)),
+        "done": jnp.asarray(done),
+        "last_action": jnp.asarray(rng.integers(0, A, (t, B))),
+    }
+
+
+def init_model(**kwargs):
+    model = TransformerNet(num_actions=A, **kwargs)
+    inputs = make_inputs()
+    state = model.initial_state(B)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+        inputs,
+        state,
+    )
+    return model, params
+
+
+def test_shapes_and_state():
+    model, params = init_model()
+    inputs = make_inputs()
+    state = model.initial_state(B)
+    out, new_state = model.apply(params, inputs, state, sample_action=False)
+    assert out.policy_logits.shape == (T, B, A)
+    assert out.baseline.shape == (T, B)
+    assert len(new_state) == model.num_layers
+    k, v, valid = new_state[0]
+    assert k.shape == (model.memory_len, B, model.num_heads,
+                       model.d_model // model.num_heads)
+    assert valid.shape == (model.memory_len, B)
+    # After a done-free unroll from empty cache, exactly T entries valid.
+    assert float(np.asarray(valid).sum()) == T * B
+
+
+def _stepwise_logits(model, params, inputs, state, t_total):
+    logits = []
+    for t in range(t_total):
+        sub = {k: v[t : t + 1] for k, v in inputs.items()}
+        out, state = model.apply(params, sub, state, sample_action=False)
+        logits.append(out.policy_logits[0])
+    return np.stack(logits), state
+
+
+def test_batch_forward_matches_stepwise_with_cache():
+    """The defining invariant: running T steps at once equals running one
+    step at a time carrying the KV cache."""
+    model, params = init_model()
+    inputs = make_inputs(seed=3)
+    state = model.initial_state(B)
+    full, _ = model.apply(params, inputs, state, sample_action=False)
+    logits, _ = _stepwise_logits(model, params, inputs, state, T)
+    np.testing.assert_allclose(
+        logits, np.asarray(full.policy_logits), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_batch_matches_stepwise_with_small_memory_and_full_cache():
+    """The hard regime: memory_len < T AND a pre-filled cache — the batch
+    (learner) forward must model the stepwise eviction exactly, or the
+    behavior/target logit pairing silently breaks in training."""
+    model, params = init_model(memory_len=4)  # < T = 6
+    warmup = make_inputs(seed=11)
+    inputs = make_inputs(seed=12)
+
+    state0 = model.initial_state(B)
+    # Fill the cache with a warmup unroll (both paths identically).
+    _, batch_state = model.apply(params, warmup, state0, sample_action=False)
+    full, _ = model.apply(params, inputs, batch_state, sample_action=False)
+
+    _, step_state = model.apply(params, warmup, state0, sample_action=False)
+    logits, _ = _stepwise_logits(model, params, inputs, step_state, T)
+    np.testing.assert_allclose(
+        logits, np.asarray(full.policy_logits), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_stepwise_state_equals_batch_state():
+    """The cache written by one batch forward must equal the cache from T
+    stepwise forwards (it is the next rollout's initial_agent_state)."""
+    model, params = init_model(memory_len=4)
+    inputs = make_inputs(seed=13)
+    state0 = model.initial_state(B)
+    _, batch_state = model.apply(params, inputs, state0, sample_action=False)
+    s = state0
+    for t in range(T):
+        sub = {k: v[t : t + 1] for k, v in inputs.items()}
+        _, s = model.apply(params, sub, s, sample_action=False)
+    for (bk, bv, bval), (sk, sv, sval) in zip(batch_state, s):
+        np.testing.assert_allclose(
+            np.asarray(bk), np.asarray(sk), rtol=2e-4, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(bv), np.asarray(sv), rtol=2e-4, atol=2e-5
+        )
+        np.testing.assert_array_equal(np.asarray(bval), np.asarray(sval))
+
+
+def test_episode_boundary_isolates_past():
+    model, params = init_model()
+    done = np.zeros((T, B), bool)
+    d = 3
+    done[d] = True
+    inputs = make_inputs(seed=5, done=done)
+    state = model.initial_state(B)
+    out1, _ = model.apply(params, inputs, state, sample_action=False)
+
+    # Perturb pre-boundary frames: post-boundary outputs must not move.
+    frames2 = np.asarray(inputs["frame"]).copy()
+    frames2[0] = 0
+    frames2[1] = 255
+    inputs2 = {**inputs, "frame": jnp.asarray(frames2)}
+    out2, _ = model.apply(params, inputs2, state, sample_action=False)
+    np.testing.assert_allclose(
+        np.asarray(out1.policy_logits)[d:],
+        np.asarray(out2.policy_logits)[d:],
+        rtol=1e-5, atol=1e-6,
+    )
+    assert not np.allclose(
+        np.asarray(out1.policy_logits)[:d],
+        np.asarray(out2.policy_logits)[:d],
+    )
+
+
+def test_cache_invalidated_by_done():
+    """A done in unroll k+1 must hide unroll k's cache from later steps."""
+    model, params = init_model()
+    state = model.initial_state(B)
+    # Unroll 1 fills the cache (distinct content per variant).
+    u1a = make_inputs(seed=7)
+    u1b = make_inputs(seed=8)
+    _, state_a = model.apply(params, u1a, state, sample_action=False)
+    _, state_b = model.apply(params, u1b, state, sample_action=False)
+
+    # Unroll 2 starts with done at slot 0: the old cache is invisible.
+    done = np.zeros((T, B), bool)
+    done[0] = True
+    u2 = make_inputs(seed=9, done=done)
+    out_a, _ = model.apply(params, u2, state_a, sample_action=False)
+    out_b, _ = model.apply(params, u2, state_b, sample_action=False)
+    np.testing.assert_allclose(
+        np.asarray(out_a.policy_logits),
+        np.asarray(out_b.policy_logits),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_registry():
+    assert isinstance(create_model("transformer", A), TransformerNet)

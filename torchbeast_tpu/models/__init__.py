@@ -9,6 +9,7 @@ from torchbeast_tpu.models.atari_net import AtariNet  # noqa: F401
 from torchbeast_tpu.models.cores import LSTMCore  # noqa: F401
 from torchbeast_tpu.models.mlp import MLPNet  # noqa: F401
 from torchbeast_tpu.models.resnet import ResNet  # noqa: F401
+from torchbeast_tpu.models.transformer import TransformerNet  # noqa: F401
 
 _REGISTRY = {
     "shallow": AtariNet,
@@ -16,6 +17,7 @@ _REGISTRY = {
     "deep": ResNet,
     "resnet": ResNet,
     "mlp": MLPNet,
+    "transformer": TransformerNet,
 }
 
 
@@ -26,4 +28,9 @@ def create_model(name: str, num_actions: int, use_lstm: bool = False, **kwargs):
         raise ValueError(
             f"Unknown model {name!r}; available: {sorted(_REGISTRY)}"
         ) from None
+    if cls is TransformerNet and use_lstm:
+        raise ValueError(
+            "--use_lstm does not apply to the transformer family (its "
+            "memory is the KV cache); drop the flag"
+        )
     return cls(num_actions=num_actions, use_lstm=use_lstm, **kwargs)

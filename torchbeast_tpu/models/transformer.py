@@ -1,0 +1,195 @@
+"""Transformer policy with episode-aware KV-cache memory.
+
+A long-context model family beyond the reference's conv+LSTM nets: the core
+attends causally over the unroll AND over a rolling key/value cache carried
+across unrolls as the recurrent state (so acting at T=1 still sees up to
+`memory_len` past steps). Episode boundaries are enforced everywhere:
+
+- within the unroll, attention is masked to the current segment
+  (ops/attention.segment_ids_from_done — state "resets where done" exactly
+  like the LSTM cores);
+- cache entries are visible only while NO done has occurred in the unroll
+  up to the query step;
+- the cache written back keeps only entries from the final segment.
+
+Attention is windowed to the last `memory_len` steps via a band mask over
+the combined [cache; unroll] axis — EXACTLY the semantics of stepwise
+acting with rolling cache eviction, so the learner's batch forward and the
+actor's T=1 forwards agree bit-for-bit at any unroll length or cache fill
+(pinned by tests/test_transformer.py). Positions enter through a learned
+RELATIVE bias over offsets 0..memory_len (absolute positions would break
+cache consistency).
+
+The cache pytree uses the framework-wide state convention (batch on axis
+1: k/v [M, B, H, D], valid [M, B]), so the queues/batcher/collectors carry
+it exactly like LSTM state. Sequence-sharded training over a mesh axis can
+swap the in-unroll dense attention for ops/attention.ring_attention.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.models.cores import RecurrentPolicyHead
+from torchbeast_tpu.ops.attention import BIG_NEG, segment_ids_from_done
+
+
+class _Block(nn.Module):
+    d_model: int
+    num_heads: int
+    memory_len: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, cache, mask, offsets):
+        """x: [B, T, d]; cache: (k, v, valid) with k/v [B, M, H, hd];
+        mask: [B, T, M+T] (True = may attend); offsets: [T, M+T] relative
+        distances query_time - key_time in [0, M]. Returns (y, new_k,
+        new_v) where new_k/new_v are this unroll's [B, T, H, hd]."""
+        B, T, _ = x.shape
+        H = self.num_heads
+        hd = self.d_model // H
+
+        h = nn.LayerNorm()(x)
+        q = nn.DenseGeneral((H, hd), name="q", dtype=self.dtype)(h)
+        k = nn.DenseGeneral((H, hd), name="k", dtype=self.dtype)(h)
+        v = nn.DenseGeneral((H, hd), name="v", dtype=self.dtype)(h)
+
+        k_all = jnp.concatenate([cache[0].astype(k.dtype), k], axis=1)
+        v_all = jnp.concatenate([cache[1].astype(v.dtype), v], axis=1)
+
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_all
+        ).astype(jnp.float32) * hd ** -0.5
+        # Learned relative-position bias over offsets 0..M (cache-stable:
+        # positions are relative, so batch and stepwise forwards agree).
+        rel_bias = self.param(
+            "rel_bias", nn.initializers.zeros, (H, self.memory_len + 1)
+        )
+        scores = scores + rel_bias[:, offsets][None]
+        scores = jnp.where(mask[:, None], scores, BIG_NEG)
+        weights = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
+        attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
+        x = x + nn.DenseGeneral(
+            self.d_model, axis=(-2, -1), name="out", dtype=self.dtype
+        )(attended).astype(jnp.float32)
+
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(4 * self.d_model, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, dtype=self.dtype)(h).astype(
+            jnp.float32
+        )
+        return x, k.astype(jnp.float32), v.astype(jnp.float32)
+
+
+class TransformerNet(nn.Module):
+    num_actions: int
+    use_lstm: bool = False  # accepted for registry uniformity; unused
+    num_layers: int = 2
+    d_model: int = 128
+    num_heads: int = 4
+    memory_len: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs, core_state, *, sample_action: bool = True):
+        frame = inputs["frame"]  # [T, B, ...]
+        T, B = frame.shape[:2]
+        M = self.memory_len
+
+        x = frame.reshape((T * B, -1)).astype(self.dtype) / 255.0
+        x = nn.Dense(self.d_model, dtype=self.dtype)(x)
+        one_hot = jax.nn.one_hot(
+            inputs["last_action"].reshape(T * B), self.num_actions
+        )
+        reward = jnp.clip(
+            inputs["reward"].astype(jnp.float32), -1, 1
+        ).reshape(T * B, 1)
+        x = x.astype(jnp.float32) + nn.Dense(self.d_model, name="extras")(
+            jnp.concatenate([reward, one_hot], axis=-1)
+        )
+        x = x.reshape(T, B, self.d_model).transpose(1, 0, 2)  # [B, T, d]
+
+        done = inputs["done"]  # [T, B]
+        seg = segment_ids_from_done(done).T  # [B, T]
+
+        # Times: in-unroll step j has time j; cache slot m (of M, ordered
+        # oldest-first) has time m - M. The STEPWISE semantics (T=1 acting
+        # with rolling eviction) are exactly "query t sees times in
+        # [t - M, t]" — encoding that as a band mask makes the batch
+        # (learner) forward identical to the actor's stepwise forward for
+        # ANY T and cache fill level.
+        q_time = jnp.arange(T)
+        key_time = jnp.concatenate(
+            [jnp.arange(M) - M, jnp.arange(T)]
+        )  # [M + T]
+        offsets = q_time[:, None] - key_time[None, :]  # [T, M+T]
+        band = (offsets >= 0) & (offsets <= M)
+        offsets = jnp.clip(offsets, 0, M)
+
+        # In-unroll mask: band-causal + same segment.
+        same = seg[:, :, None] == seg[:, None, :]
+        seq_mask = band[None, :, M:] & same  # [B, T, T]
+        # Cache mask: band + validity + no done up to the query (cache
+        # precedes slot 0; any done invalidates it from there on).
+        no_done_yet = jnp.cumsum(done.astype(jnp.int32), axis=0).T == 0
+
+        new_state = []
+        for layer in range(self.num_layers):
+            k_cache, v_cache, valid = core_state[layer]
+            # state convention [M, B, ...] -> model-internal [B, M, ...]
+            k_cache_b = k_cache.transpose(1, 0, 2, 3)
+            v_cache_b = v_cache.transpose(1, 0, 2, 3)
+            valid_b = valid.T  # [B, M]
+            cache_mask = (
+                band[None, :, :M]
+                & valid_b[:, None, :].astype(bool)
+                & no_done_yet[:, :, None]
+            )  # [B, T, M]
+            mask = jnp.concatenate([cache_mask, seq_mask], axis=-1)
+            x, k_new, v_new = _Block(
+                d_model=self.d_model, num_heads=self.num_heads,
+                memory_len=M, dtype=self.dtype,
+                name=f"block_{layer}",
+            )(x, (k_cache_b, v_cache_b), mask, offsets)
+
+            # Roll the cache: last M of [old cache; this unroll], validity
+            # restricted to the final segment.
+            final_seg = seg[:, -1:]
+            seq_valid = (seg == final_seg)  # [B, T]
+            old_valid = valid_b.astype(bool) & no_done_yet[:, -1:]
+            k_all = jnp.concatenate([k_cache_b, k_new], axis=1)
+            v_all = jnp.concatenate([v_cache_b, v_new], axis=1)
+            valid_all = jnp.concatenate([old_valid, seq_valid], axis=1)
+            new_state.append((
+                k_all[:, -M:].transpose(1, 0, 2, 3),
+                v_all[:, -M:].transpose(1, 0, 2, 3),
+                valid_all[:, -M:].astype(jnp.float32).T,
+            ))
+
+        x = nn.LayerNorm()(x)
+        core_output = x.transpose(1, 0, 2).reshape(T * B, self.d_model)
+
+        out, _ = RecurrentPolicyHead(
+            num_actions=self.num_actions,
+            use_lstm=False,
+            hidden_size=self.d_model,
+            num_layers=1,
+            name="head",
+        )(core_output, done, (), T, B, sample_action)
+        return out, tuple(new_state)
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        hd = self.d_model // self.num_heads
+        M = self.memory_len
+        return tuple(
+            (
+                jnp.zeros((M, batch_size, self.num_heads, hd), jnp.float32),
+                jnp.zeros((M, batch_size, self.num_heads, hd), jnp.float32),
+                jnp.zeros((M, batch_size), jnp.float32),
+            )
+            for _ in range(self.num_layers)
+        )

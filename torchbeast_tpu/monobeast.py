@@ -64,7 +64,7 @@ def make_parser():
     parser.add_argument("--unroll_length", type=int, default=80,
                         help="The unroll length (time dimension).")
     parser.add_argument("--model", default="shallow",
-                        choices=["shallow", "deep", "mlp"],
+                        choices=["shallow", "deep", "mlp", "transformer"],
                         help="Model family (Mono used shallow; Poly deep; "
                              "mlp for tiny frames).")
     parser.add_argument("--use_lstm", action="store_true",

@@ -1,0 +1,165 @@
+"""Attention ops: dense causal/segment attention and RING attention for
+sequence parallelism.
+
+The reference has no attention at all (conv+LSTM nets, SURVEY.md §5.7);
+long-context support is a first-class goal of this framework, so the core
+op comes with a sequence-parallel formulation from the start:
+
+- `causal_attention`: dense softmax attention with a causal + segment mask
+  (segments from episode-boundary `done` flags, so an agent never attends
+  across episode resets).
+- `ring_attention`: the same computation with the SEQUENCE axis sharded
+  over a mesh axis. Each device holds a T/P block of Q/K/V; K/V blocks
+  rotate around the ring via `lax.ppermute` while queries stay put, and
+  softmax is accumulated online (flash-attention style running max/sum),
+  so no device ever materializes the full [T, T] score matrix or the full
+  K/V. Communication rides neighbor-to-neighbor ICI links.
+
+Equivalence of the two is pinned by tests/test_attention.py on the 8-device
+CPU mesh.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BIG_NEG = -1e30
+
+
+def segment_ids_from_done(done):
+    """[T, B] done flags -> [T, B] segment ids (segments start AT a done
+    step, matching the models' convention that state resets where done is
+    set)."""
+    return jnp.cumsum(done.astype(jnp.int32), axis=0)
+
+
+def causal_attention(q, k, v, segment_ids: Optional[jnp.ndarray] = None):
+    """Dense reference implementation.
+
+    q, k, v: [B, T, H, D]; segment_ids: [B, T] (attend only within the
+    same segment). Returns [B, T, H, D].
+    """
+    T = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        mask = mask & same[:, None]
+    scores = jnp.where(mask, scores, BIG_NEG)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _block_attend(q, k, v, mask, acc, row_max, row_sum):
+    """One online-softmax accumulation step over a K/V block.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [B, Tq, Tk] (True=keep).
+    acc: [B, Tq, H, D]; row_max/row_sum: [B, H, Tq].
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.where(mask[:, None], scores, BIG_NEG)
+
+    block_max = scores.max(axis=-1)
+    new_max = jnp.maximum(row_max, block_max)
+    correction = jnp.exp(row_max - new_max)
+    weights = jnp.exp(scores - new_max[..., None])
+
+    acc = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", weights, v
+    )
+    row_sum = row_sum * correction + weights.sum(axis=-1)
+    return acc, new_max, row_sum
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, axis: str = "data",
+    segment_ids: Optional[jnp.ndarray] = None,
+):
+    """Sequence-parallel causal(+segment) attention.
+
+    q, k, v: [B, T, H, D] GLOBAL arrays sharded along T over `axis` of
+    `mesh` (callers place them; see tests). segment_ids: [B, T] sharded
+    the same way. Returns [B, T, H, D] with the same sharding.
+    """
+    num_blocks = mesh.shape[axis]
+
+    def local_fn(q_blk, k_blk, v_blk, seg_blk):
+        # q_blk: [B, T/P, H, D]; this device holds query block `my_idx`.
+        my_idx = jax.lax.axis_index(axis)
+        B, Tb = q_blk.shape[0], q_blk.shape[1]
+
+        # Global positions of the local queries (for the diagonal mask).
+        q_pos = my_idx * Tb + jnp.arange(Tb)
+
+        acc = jnp.zeros_like(q_blk)
+        # Init the running max WELL ABOVE the mask value: if it started at
+        # BIG_NEG, a fully-masked first block would give scores==row_max
+        # and exp(0)=1 weights for masked entries. Derived from q_blk (not
+        # jnp.full) so the carry is device-varying under shard_map.
+        zeros_bht = q_blk[..., 0].transpose(0, 2, 1) * 0  # [B, H, Tb]
+        row_max = zeros_bht - 1e9
+        row_sum = zeros_bht
+
+        def body(step, carry):
+            # NOTE: every device runs all P steps, including the ~P/2
+            # blocks its causal mask fully rejects (their weights are
+            # exact zeros). A zig-zag block assignment would halve the
+            # wasted FLOPs; left for a perf round — correctness first.
+            acc, row_max, row_sum, k_cur, v_cur, seg_cur = carry
+            kv_idx = (my_idx - step) % num_blocks
+            k_pos = kv_idx * Tb + jnp.arange(Tb)
+
+            causal = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk] global
+            mask = jnp.broadcast_to(causal[None], (B, Tb, Tb))
+            if seg_blk is not None:
+                # seg_cur: [B, Tk] (travels with k/v); seg_blk: [B, Tq].
+                same = seg_blk[:, :, None] == seg_cur[:, None, :]
+                mask = mask & same
+
+            acc, row_max, row_sum = _block_attend(
+                q_blk, k_cur, v_cur, mask, acc, row_max, row_sum
+            )
+
+            # Rotate K/V (and their segment ids) one step around the ring.
+            perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            seg_next = (
+                jax.lax.ppermute(seg_cur, axis, perm)
+                if seg_blk is not None else seg_cur
+            )
+            return acc, row_max, row_sum, k_next, v_next, seg_next
+
+        seg0 = seg_blk if seg_blk is not None else jnp.zeros(
+            (B, Tb), jnp.int32
+        )
+        acc, row_max, row_sum, _, _, _ = jax.lax.fori_loop(
+            0, num_blocks, body,
+            (acc, row_max, row_sum, k_blk, v_blk, seg0),
+        )
+        return acc / row_sum.transpose(0, 2, 1)[..., None]
+
+    from jax import shard_map
+
+    seq = P(None, axis, None, None)
+    seg_spec = P(None, axis)
+    if segment_ids is None:
+        fn = shard_map(
+            lambda q_, k_, v_: local_fn(q_, k_, v_, None),
+            mesh=mesh,
+            in_specs=(seq, seq, seq),
+            out_specs=seq,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, seg_spec),
+        out_specs=seq,
+    )
+    return fn(q, k, v, segment_ids)

@@ -74,7 +74,7 @@ def make_parser():
     parser.add_argument("--batch_size", type=int, default=8)
     parser.add_argument("--unroll_length", type=int, default=80)
     parser.add_argument("--model", default="deep",
-                        choices=["shallow", "deep", "mlp"])
+                        choices=["shallow", "deep", "mlp", "transformer"])
     parser.add_argument("--use_lstm", action="store_true")
     parser.add_argument("--model_dtype", default="float32",
                         choices=["float32", "bfloat16"],
