@@ -92,6 +92,57 @@ def test_unknown_tag_rejected():
         wire.decode(b"\xff")
 
 
+def test_fuzz_random_nests_roundtrip():
+    """Randomized structures/dtypes/shapes survive the wire bit-exact."""
+    rng = np.random.default_rng(2024)
+    dtypes = sorted(wire._DTYPE_CODES, key=str)  # every supported dtype
+
+    def random_value(depth=0):
+        kind = rng.integers(0, 9 if depth < 3 else 6)
+        if kind == 0:
+            return None
+        if kind == 1:
+            return bool(rng.integers(0, 2))
+        if kind == 2:
+            return int(rng.integers(-(2 ** 40), 2 ** 40))
+        if kind == 3:
+            return float(rng.random() * 1e6 - 5e5)
+        if kind == 4:
+            return "".join(chr(rng.integers(32, 1000)) for _ in range(8))
+        if kind == 5:
+            shape = tuple(rng.integers(0, 4, size=rng.integers(0, 4)))
+            dt = dtypes[rng.integers(0, len(dtypes))]
+            # np.asarray: rng.random(()) yields a numpy SCALAR, which the
+            # codec intentionally encodes as a scalar tag; arrays only here.
+            return np.asarray((rng.random(shape) * 100).astype(dt))
+        if kind == 6:
+            return [random_value(depth + 1) for _ in range(rng.integers(0, 4))]
+        return {
+            f"k{i}": random_value(depth + 1)
+            for i in range(rng.integers(0, 4))
+        }
+
+    def check(a, b):
+        if isinstance(a, np.ndarray):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+        elif isinstance(a, list):
+            assert isinstance(b, list) and len(a) == len(b)
+            for x, y in zip(a, b):
+                check(x, y)
+        elif isinstance(a, dict):
+            assert set(a) == set(b)
+            for k in a:
+                check(a[k], b[k])
+        else:
+            # type-exact: bool must not come back as int, int not as float
+            assert type(a) is type(b) and a == b
+
+    for _ in range(200):
+        value = random_value()
+        check(value, roundtrip(value))
+
+
 def test_decoded_arrays_are_views():
     # Zero-copy on decode: the array's memory belongs to the payload.
     arr = np.arange(10, dtype=np.int64)
