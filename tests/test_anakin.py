@@ -78,6 +78,29 @@ def test_anakin_learns_catch(tmp_path):
     assert stats.get("mean_episode_return", -1.0) > 0.5
 
 
+def test_anakin_resume(tmp_path):
+    import csv
+    import pickle
+
+    run_anakin(tmp_path, total_steps=5_000, xpid="anakin-resume")
+    ckpt = tmp_path / "anakin-resume" / "model.ckpt"
+    with open(ckpt, "rb") as f:
+        saved_step = pickle.load(f)["step"]
+    assert saved_step >= 5_000
+
+    with open(tmp_path / "anakin-resume" / "logs.csv") as f:
+        rows_before = len(list(csv.DictReader(f)))
+
+    stats = run_anakin(tmp_path, total_steps=10_000, xpid="anakin-resume")
+    assert stats["step"] >= 10_000
+    # Run 2 RESUMED: its first logged step continues past run 1's
+    # checkpoint instead of restarting near zero.
+    with open(tmp_path / "anakin-resume" / "logs.csv") as f:
+        rows = list(csv.DictReader(f))
+    first_new = int(float(rows[rows_before]["step"]))
+    assert first_new > saved_step
+
+
 def test_anakin_data_parallel(tmp_path):
     stats = run_anakin(
         tmp_path, total_steps=10_000, xpid="anakin-dp", num_devices="4",
