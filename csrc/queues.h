@@ -113,16 +113,23 @@ class BatchingQueue {
     std::vector<Item> items;
     {
       std::unique_lock<std::mutex> lock(mu_);
+      // The timeout bounds the wait for a FULL minimum batch; an empty
+      // queue always blocks untimed for the first item — wait_for in a
+      // loop with an expired (e.g. zero) timeout would busy-spin.
+      std::optional<std::chrono::steady_clock::time_point> deadline;
+      if (timeout_ms_)
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(*timeout_ms_);
       while (true) {
         int64_t rows = 0;
         for (const Item& it : deque_) rows += it.rows;
         if (rows >= min_) break;
         if (closed_) throw QueueStopped("queue closed");
-        if (timeout_ms_) {
-          bool timed_out = can_dequeue_.wait_for(
-                               lock, std::chrono::milliseconds(*timeout_ms_)) ==
-                           std::cv_status::timeout;
-          if (timed_out && !deque_.empty()) break;
+        if (deadline && std::chrono::steady_clock::now() >= *deadline) {
+          if (!deque_.empty()) break;
+          can_dequeue_.wait(lock);
+        } else if (deadline) {
+          can_dequeue_.wait_until(lock, *deadline);
         } else {
           can_dequeue_.wait(lock);
         }

@@ -151,6 +151,77 @@ static void test_wire_roundtrip() {
   std::printf("wire roundtrip ok\n");
 }
 
+// Adversarial frames: the decoder sees untrusted bytes straight off a TCP
+// socket, so dimension fields that would wrap the size computation must be
+// rejected, not used to index out of bounds.
+static void test_wire_malformed() {
+  auto decode_bytes = [](std::vector<uint8_t> bytes) {
+    auto payload = std::make_shared<std::vector<uint8_t>>(std::move(bytes));
+    return wire::decode(payload->data(), payload->size(), payload);
+  };
+  auto put_i64 = [](std::vector<uint8_t>* buf, int64_t x) {
+    for (int i = 0; i < 8; ++i)
+      buf->push_back((static_cast<uint64_t>(x) >> (8 * i)) & 0xff);
+  };
+
+  // Negative dim: i64 dims are attacker-controlled.
+  {
+    std::vector<uint8_t> b{wire::kTagArray, 4 /* f32 */, 1 /* ndim */};
+    put_i64(&b, -8);
+    CHECK_THROWS(decode_bytes(b), wire::WireError);
+  }
+  // Two dims whose product wraps size_t back to something tiny.
+  {
+    std::vector<uint8_t> b{wire::kTagArray, 4, 2};
+    put_i64(&b, int64_t{1} << 62);
+    put_i64(&b, int64_t{1} << 62);
+    b.push_back(0);  // a little "payload" so a wrapped size could "fit"
+    CHECK_THROWS(decode_bytes(b), wire::WireError);
+  }
+  // Single dim so large that numel*itemsize overflows.
+  {
+    std::vector<uint8_t> b{wire::kTagArray, 5 /* f64 */, 1};
+    put_i64(&b, int64_t{1} << 61);
+    CHECK_THROWS(decode_bytes(b), wire::WireError);
+  }
+  // Unknown dtype byte.
+  {
+    std::vector<uint8_t> b{wire::kTagArray, 0x7f, 0};
+    CHECK_THROWS(decode_bytes(b), std::invalid_argument);
+  }
+  // Huge string length must not wrap the bounds check.
+  {
+    std::vector<uint8_t> b{wire::kTagString, 0xff, 0xff, 0xff, 0xff};
+    CHECK_THROWS(decode_bytes(b), wire::WireError);
+  }
+  // Huge list/dict counts must be rejected before any allocation.
+  {
+    std::vector<uint8_t> b{wire::kTagList, 0xff, 0xff, 0xff, 0xff};
+    CHECK_THROWS(decode_bytes(b), wire::WireError);
+  }
+  {
+    std::vector<uint8_t> b{wire::kTagDict, 0xff, 0xff, 0xff, 0xff};
+    CHECK_THROWS(decode_bytes(b), wire::WireError);
+  }
+  // Zero-sized dims stay legal: shape (0, 5) decodes to an empty array,
+  // and a LATER zero dim must not demand bytes for the earlier dims.
+  {
+    std::vector<uint8_t> b{wire::kTagArray, 4, 2};
+    put_i64(&b, 0);
+    put_i64(&b, 5);
+    wire::ValueNest out = decode_bytes(b);
+    CHECK(out.leaf().array.shape() == (std::vector<int64_t>{0, 5}));
+  }
+  {
+    std::vector<uint8_t> b{wire::kTagArray, 4, 2};
+    put_i64(&b, 5);
+    put_i64(&b, 0);
+    wire::ValueNest out = decode_bytes(b);
+    CHECK(out.leaf().array.shape() == (std::vector<int64_t>{5, 0}));
+  }
+  std::printf("wire malformed-frame rejection ok\n");
+}
+
 static void test_batching_queue() {
   CHECK_THROWS(BatchingQueue<int>(0, 0, 1, {}, {}, true),
                std::invalid_argument);
@@ -262,6 +333,7 @@ int main() {
   test_array_concat_slice();
   test_nest_ops();
   test_wire_roundtrip();
+  test_wire_malformed();
   test_batching_queue();
   test_queue_stress();
   test_dynamic_batcher();

@@ -115,7 +115,9 @@ struct Reader {
     return p;
   }
   void need(size_t n) const {
-    if (pos + n > size) throw WireError("wire: truncated payload");
+    // Written as a subtraction so a huge `n` cannot wrap `pos + n` back
+    // into range; `pos <= size` is an invariant of every advance above.
+    if (n > size - pos) throw WireError("wire: truncated payload");
   }
 };
 
@@ -214,12 +216,28 @@ inline ValueNest decode_value(detail::Reader* r) {
     }
     case kTagArray: {
       DType dtype = static_cast<DType>(r->u8());
+      size_t isize = itemsize(dtype);  // throws on unknown dtype byte
       uint8_t ndim = r->u8();
       std::vector<int64_t> shape(ndim);
       for (auto& d : shape) d = r->i64();
-      int64_t numel = 1;
-      for (int64_t d : shape) numel *= d;
-      size_t nbytes = static_cast<size_t>(numel) * itemsize(dtype);
+      // Untrusted dims: reject negatives and anything whose byte count
+      // could not fit in the frame anyway. The remaining payload bounds
+      // the product, so overflow-check against that rather than SIZE_MAX.
+      // Any zero dim makes the array empty regardless of the other dims.
+      bool empty = false;
+      for (int64_t d : shape) {
+        if (d < 0) throw WireError("wire: negative array dim");
+        if (d == 0) empty = true;
+      }
+      const size_t remaining = r->size - r->pos;
+      size_t nbytes = empty ? 0 : isize;
+      if (!empty) {
+        for (int64_t d : shape) {
+          if (nbytes > remaining / static_cast<size_t>(d))
+            throw WireError("wire: array size exceeds payload");
+          nbytes *= static_cast<size_t>(d);
+        }
+      }
       const uint8_t* p = r->bytes(nbytes);
       // Zero-copy: the array aliases the payload buffer via the owner.
       return ValueNest(Value::of(Array(
@@ -227,6 +245,11 @@ inline ValueNest decode_value(detail::Reader* r) {
     }
     case kTagList: {
       uint32_t n = r->u32();
+      // Each element is at least 1 byte, so an honest count is bounded by
+      // the remaining payload — reserve() on a raw attacker u32 would be
+      // a one-frame multi-GB allocation.
+      if (n > r->size - r->pos)
+        throw WireError("wire: list count exceeds payload");
       ValueNest::List out;
       out.reserve(n);
       for (uint32_t i = 0; i < n; ++i) out.push_back(decode_value(r));
@@ -234,6 +257,8 @@ inline ValueNest decode_value(detail::Reader* r) {
     }
     case kTagDict: {
       uint32_t n = r->u32();
+      if (n > r->size - r->pos)
+        throw WireError("wire: dict count exceeds payload");
       ValueNest::Dict out;
       for (uint32_t i = 0; i < n; ++i) {
         uint16_t klen = r->u8();

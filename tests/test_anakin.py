@@ -80,12 +80,13 @@ def test_anakin_learns_catch(tmp_path):
 
 def test_anakin_resume(tmp_path):
     import csv
-    import pickle
+
+    import flax.serialization
 
     run_anakin(tmp_path, total_steps=5_000, xpid="anakin-resume")
     ckpt = tmp_path / "anakin-resume" / "model.ckpt"
     with open(ckpt, "rb") as f:
-        saved_step = pickle.load(f)["step"]
+        saved_step = flax.serialization.msgpack_restore(f.read())["step"]
     assert saved_step >= 5_000
 
     with open(tmp_path / "anakin-resume" / "logs.csv") as f:

@@ -73,6 +73,15 @@ class TestBatchingQueue:
         assert len(payloads) == 1
         assert 0.02 < elapsed < 2.0
 
+    def test_timeout_zero_means_immediate_not_forever(self):
+        # Regression: timeout_ms=0 was treated as falsy -> block forever.
+        queue = BatchingQueue(minimum_batch_size=4, timeout_ms=0)
+        queue.enqueue(np.zeros((1, 1)))
+        t0 = time.monotonic()
+        batch, payloads = queue.dequeue_many()
+        assert time.monotonic() - t0 < 1.0
+        assert len(payloads) == 1
+
     def test_backpressure_blocks_producer(self):
         queue = BatchingQueue(maximum_queue_size=2, minimum_batch_size=1)
         queue.enqueue(np.zeros((1, 1)))

@@ -2,6 +2,8 @@
 regression (tests/contiguous_arrays_test.py: transposed arrays must survive
 the wire intact)."""
 
+import struct
+
 import numpy as np
 import pytest
 
@@ -90,6 +92,39 @@ def test_trailing_garbage_rejected():
 def test_unknown_tag_rejected():
     with pytest.raises(wire.WireError):
         wire.decode(b"\xff")
+
+
+def _array_header(code: int, *dims: int) -> bytes:
+    return (
+        bytes([wire.TAG_ARRAY, code, len(dims)])
+        + b"".join(struct.pack("<q", d) for d in dims)
+    )
+
+
+def test_malformed_array_frames_raise_wire_error():
+    """Adversarial frames off the socket must fail as WireError (the
+    connection-teardown exception), never ValueError/struct.error."""
+    cases = [
+        _array_header(4, -8),  # negative dim
+        _array_header(4, 1 << 62, 1 << 62) + b"\x00",  # product wraps
+        _array_header(5, 1 << 61),  # numel*itemsize overflows
+        _array_header(4, 7),  # size exceeds payload (no data bytes)
+        bytes([wire.TAG_ARRAY, 4, 3]),  # truncated shape
+        bytes([wire.TAG_ARRAY, 0x7F, 0]),  # unknown dtype code
+        bytes([wire.TAG_STRING]) + struct.pack("<I", 0xFFFFFFFF),  # huge len
+        bytes([wire.TAG_INT]) + b"\x01",  # truncated i64
+    ]
+    for payload in cases:
+        with pytest.raises(wire.WireError):
+            wire.decode(payload)
+
+
+@pytest.mark.parametrize("shape", [(0, 5), (5, 0), (3, 0, 1 << 40)])
+def test_zero_dim_in_shape_still_decodes(shape):
+    # A zero dim anywhere makes the array empty — the validator must not
+    # demand bytes for the nonzero dims around it.
+    arr = wire.decode(_array_header(4, *shape))
+    assert arr.shape == shape and arr.size == 0
 
 
 def test_fuzz_random_nests_roundtrip():

@@ -24,6 +24,7 @@ test surface):
 
 import collections
 import threading
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -71,9 +72,15 @@ class BatchingQueue:
             raise ValueError("Max queue size must be >= 1")
         self._batch_dim = batch_dim
         self._min = minimum_batch_size
-        self._max = maximum_batch_size or float("inf")
-        self._timeout_s = timeout_ms / 1000 if timeout_ms else None
-        self._max_queue = maximum_queue_size or float("inf")
+        self._max = (
+            maximum_batch_size if maximum_batch_size is not None else float("inf")
+        )
+        # `is not None`, not truthiness: timeout_ms=0 means "time out
+        # immediately", never "block forever".
+        self._timeout_s = timeout_ms / 1000 if timeout_ms is not None else None
+        self._max_queue = (
+            maximum_queue_size if maximum_queue_size is not None else float("inf")
+        )
         self._check_inputs = check_inputs
 
         self._lock = threading.Lock()
@@ -146,14 +153,28 @@ class BatchingQueue:
         maximum_batch_size rows are concatenated; the first item is always
         taken so an oversized single item can't deadlock the queue."""
         with self._not_empty:
+            # The timeout bounds how long we hold out for a FULL minimum
+            # batch; an empty queue always blocks (there is nothing to
+            # return), so an expired deadline must not busy-spin — we fall
+            # back to an untimed wait for the first item.
+            deadline = (
+                None
+                if self._timeout_s is None
+                else time.monotonic() + self._timeout_s
+            )
             while True:
                 if sum(r for _, _, r in self._deque) >= self._min:
                     break
                 if self._closed:
                     raise StopIteration
-                timed_out = not self._not_empty.wait(timeout=self._timeout_s)
-                if timed_out and self._deque:
-                    break
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        if self._deque:
+                            break
+                        remaining = None
+                self._not_empty.wait(timeout=remaining)
             items = [self._deque.popleft()]
             rows = items[0][2]
             while self._deque and rows + self._deque[0][2] <= self._max:

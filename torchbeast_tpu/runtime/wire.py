@@ -136,7 +136,21 @@ def _decode_value(view: memoryview, offset: int):
         dtype = _CODE_DTYPES.get(code)
         if dtype is None:
             raise WireError(f"Unknown dtype code {code}")
-        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        # Untrusted dims off the socket: reject negatives, and bound the
+        # byte count by the remaining payload before multiplying so a
+        # wrapping product can't pass (mirrors csrc/wire.h). Any zero dim
+        # makes the whole array empty regardless of the other dims.
+        if any(d < 0 for d in shape):
+            raise WireError(f"Negative array dim in {shape}")
+        if 0 in shape:
+            nbytes = 0
+        else:
+            remaining = len(view) - offset
+            nbytes = dtype.itemsize
+            for d in shape:
+                if nbytes > remaining // d:
+                    raise WireError("Array size exceeds payload")
+                nbytes *= d
         arr = np.frombuffer(
             view[offset : offset + nbytes], dtype=dtype
         ).reshape(shape)
@@ -174,8 +188,20 @@ def encode(value: Any) -> bytes:
 
 def decode(payload: bytes) -> Any:
     """Payload bytes (no length prefix) -> value. Arrays are zero-copy
-    views into `payload` (read-only)."""
-    value, offset = _decode_value(memoryview(payload), 0)
+    views into `payload` (read-only).
+
+    Every malformed-frame failure surfaces as WireError: the actor/server
+    recovery paths catch WireError to tear down one connection, so a
+    corrupt frame must never escape as struct.error/ValueError and kill
+    the whole thread instead.
+    """
+    try:
+        value, offset = _decode_value(memoryview(payload), 0)
+    except WireError:
+        raise
+    except (struct.error, ValueError, IndexError, UnicodeDecodeError,
+            OverflowError) as e:
+        raise WireError(f"Malformed frame: {e}") from e
     if offset != len(payload):
         raise WireError(
             f"Trailing garbage: decoded {offset} of {len(payload)} bytes"

@@ -7,11 +7,14 @@ optimizer/scheduler state + flags (+ stats) every 10 minutes and at exit
 (params + opt_state), serialized with flax.serialization msgpack; flags and
 stats ride along in the same file. Atomic write (tmp + rename) so a
 preemption mid-write never corrupts the resume path.
+
+The whole payload is msgpack, never pickle: drivers auto-resume from
+whatever file sits at checkpoint_path, so a tampered savedir must not be
+able to execute code on restart (unlike the reference's torch.load).
 """
 
 import logging
 import os
-import pickle
 from typing import Any, Dict, Optional
 
 import flax.serialization
@@ -42,7 +45,7 @@ def save_checkpoint(
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.write(flax.serialization.msgpack_serialize(payload))
     os.replace(tmp, path)
     log.info("Saved checkpoint to %s (step %d)", path, step)
 
@@ -56,7 +59,15 @@ def load_checkpoint(
 ) -> Dict[str, Any]:
     """Restore onto templates (pytrees with the right structure/shapes)."""
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        raw = f.read()
+    if raw[:1] == b"\x80":  # pickle protocol-2+ magic; msgpack's 0x80 head
+        # byte would mean "empty fixmap" — never a valid whole checkpoint.
+        raise ValueError(
+            f"{path} is a legacy pickle-format checkpoint; checkpoints are "
+            "now msgpack (pickle auto-resume was an arbitrary-code-execution "
+            "risk). Delete it or re-save with the current version."
+        )
+    payload = flax.serialization.msgpack_restore(raw)
     out = {
         "params": flax.serialization.from_bytes(
             params_template, payload["params"]

@@ -47,7 +47,16 @@ def gather_metadata() -> Dict:
     }
     if slurm:
         meta["slurm"] = slurm
-    meta["env"] = dict(os.environ)
+    # Allowlist, not a full environ dump: meta.json lands in every
+    # experiment dir and a blanket copy would spill tokens/credentials.
+    # Keep only the vars that explain how the run behaved.
+    allowed_prefixes = ("SLURM_", "JAX_", "XLA_", "LIBTPU_", "TPU_", "TF_CPP_")
+    allowed_exact = {"HOSTNAME", "USER", "CUDA_VISIBLE_DEVICES", "OMP_NUM_THREADS"}
+    meta["env"] = {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith(allowed_prefixes) or k in allowed_exact
+    }
     return meta
 
 

@@ -1,44 +1,69 @@
 """Online per-section timing profiler.
 
 Same capability as the reference's Timings (/root/reference/torchbeast/core/
-prof.py:32-81): O(1) running mean/variance per named section via Welford's
-update, printable summary with ms +/- std and % share.
+prof.py:32-81) — O(1) running statistics per named section of the driver
+loop, printable summary with ms +/- std and % share — but implemented as
+plain moment accumulators (count, sum, sum of squares) rather than an
+incremental mean/variance recurrence. Sections here are short wall-clock
+spans (ms scale), so the naive sumsq formula has no precision trouble.
 """
 
-import collections
 import timeit
+from typing import Dict
+
+
+class _Moments:
+    __slots__ = ("count", "total", "total_sq")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.total_sq = 0.0
+
+    def add(self, sample: float) -> None:
+        self.count += 1
+        self.total += sample
+        self.total_sq += sample * sample
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    @property
+    def variance(self) -> float:
+        if not self.count:
+            return 0.0
+        m = self.mean
+        # E[x^2] - E[x]^2, clamped: float cancellation can dip epsilon-negative.
+        return max(self.total_sq / self.count - m * m, 0.0)
 
 
 class Timings:
+    """Split-timer: each `time(name)` attributes the span since the previous
+    mark to `name`, like lap times on a stopwatch."""
+
     def __init__(self):
-        self._means = collections.defaultdict(int)
-        self._vars = collections.defaultdict(int)
-        self._counts = collections.defaultdict(int)
+        self._sections: Dict[str, _Moments] = {}
         self.reset()
 
     def reset(self):
-        self.last_time = timeit.default_timer()
+        """Start a fresh lap without attributing the elapsed span."""
+        self._mark = timeit.default_timer()
 
     def time(self, name: str):
         """Record the time since the last reset()/time() call under `name`."""
         now = timeit.default_timer()
-        x = now - self.last_time
-        self.last_time = now
+        section = self._sections.get(name)
+        if section is None:
+            section = self._sections[name] = _Moments()
+        section.add(now - self._mark)
+        self._mark = now
 
-        n = self._counts[name]
-        mean = self._means[name] + (x - self._means[name]) / (n + 1)
-        var = (
-            n * self._vars[name] + n * (self._means[name] - mean) ** 2 + (x - mean) ** 2
-        ) / (n + 1)
-        self._means[name] = mean
-        self._vars[name] = var
-        self._counts[name] = n + 1
+    def means(self) -> Dict[str, float]:
+        return {name: s.mean for name, s in self._sections.items()}
 
-    def means(self):
-        return dict(self._means)
-
-    def stds(self):
-        return {k: v ** 0.5 for k, v in self._vars.items()}
+    def stds(self) -> Dict[str, float]:
+        return {name: s.variance**0.5 for name, s in self._sections.items()}
 
     def summary(self, prefix: str = "") -> str:
         means = self.means()
