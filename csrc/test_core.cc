@@ -244,6 +244,37 @@ static void test_batching_queue() {
   std::printf("batching queue ok\n");
 }
 
+// timeout_ms=0: an immediate timeout returns whatever rows exist, and an
+// EMPTY queue must block idle for the first item instead of busy-spinning
+// wait_for(0) in a loop (regression: pegged a core until an enqueue).
+static void test_batching_queue_timeout_zero() {
+  {
+    BatchingQueue<int> queue(0, 4, 8, int64_t{0}, {}, true);
+    queue.enqueue(ArrayNest(make_array(DType::kI64, {1, 2}, 7)), 7);
+    auto [batch, payloads] = queue.dequeue_many();  // partial, no wait
+    CHECK(payloads == (std::vector<int>{7}));
+  }
+  {
+    BatchingQueue<int> queue(0, 4, 8, int64_t{0}, {}, true);
+    timespec cpu0{}, cpu1{};
+    std::thread consumer([&] {
+      clock_gettime(CLOCK_THREAD_CPUTIME_ID, &cpu0);
+      auto [batch, payloads] = queue.dequeue_many();
+      clock_gettime(CLOCK_THREAD_CPUTIME_ID, &cpu1);
+      CHECK(payloads == (std::vector<int>{1}));
+    });
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    queue.enqueue(ArrayNest(make_array(DType::kI64, {1, 2}, 1)), 1);
+    consumer.join();
+    double cpu_ms = (cpu1.tv_sec - cpu0.tv_sec) * 1e3 +
+                    (cpu1.tv_nsec - cpu0.tv_nsec) / 1e6;
+    // 200ms wall blocked on an empty queue must cost ~0 CPU; a busy-spin
+    // burns the full 200ms.
+    CHECK(cpu_ms < 100.0);
+  }
+  std::printf("batching queue timeout-zero ok\n");
+}
+
 static void test_queue_stress() {
   BatchingQueue<int64_t> queue(0, 1, 16, {}, {}, true);
   constexpr int kProducers = 8, kItems = 200;
@@ -335,6 +366,7 @@ int main() {
   test_wire_roundtrip();
   test_wire_malformed();
   test_batching_queue();
+  test_batching_queue_timeout_zero();
   test_queue_stress();
   test_dynamic_batcher();
   std::printf("ALL NATIVE CORE TESTS PASSED\n");

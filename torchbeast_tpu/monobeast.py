@@ -241,6 +241,29 @@ def train(flags):
     if flags.profile_dir:
         jax.profiler.start_trace(flags.profile_dir)
 
+    # One-iteration-delayed stats fetch: updates for unroll k are
+    # DISPATCHED (async) and the host immediately starts collecting unroll
+    # k+1 — env stepping overlaps the update chain on-device, and the
+    # first act of k+1 picks up the new params through XLA's data
+    # dependency, so policy lag stays exactly zero. The blocking
+    # device_get of k's stats happens after k+1's work is underway.
+    pending = None  # (list of device stats, step after those updates)
+
+    def flush_stats(pending_entry):
+        device_stats, at_step = pending_entry
+        sub_stats = jax.device_get(device_stats)  # one batched transfer
+        agg = {}
+        for key in sub_stats[0]:
+            vals = [float(s[key]) for s in sub_stats]
+            if key in ("episode_returns_sum", "episode_count"):
+                agg[key] = sum(vals)
+            else:
+                agg[key] = sum(vals) / len(vals)
+        out = learner_lib.episode_stat_postprocess(agg)
+        out["step"] = at_step
+        plogger.log(out)
+        return out
+
     try:
         while step < flags.total_steps:
             timings.reset()
@@ -249,9 +272,7 @@ def train(flags):
 
             # Split the [T+1, num_actors] unroll into learner batches of
             # batch_size columns; aggregate stats over ALL sub-batches
-            # (losses averaged, episode sums/counts summed). Stats stay on
-            # device until all sub-updates are dispatched — XLA's async
-            # dispatch overlaps the fetch with the next update.
+            # (losses averaged, episode sums/counts summed).
             device_stats = []
             for i in range(0, B, flags.batch_size):
                 sub = {
@@ -265,19 +286,10 @@ def train(flags):
                 )
                 device_stats.append(train_stats)
                 step += T * flags.batch_size
-            sub_stats = jax.device_get(device_stats)  # one batched transfer
+            if pending is not None:
+                stats = flush_stats(pending)
+            pending = (device_stats, step)
             timings.time("learn")
-
-            agg = {}
-            for key in sub_stats[0]:
-                vals = [float(s[key]) for s in sub_stats]
-                if key in ("episode_returns_sum", "episode_count"):
-                    agg[key] = sum(vals)
-                else:
-                    agg[key] = sum(vals) / len(vals)
-            stats = learner_lib.episode_stat_postprocess(agg)
-            stats["step"] = step
-            plogger.log(stats)
 
             now = time.time()
             if now - last_log_time > 5:
@@ -307,6 +319,9 @@ def train(flags):
                     stats=stats,
                 )
                 last_checkpoint_time = now
+        if pending is not None:
+            stats = flush_stats(pending)
+            pending = None
         successful = True
     except KeyboardInterrupt:
         log.info("Interrupted; saving final checkpoint.")

@@ -58,7 +58,7 @@ def initialize_distributed(
 
 
 def make_parallel_update_step(
-    model, optimizer, hp: learner_lib.HParams, mesh, donate: bool = True,
+    model, optimizer, hp: learner_lib.HParams, mesh, donate=True,
     param_shardings: Optional[Any] = None,
 ):
     """Data/tensor-parallel version of learner.make_update_step.
@@ -66,8 +66,9 @@ def make_parallel_update_step(
     Same signature and semantics; gradients are averaged over the `data`
     axis implicitly by XLA's all-reduce (sum-reduced losses over a sharded
     batch == the reference's single-learner loss over the full batch).
-    donate=False for async drivers whose inference threads hold live
-    references to params (see learner.make_update_step).
+    `donate` is a policy understood by learner.donate_argnums_for: True
+    (params+opt, single-threaded drivers), "opt_and_data" (async drivers —
+    everything but the shared params), or False.
 
     param_shardings (optional): a params-pytree of NamedShardings (see
     parallel/tp.py) to shard weights over the mesh's `model` axis;
@@ -100,7 +101,7 @@ def make_parallel_update_step(
         update_step,
         in_shardings=(psh, opt_sh, bsh, ssh),
         out_shardings=(psh, opt_sh, repl),
-        donate_argnums=(0, 1) if donate else (),
+        donate_argnums=learner_lib.donate_argnums_for(donate),
     )
 
 

@@ -18,6 +18,7 @@ Run (combined, like the reference's polybeast.py launcher):
 import argparse
 import logging
 import os
+import queue as stdlib_queue
 import threading
 import time
 
@@ -172,7 +173,11 @@ def train(flags):
         stats = restored["stats"]
         log.info("Resuming preempted job, current stats:\n%s", stats)
 
-    # donate=False: inference threads hold live references to params.
+    # donate="opt_and_data": params stay undonated (inference threads hold
+    # live references), but opt_state + the dequeued batch buffers are
+    # aliased in-place — most of donation's HBM-traffic savings without
+    # invalidating an in-flight act dispatch. Requires update dispatch and
+    # checkpoint reads of opt_state to share state_lock (they do, below).
     mesh = None
     if flags.num_learner_devices > 1:
         from torchbeast_tpu.parallel import (
@@ -189,7 +194,7 @@ def train(flags):
             )
         mesh = create_mesh(flags.num_learner_devices)
         update_step = make_parallel_update_step(
-            model, optimizer, hp, mesh, donate=False
+            model, optimizer, hp, mesh, donate="opt_and_data"
         )
         params = replicate(mesh, params)
         opt_state = replicate(mesh, opt_state)
@@ -197,8 +202,9 @@ def train(flags):
         log.info("Data-parallel learner over %d devices",
                  flags.num_learner_devices)
     else:
-        update_step = learner_lib.make_update_step(model, optimizer, hp,
-                                                   donate=False)
+        update_step = learner_lib.make_update_step(
+            model, optimizer, hp, donate="opt_and_data"
+        )
         shard = None
     act_step = learner_lib.make_act_step(model)
 
@@ -212,6 +218,10 @@ def train(flags):
         "done": False,
     }
     state_lock = threading.Lock()
+    # Serializes update-step dispatch (which invalidates donated opt_state
+    # buffers) against checkpoint reads of opt_state. Deliberately separate
+    # from state_lock so the inference hot path never waits on a dispatch.
+    donation_lock = threading.Lock()
 
     if flags.native_runtime:
         from torchbeast_tpu.runtime.native import import_native
@@ -291,6 +301,44 @@ def train(flags):
 
     timings = Timings()
 
+    # Host->HBM prefetch (SURVEY §7 hard part #3): a double-buffered stage
+    # between the learner queue and the learner thread. device_put (and
+    # the DP shard placement) is async, so by the time the learner pulls
+    # an item its transfer is already riding behind the previous update's
+    # compute instead of stalling dispatch.
+    prefetch_q = stdlib_queue.Queue(maxsize=2)
+
+    def prefetch_loop():
+        try:
+            for item in learner_queue:
+                batch = item["batch"]
+                initial_agent_state = item["initial_agent_state"]
+                if shard is not None:
+                    batch, initial_agent_state = shard(
+                        batch, initial_agent_state
+                    )
+                else:
+                    batch = jax.device_put(batch)
+                    initial_agent_state = jax.device_put(initial_agent_state)
+                entry = (batch, initial_agent_state)
+                while True:
+                    try:
+                        prefetch_q.put(entry, timeout=1.0)
+                        break
+                    except stdlib_queue.Full:
+                        with state_lock:
+                            if state["done"]:
+                                return
+        except Exception:
+            log.exception("Prefetch thread failed")
+        # No end-sentinel put: the queue may be full of live items the
+        # learner still wants; the learner detects the end by this thread
+        # having exited with the queue drained.
+
+    prefetch_thread = threading.Thread(
+        target=prefetch_loop, daemon=True, name="prefetch"
+    )
+
     def learner_loop():
         try:
             _learner_loop_body()
@@ -301,7 +349,6 @@ def train(flags):
                 state["done"] = True
 
     def _learner_loop_body():
-        queue_iter = iter(learner_queue)
         # One-step-delayed stats fetch: device_get on the PREVIOUS update's
         # stats happens after the current one is dispatched, so the host
         # never stalls XLA's async pipeline (the reference's equivalent
@@ -320,27 +367,32 @@ def train(flags):
             plogger.log(s)
 
         while True:
-            # reset BEFORE blocking so 'dequeue' measures the actual queue
-            # wait (actor starvation shows up here).
+            # reset BEFORE blocking so 'dequeue' measures the actual wait
+            # for a prefetched batch (actor starvation shows up here).
             timings.reset()
             try:
-                item = next(queue_iter)
-            except StopIteration:
-                break
-            batch = item["batch"]
-            initial_agent_state = item["initial_agent_state"]
-            if shard is not None:
-                batch, initial_agent_state = shard(batch, initial_agent_state)
+                batch, initial_agent_state = prefetch_q.get(timeout=1.0)
+            except stdlib_queue.Empty:
+                if not prefetch_thread.is_alive():
+                    break
+                continue
             timings.time("dequeue")
-            with state_lock:
-                params_now, opt_now = state["params"], state["opt_state"]
-            new_params, new_opt, train_stats = update_step(
-                params_now, opt_now, batch, initial_agent_state
-            )
-            with state_lock:
-                state["params"], state["opt_state"] = new_params, new_opt
-                state["step"] += flags.unroll_length * flags.batch_size
-                now_step = state["step"]
+            # Dispatch under donation_lock (NOT state_lock): opt_state is
+            # donated, so the dispatch that invalidates the old opt
+            # buffers must not race a checkpoint's device_get of them —
+            # but dispatch can block behind in-flight compute, and holding
+            # state_lock here would stall every inference thread's params
+            # read for that long. Checkpointing takes donation_lock first.
+            with donation_lock:
+                with state_lock:
+                    params_now, opt_now = state["params"], state["opt_state"]
+                new_params, new_opt, train_stats = update_step(
+                    params_now, opt_now, batch, initial_agent_state
+                )
+                with state_lock:
+                    state["params"], state["opt_state"] = new_params, new_opt
+                    state["step"] += flags.unroll_length * flags.batch_size
+                    now_step = state["step"]
             if pending is not None:
                 flush(pending)
             pending = (train_stats, now_step)
@@ -357,6 +409,7 @@ def train(flags):
     for t in inference_threads:
         t.start()
     actor_thread.start()
+    prefetch_thread.start()
     learner_thread.start()
 
     if flags.profile_dir:
@@ -402,7 +455,7 @@ def train(flags):
                 if "mean_episode_return" in stats_now else "",
             )
             if now - last_checkpoint > flags.checkpoint_interval_s:
-                with state_lock:
+                with donation_lock, state_lock:
                     save_checkpoint(
                         checkpoint_path,
                         params=state["params"],
@@ -429,8 +482,9 @@ def train(flags):
             except RuntimeError:
                 pass
         actor_thread.join(timeout=10)
+        prefetch_thread.join(timeout=10)
         learner_thread.join(timeout=10)
-        with state_lock:
+        with donation_lock, state_lock:
             save_checkpoint(
                 checkpoint_path,
                 params=state["params"],

@@ -60,10 +60,15 @@ class Timings:
         self._mark = now
 
     def means(self) -> Dict[str, float]:
-        return {name: s.mean for name, s in self._sections.items()}
+        # list(...) snapshots atomically (single C call): monitor threads
+        # read while the timed thread may be inserting a new section.
+        return {name: s.mean for name, s in list(self._sections.items())}
 
     def stds(self) -> Dict[str, float]:
-        return {name: s.variance**0.5 for name, s in self._sections.items()}
+        return {
+            name: s.variance**0.5
+            for name, s in list(self._sections.items())
+        }
 
     def summary(self, prefix: str = "") -> str:
         means = self.means()
