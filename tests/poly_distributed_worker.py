@@ -1,0 +1,58 @@
+"""Worker body for the multi-host poly end-to-end test: run the FULL async
+driver (env servers + actors + inference + prefetch + collective learner)
+as one of 2 jax.distributed processes, 2 virtual CPU devices each, over a
+global 4-device mesh.
+
+Invoked by test_distributed.py:
+    poly_distributed_worker.py <proc_id> <coordinator_port> <savedir>
+        <total_steps>
+
+Everything lives under the __main__ guard: the driver spawns env-server
+children with the multiprocessing "spawn" context, which re-imports this
+module — module-level driver code would re-run jax.distributed.initialize
+in every child with a duplicate process id.
+"""
+
+import os
+import sys
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    port = sys.argv[2]
+    savedir = sys.argv[3]
+    total_steps = int(sys.argv[4])
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from torchbeast_tpu import polybeast
+
+    flags = polybeast.make_parser().parse_args([
+        "--env", "Mock",
+        "--xpid", "poly-dist",
+        "--coordinator_address", f"127.0.0.1:{port}",
+        "--num_servers", "2",
+        "--num_learner_devices", "4",
+        "--batch_size", "4",       # global; 2 local rows per host
+        "--unroll_length", "5",
+        "--total_steps", str(total_steps),
+        "--model", "mlp",
+        "--savedir", savedir,
+        "--pipes_basename", f"unix:{savedir}/pipes",
+        "--checkpoint_interval_s", "100000",
+    ])
+    os.environ["TORCHBEAST_NUM_PROCESSES"] = "2"
+    os.environ["TORCHBEAST_PROCESS_ID"] = str(proc_id)
+
+    stats = polybeast.train(flags)
+    print(f"worker {proc_id}: final step {stats.get('step')} OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""The Atari preprocessing stack, executed end-to-end on the dependency-free
+ALE-compatible MiniAtari cabinet (no ale_py in the image). Pins the wrapper
+composition and the EpisodicLife/FireReset semantics the reference vendors
+from baselines (reference atari_wrappers.py:64-118)."""
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu.envs import create_env, num_actions_of
+from torchbeast_tpu.envs.atari import (
+    EpisodicLifeWrapper,
+    FireResetWrapper,
+    create_atari_env,
+)
+from torchbeast_tpu.envs.environment import Environment
+
+ENV_ID = "tbt/MiniAtari-v0"
+
+
+def test_full_stack_output_contract():
+    env = create_atari_env(ENV_ID)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (84, 84, 4)  # HWC, TPU-native NHWC layout
+    assert obs.dtype == np.uint8
+    assert num_actions_of(env) == 4
+    obs2, reward, term, trunc, _ = env.step(0)
+    assert obs2.shape == (84, 84, 4) and obs2.dtype == np.uint8
+    assert isinstance(float(reward), float)
+
+
+def test_wrapper_composition():
+    env = create_atari_env(ENV_ID)
+    chain = []
+    e = env
+    while hasattr(e, "env"):
+        chain.append(type(e).__name__)
+        e = e.env
+    assert "EpisodicLifeWrapper" in chain
+    # MiniAtari advertises FIRE, so FireReset must be applied.
+    assert "FireResetWrapper" in chain
+    assert "AtariPreprocessing" in chain
+    assert "FrameStackObservation" in chain
+    # FireReset must wrap EpisodicLife (fire after EVERY per-life reset).
+    assert chain.index("FireResetWrapper") < chain.index("EpisodicLifeWrapper")
+
+    no_life = create_atari_env(ENV_ID, episodic_life=False)
+    chain = []
+    e = no_life
+    while hasattr(e, "env"):
+        chain.append(type(e).__name__)
+        e = e.env
+    assert "EpisodicLifeWrapper" not in chain
+
+
+def test_episodic_life_done_per_life_but_reset_per_game():
+    env = create_atari_env(ENV_ID, noop_max=0)
+    env.reset(seed=1)
+    ale = env.unwrapped.ale
+
+    per_life_dones = 0
+    start_lives = ale.lives()
+    # NOOP forever: auto-serve drops balls that always miss a centered
+    # paddle eventually; count per-life dones until the game truly resets.
+    for _ in range(3000):
+        _, _, terminated, truncated, _ = env.step(0)
+        if terminated or truncated:
+            per_life_dones += 1
+            env.reset()
+            if ale.lives() == start_lives:
+                break
+    # One done per lost life, and the underlying game replenished lives
+    # only after all of them were gone.
+    assert per_life_dones == start_lives
+    assert ale.lives() == start_lives
+
+
+def test_fire_reset_serves_the_ball():
+    env = create_atari_env(ENV_ID, noop_max=0)
+    env.reset(seed=2)
+    # FireReset pressed FIRE during reset, so the ball is in play without
+    # the agent ever choosing action 1.
+    assert env.unwrapped.ale.in_play
+
+
+def test_environment_adapter_over_full_stack():
+    e = Environment(create_env(ENV_ID))
+    obs = e.initial()
+    assert obs["frame"].shape == (84, 84, 4)
+    rng = np.random.default_rng(3)
+    saw_done = False
+    for _ in range(300):
+        out = e.step(int(rng.integers(0, 4)))
+        assert out["frame"].shape == (84, 84, 4)
+        if out["done"]:
+            saw_done = True
+    assert saw_done  # random play loses lives well within 300 steps
+    e.close()
+
+
+def test_real_atari_id_gives_clear_error_without_ale():
+    pytest.importorskip("gymnasium")
+    try:
+        import ale_py  # noqa: F401
+
+        pytest.skip("ale_py installed; gate not reachable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="ale_py"):
+        create_atari_env("PongNoFrameskip-v4")
+
+
+def test_miniatari_seeded_serve_is_deterministic():
+    a = create_atari_env(ENV_ID, noop_max=0)
+    b = create_atari_env(ENV_ID, noop_max=0)
+    oa, _ = a.reset(seed=7)
+    ob, _ = b.reset(seed=7)
+    np.testing.assert_array_equal(oa, ob)
+    for _ in range(20):
+        sa = a.step(2)
+        sb = b.step(2)
+        np.testing.assert_array_equal(sa[0], sb[0])
+        assert sa[1:3] == sb[1:3]
+
+
+def test_episodic_life_wrapper_unit():
+    """Direct unit semantics on a raw cabinet (no preprocessing)."""
+    import gymnasium
+
+    import torchbeast_tpu.envs.miniatari  # noqa: F401 — registers
+
+    env = EpisodicLifeWrapper(
+        FireResetWrapper(gymnasium.make(ENV_ID, frameskip=1))
+    )
+    env.reset(seed=0)
+    ale = env.unwrapped.ale
+    lives0 = ale.lives()
+    # Hide the paddle in a corner; the ball will eventually miss.
+    terminated = truncated = False
+    for _ in range(5000):
+        _, _, terminated, truncated, _ = env.step(3)
+        if terminated or truncated:
+            break
+    assert terminated  # life loss surfaces as termination
+    assert ale.lives() == lives0 - 1  # but the game is not over
+    assert not env.was_real_done
+    env.reset()
+    assert ale.lives() == lives0 - 1  # soft reset preserved the game

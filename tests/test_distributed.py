@@ -48,3 +48,72 @@ def test_two_process_dp_update_matches_single_device():
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert "matches single-device OK" in out
+
+
+def _run_poly_workers(tmp_path, total_steps, timeout=420):
+    port = _free_port()
+    worker = os.path.join(
+        os.path.dirname(__file__), "poly_distributed_worker.py"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    extra = [
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p
+    ]
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.pathsep.join([repo_root] + extra),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port), str(tmp_path),
+             str(total_steps)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    return outputs
+
+
+def test_poly_driver_two_hosts_end_to_end(tmp_path):
+    """The FULL async driver across 2 jax.distributed processes: each host
+    runs its own env servers/actors/inference, updates are collective over
+    the global 4-device mesh, the lead host checkpoints, and a second
+    launch resumes from that checkpoint."""
+    total = 400  # 20 collective updates of 5*4 global frames
+    outputs = _run_poly_workers(tmp_path, total)
+    for i, out in enumerate(outputs):
+        assert f"worker {i}: final step" in out
+
+    # Host-aware layout: both hosts trained and logged...
+    assert (tmp_path / "poly-dist" / "logs.csv").exists()
+    assert (tmp_path / "poly-dist-host1" / "logs.csv").exists()
+    # ...but only the lead host wrote the checkpoint.
+    ckpt = tmp_path / "poly-dist" / "model.ckpt"
+    assert ckpt.exists()
+    assert not (tmp_path / "poly-dist-host1" / "model.ckpt").exists()
+
+    import flax.serialization
+
+    saved = flax.serialization.msgpack_restore(ckpt.read_bytes())
+    assert saved["step"] >= total
+
+    # Resume: both hosts load the lead's checkpoint and continue.
+    outputs = _run_poly_workers(tmp_path, 2 * total)
+    for out in outputs:
+        assert "Resuming preempted job" in out
+    saved = flax.serialization.msgpack_restore(ckpt.read_bytes())
+    assert saved["step"] >= 2 * total

@@ -87,3 +87,21 @@ def test_unaligned_actors_rejected(tmp_path):
     except ValueError:
         raised = True
     assert raised
+
+
+def test_train_transformer_sequence_parallel(tmp_path):
+    """The transformer trains with its unroll attention running as ring
+    attention over a 4-way `seq` mesh (T+1 = 8 divisible by 4; acting at
+    T=1 falls back to dense with the same params)."""
+    flags = make_flags(
+        tmp_path,
+        xpid="smoke-seqpar",
+        model="transformer",
+        sequence_parallel=4,
+        unroll_length=7,
+        env="Catch",
+        total_steps=56,
+    )
+    stats = monobeast.train(flags)
+    assert stats["step"] >= 56
+    assert np.isfinite(stats["total_loss"])

@@ -93,13 +93,20 @@ def create_atari_env(
     noop_max: int = 30,
 ):
     """Build the full preprocessing stack -> HWC uint8 [84, 84, frame_stack]."""
-    try:
-        import ale_py  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "Atari environments need ale_py; install it or use --env Mock "
-            "for a dependency-free environment."
-        ) from e
+    if env_name.startswith("tbt/"):
+        # Registers the dependency-free ALE-compatible cabinet ids.
+        import torchbeast_tpu.envs.miniatari  # noqa: F401
+    else:
+        try:
+            import ale_py
+
+            gymnasium.register_envs(ale_py)
+        except ImportError as e:
+            raise ImportError(
+                f"Env {env_name!r} needs ale_py; install it, or use "
+                "--env tbt/MiniAtari-v0 (dependency-free Atari-like, same "
+                "preprocessing stack) or --env Mock."
+            ) from e
 
     env = gymnasium.make(env_name, frameskip=1)  # AtariPreprocessing skips
     env = gymnasium.wrappers.AtariPreprocessing(

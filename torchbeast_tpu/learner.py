@@ -142,17 +142,18 @@ def donate_argnums_for(donate) -> tuple:
 
     - True: donate params + opt_state (single-threaded drivers; the update
       is in-place on-device).
-    - "opt_and_data": donate opt_state + batch + initial_agent_state but
-      NOT params. For async drivers: inference threads hold live
-      references to params (donating them would invalidate an in-flight
-      act dispatch), but nothing else reads the optimizer state or a
-      dequeued batch, so those buffers can be aliased — recovering most of
-      the HBM-traffic savings donation exists for. Callers must serialize
-      update dispatch with any host read of opt_state (checkpointing).
+    - "opt_only": donate opt_state but NOT params. For async drivers:
+      inference threads hold live references to params (donating them
+      would invalidate an in-flight act dispatch), but nothing else reads
+      the optimizer state, so its buffers alias the new opt_state output
+      in place. (The batch/agent-state inputs have no matching output to
+      alias, so donating them would buy nothing — XLA donation is strictly
+      input-output buffer aliasing.) Callers must serialize update
+      dispatch with any host read of opt_state (checkpointing).
     - False: donate nothing.
     """
-    if donate == "opt_and_data":
-        return (1, 2, 3)
+    if donate == "opt_only":
+        return (1,)
     if not isinstance(donate, bool):
         # A typo'd policy string must not fall through to the params-
         # donating default — that is the one unsafe option for async
@@ -171,8 +172,8 @@ def make_update_step(
         (new_params, new_opt_state, stats)
 
     `donate` is a policy understood by donate_argnums_for: True (donate
-    params+opt, single-threaded drivers), "opt_and_data" (async drivers —
-    everything but the shared params), or False.
+    params+opt, single-threaded drivers), "opt_only" (async drivers —
+    the shared params stay undonated), or False.
     """
 
     def update_step(params, opt_state, batch, initial_agent_state):

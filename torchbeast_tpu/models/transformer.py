@@ -22,18 +22,31 @@ cache consistency).
 
 The cache pytree uses the framework-wide state convention (batch on axis
 1: k/v [M, B, H, D], valid [M, B]), so the queues/batcher/collectors carry
-it exactly like LSTM state. Sequence-sharded training over a mesh axis can
-swap the in-unroll dense attention for ops/attention.ring_attention.
+it exactly like LSTM state.
+
+Sequence parallelism: construct with `mesh=` (a jax Mesh with a `seq`
+axis) and unrolls whose T is divisible by the axis size run their
+in-unroll attention as RING attention (ops/attention.
+ring_transformer_attention) — K/V blocks rotate over ICI while queries
+stay put, with the band mask, segment mask, relative bias, and KV-cache
+leg softmax-merged online so numerics match the dense path (pinned by
+tests/test_transformer.py::test_ring_path_*). Short unrolls (acting at
+T=1) automatically use the dense path with the SAME parameters, so one
+model serves both.
 """
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead
-from torchbeast_tpu.ops.attention import BIG_NEG, segment_ids_from_done
+from torchbeast_tpu.ops.attention import (
+    BIG_NEG,
+    ring_transformer_attention,
+    segment_ids_from_done,
+)
 
 
 class _Block(nn.Module):
@@ -41,13 +54,18 @@ class _Block(nn.Module):
     num_heads: int
     memory_len: int
     dtype: Any = jnp.float32
+    mesh: Any = None  # set -> ring attention over mesh axis `seq_axis`
+    seq_axis: str = "seq"
 
     @nn.compact
-    def __call__(self, x, cache, mask, offsets):
-        """x: [B, T, d]; cache: (k, v, valid) with k/v [B, M, H, hd];
+    def __call__(self, x, cache, mask, offsets, cache_mask=None, seg=None):
+        """x: [B, T, d]; cache: (k, v) with k/v [B, M, H, hd];
         mask: [B, T, M+T] (True = may attend); offsets: [T, M+T] relative
-        distances query_time - key_time in [0, M]. Returns (y, new_k,
-        new_v) where new_k/new_v are this unroll's [B, T, H, hd]."""
+        distances query_time - key_time in [0, M]. cache_mask [B, T, M]
+        and seg [B, T] feed the ring path (which rebuilds the in-unroll
+        band/segment mask per block instead of materializing [T, T]).
+        Returns (y, new_k, new_v) where new_k/new_v are this unroll's
+        [B, T, H, hd]."""
         B, T, _ = x.shape
         H = self.num_heads
         hd = self.d_model // H
@@ -57,21 +75,44 @@ class _Block(nn.Module):
         k = nn.DenseGeneral((H, hd), name="k", dtype=self.dtype)(h)
         v = nn.DenseGeneral((H, hd), name="v", dtype=self.dtype)(h)
 
-        k_all = jnp.concatenate([cache[0].astype(k.dtype), k], axis=1)
-        v_all = jnp.concatenate([cache[1].astype(v.dtype), v], axis=1)
-
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_all
-        ).astype(jnp.float32) * hd ** -0.5
         # Learned relative-position bias over offsets 0..M (cache-stable:
         # positions are relative, so batch and stepwise forwards agree).
         rel_bias = self.param(
             "rel_bias", nn.initializers.zeros, (H, self.memory_len + 1)
         )
-        scores = scores + rel_bias[:, offsets][None]
-        scores = jnp.where(mask[:, None], scores, BIG_NEG)
-        weights = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
-        attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
+
+        use_ring = (
+            self.mesh is not None
+            and T % self.mesh.shape[self.seq_axis] == 0
+        )
+        if use_ring:
+            # Softmax runs in f32 on both paths; ring also keeps the
+            # einsums f32 (scores never materialize globally, so the
+            # bf16-MXU win matters less than exact online-merge numerics).
+            attended = ring_transformer_attention(
+                q.astype(jnp.float32),
+                k.astype(jnp.float32),
+                v.astype(jnp.float32),
+                cache[0].astype(jnp.float32),
+                cache[1].astype(jnp.float32),
+                cache_mask,
+                rel_bias,
+                self.memory_len,
+                seg,
+                self.mesh,
+                self.seq_axis,
+            ).astype(v.dtype)
+        else:
+            k_all = jnp.concatenate([cache[0].astype(k.dtype), k], axis=1)
+            v_all = jnp.concatenate([cache[1].astype(v.dtype), v], axis=1)
+
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_all
+            ).astype(jnp.float32) * hd ** -0.5
+            scores = scores + rel_bias[:, offsets][None]
+            scores = jnp.where(mask[:, None], scores, BIG_NEG)
+            weights = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
+            attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
         x = x + nn.DenseGeneral(
             self.d_model, axis=(-2, -1), name="out", dtype=self.dtype
         )(attended).astype(jnp.float32)
@@ -93,6 +134,8 @@ class TransformerNet(nn.Module):
     num_heads: int = 4
     memory_len: int = 64
     dtype: Any = jnp.float32
+    mesh: Optional[Any] = None  # sequence-parallel training mesh
+    seq_axis: str = "seq"
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -153,8 +196,12 @@ class TransformerNet(nn.Module):
             x, k_new, v_new = _Block(
                 d_model=self.d_model, num_heads=self.num_heads,
                 memory_len=M, dtype=self.dtype,
+                mesh=self.mesh, seq_axis=self.seq_axis,
                 name=f"block_{layer}",
-            )(x, (k_cache_b, v_cache_b), mask, offsets)
+            )(
+                x, (k_cache_b, v_cache_b), mask, offsets,
+                cache_mask=cache_mask, seg=seg,
+            )
 
             # Roll the cache: last M of [old cache; this unroll], validity
             # restricted to the final segment.

@@ -75,6 +75,14 @@ def make_parser():
                              "the MXU; params and losses stay float32).")
     parser.add_argument("--serial_envs", action="store_true",
                         help="Step envs in-process (tests/cheap envs).")
+    parser.add_argument("--sequence_parallel", type=int, default=0,
+                        help="Shard the transformer's unroll (time) axis "
+                             "over N devices: in-unroll attention runs as "
+                             "ring attention over a `seq` mesh axis "
+                             "(model=transformer only; pick unroll_length "
+                             "so T+1 is divisible by N — short/acting "
+                             "forwards fall back to dense with the same "
+                             "params).")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--checkpoint_interval_s", type=int, default=600,
                         help="Seconds between checkpoints (reference: 10min).")
@@ -149,9 +157,36 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         if getattr(flags, "model_dtype", "float32") == "bfloat16"
         else jnp.float32
     )
+    extra = {}
+    seq_par = getattr(flags, "sequence_parallel", 0)
+    if seq_par and seq_par > 1:
+        if flags.model != "transformer":
+            raise ValueError(
+                "--sequence_parallel needs --model transformer (the "
+                "conv+LSTM families have no sequence-sharded formulation)"
+            )
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) < seq_par:
+            raise ValueError(
+                f"--sequence_parallel {seq_par} but only "
+                f"{len(devices)} devices are visible"
+            )
+        if (flags.unroll_length + 1) % seq_par != 0:
+            # The learner forward sees T = unroll_length + 1 steps; if the
+            # mesh doesn't divide it, the model would silently fall back
+            # to dense attention — the opposite of what the flag asks for.
+            raise ValueError(
+                f"--sequence_parallel {seq_par} requires unroll_length+1 "
+                f"divisible by it (got {flags.unroll_length + 1})"
+            )
+        extra["mesh"] = Mesh(
+            np.asarray(devices[:seq_par]), ("seq",)
+        )
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
-        dtype=dtype,
+        dtype=dtype, **extra,
     )
     dummy = {
         "frame": np.zeros((1, batch_size) + tuple(frame_shape), frame_dtype),
@@ -319,9 +354,6 @@ def train(flags):
                     stats=stats,
                 )
                 last_checkpoint_time = now
-        if pending is not None:
-            stats = flush_stats(pending)
-            pending = None
         successful = True
     except KeyboardInterrupt:
         log.info("Interrupted; saving final checkpoint.")
@@ -330,6 +362,15 @@ def train(flags):
         successful = False
         raise
     finally:
+        # Flush the one-iteration-delayed stats so the final checkpoint
+        # and return value are current even on interrupt (guarded: an
+        # async XLA error may surface here instead of at dispatch).
+        if pending is not None:
+            try:
+                stats = flush_stats(pending)
+            except Exception:
+                log.exception("Could not flush final stats")
+            pending = None
         if flags.profile_dir:
             jax.profiler.stop_trace()
         save_checkpoint(

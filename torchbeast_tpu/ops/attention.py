@@ -54,14 +54,18 @@ def causal_attention(q, k, v, segment_ids: Optional[jnp.ndarray] = None):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
-def _block_attend(q, k, v, mask, acc, row_max, row_sum):
+def _block_attend(q, k, v, mask, acc, row_max, row_sum, bias=None):
     """One online-softmax accumulation step over a K/V block.
 
     q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [B, Tq, Tk] (True=keep).
     acc: [B, Tq, H, D]; row_max/row_sum: [B, H, Tq].
+    bias: optional additive [H, Tq, Tk] (e.g. relative-position bias),
+    applied after scaling, before masking — matching the dense order.
     """
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias[None]
     scores = jnp.where(mask[:, None], scores, BIG_NEG)
 
     block_max = scores.max(axis=-1)
@@ -74,6 +78,58 @@ def _block_attend(q, k, v, mask, acc, row_max, row_sum):
     )
     row_sum = row_sum * correction + weights.sum(axis=-1)
     return acc, new_max, row_sum
+
+
+def _online_softmax_init(q_blk):
+    """(acc, row_max, row_sum) carries for online-softmax accumulation.
+
+    The running max starts WELL ABOVE the mask value: if it started at
+    BIG_NEG, a fully-masked first block would give scores==row_max and
+    exp(0)=1 weights for masked entries. It is derived from q_blk (not
+    jnp.full) so the fori_loop carry is device-varying under shard_map.
+    """
+    acc = jnp.zeros_like(q_blk)
+    zeros_bht = q_blk[..., 0].transpose(0, 2, 1) * 0  # [B, H, Tb]
+    return acc, zeros_bht - 1e9, zeros_bht
+
+
+def _ring_pass(axis, num_blocks, my_idx, q_blk, k_blk, v_blk, seg_blk,
+               carry, mask_bias_fn):
+    """Rotate K/V (+ their segment ids) around the ring, accumulating the
+    online softmax into `carry` = (acc, row_max, row_sum).
+
+    mask_bias_fn(q_pos, k_pos, seg_cur) -> (mask [B?, Tq, Tk], bias or
+    None) builds the per-block mask/bias from GLOBAL positions — the only
+    part that differs between the ring attention variants.
+
+    NOTE: every device runs all P steps, including the ~P/2 blocks its
+    causal mask fully rejects (their weights are exact zeros). A zig-zag
+    block assignment would halve the wasted FLOPs; left for a perf round —
+    correctness first.
+    """
+    Tb = q_blk.shape[1]
+    q_pos = my_idx * Tb + jnp.arange(Tb)
+
+    def body(step, c):
+        acc, row_max, row_sum, k_cur, v_cur, seg_cur = c
+        kv_idx = (my_idx - step) % num_blocks
+        k_pos = kv_idx * Tb + jnp.arange(Tb)
+        mask, bias = mask_bias_fn(q_pos, k_pos, seg_cur)
+        acc, row_max, row_sum = _block_attend(
+            q_blk, k_cur, v_cur, mask, acc, row_max, row_sum, bias=bias
+        )
+        perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+        return (
+            acc, row_max, row_sum,
+            jax.lax.ppermute(k_cur, axis, perm),
+            jax.lax.ppermute(v_cur, axis, perm),
+            jax.lax.ppermute(seg_cur, axis, perm),
+        )
+
+    acc, row_max, row_sum, _, _, _ = jax.lax.fori_loop(
+        0, num_blocks, body, (*carry, k_blk, v_blk, seg_blk)
+    )
+    return acc / row_sum.transpose(0, 2, 1)[..., None]
 
 
 def ring_attention(
@@ -93,56 +149,18 @@ def ring_attention(
         my_idx = jax.lax.axis_index(axis)
         B, Tb = q_blk.shape[0], q_blk.shape[1]
 
-        # Global positions of the local queries (for the diagonal mask).
-        q_pos = my_idx * Tb + jnp.arange(Tb)
-
-        acc = jnp.zeros_like(q_blk)
-        # Init the running max WELL ABOVE the mask value: if it started at
-        # BIG_NEG, a fully-masked first block would give scores==row_max
-        # and exp(0)=1 weights for masked entries. Derived from q_blk (not
-        # jnp.full) so the carry is device-varying under shard_map.
-        zeros_bht = q_blk[..., 0].transpose(0, 2, 1) * 0  # [B, H, Tb]
-        row_max = zeros_bht - 1e9
-        row_sum = zeros_bht
-
-        def body(step, carry):
-            # NOTE: every device runs all P steps, including the ~P/2
-            # blocks its causal mask fully rejects (their weights are
-            # exact zeros). A zig-zag block assignment would halve the
-            # wasted FLOPs; left for a perf round — correctness first.
-            acc, row_max, row_sum, k_cur, v_cur, seg_cur = carry
-            kv_idx = (my_idx - step) % num_blocks
-            k_pos = kv_idx * Tb + jnp.arange(Tb)
-
+        def mask_bias(q_pos, k_pos, seg_cur):
             causal = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk] global
             mask = jnp.broadcast_to(causal[None], (B, Tb, Tb))
-            if seg_blk is not None:
+            if segment_ids is not None:
                 # seg_cur: [B, Tk] (travels with k/v); seg_blk: [B, Tq].
-                same = seg_blk[:, :, None] == seg_cur[:, None, :]
-                mask = mask & same
+                mask = mask & (seg_blk[:, :, None] == seg_cur[:, None, :])
+            return mask, None
 
-            acc, row_max, row_sum = _block_attend(
-                q_blk, k_cur, v_cur, mask, acc, row_max, row_sum
-            )
-
-            # Rotate K/V (and their segment ids) one step around the ring.
-            perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            seg_next = (
-                jax.lax.ppermute(seg_cur, axis, perm)
-                if seg_blk is not None else seg_cur
-            )
-            return acc, row_max, row_sum, k_next, v_next, seg_next
-
-        seg0 = seg_blk if seg_blk is not None else jnp.zeros(
-            (B, Tb), jnp.int32
+        return _ring_pass(
+            axis, num_blocks, my_idx, q_blk, k_blk, v_blk, seg_blk,
+            _online_softmax_init(q_blk), mask_bias,
         )
-        acc, row_max, row_sum, _, _, _ = jax.lax.fori_loop(
-            0, num_blocks, body,
-            (acc, row_max, row_sum, k_blk, v_blk, seg0),
-        )
-        return acc / row_sum.transpose(0, 2, 1)[..., None]
 
     from jax import shard_map
 
@@ -150,7 +168,13 @@ def ring_attention(
     seg_spec = P(None, axis)
     if segment_ids is None:
         fn = shard_map(
-            lambda q_, k_, v_: local_fn(q_, k_, v_, None),
+            # Dummy seg ids, unread by mask_bias; derived from q (not
+            # jnp.zeros) so they are device-VARYING — ppermute in the ring
+            # body outputs varying arrays and the loop carry types must
+            # match.
+            lambda q_, k_, v_: local_fn(
+                q_, k_, v_, (q_[..., 0, 0] * 0).astype(jnp.int32)
+            ),
             mesh=mesh,
             in_specs=(seq, seq, seq),
             out_specs=seq,
@@ -163,3 +187,72 @@ def ring_attention(
         out_specs=seq,
     )
     return fn(q, k, v, segment_ids)
+
+
+def ring_transformer_attention(
+    q, k, v, cache_k, cache_v, cache_mask, rel_bias, memory_len: int,
+    segment_ids, mesh: Mesh, axis: str = "seq",
+):
+    """Sequence-parallel version of the transformer policy's in-unroll
+    attention (models/transformer.py _Block): band-causal windowing to the
+    last `memory_len` steps, segment masking, learned relative-position
+    bias, AND attention into the rolling KV cache — softmax-merged online
+    so the numerics match the dense path exactly (pinned by
+    tests/test_transformer.py::test_ring_path_matches_dense_* ).
+
+    The unroll axis T is sharded over `axis`; each device's query block
+    first attends the (replicated, M-entry) cache locally, then in-unroll
+    K/V blocks rotate around the ring via ppermute. The cache leg needs no
+    communication because M << T and every query may need any slot.
+
+    q, k, v:      [B, T, H, D] global, sharded along T.
+    cache_k/v:    [B, M, H, D] replicated.
+    cache_mask:   [B, T, M] bool — band+validity+no-done, exactly the
+                  dense model's cache mask (sharded along T).
+    rel_bias:     [H, M+1] learned bias over offsets 0..M.
+    segment_ids:  [B, T] int, sharded along T.
+    Returns [B, T, H, D], sharded along T.
+    """
+    num_blocks = mesh.shape[axis]
+    M = memory_len
+
+    def local_fn(q_blk, k_blk, v_blk, seg_blk, c_k, c_v, c_mask, bias_tbl):
+        my_idx = jax.lax.axis_index(axis)
+        Tb = q_blk.shape[1]
+        q_pos = my_idx * Tb + jnp.arange(Tb)
+
+        # Cache leg (local): slot m has global time m - M, so the offset
+        # of query t to slot m is t + M - m; the band/validity are already
+        # folded into c_mask by the caller.
+        cache_offsets = q_pos[:, None] + M - jnp.arange(M)[None, :]
+        cache_bias = bias_tbl[:, jnp.clip(cache_offsets, 0, M)]
+        carry = _block_attend(
+            q_blk, c_k, c_v, c_mask, *_online_softmax_init(q_blk),
+            bias=cache_bias,
+        )
+
+        def mask_bias(q_pos, k_pos, seg_cur):
+            offsets = q_pos[:, None] - k_pos[None, :]  # [Tq, Tk] global
+            band = (offsets >= 0) & (offsets <= M)
+            same = seg_blk[:, :, None] == seg_cur[:, None, :]
+            return band[None] & same, bias_tbl[:, jnp.clip(offsets, 0, M)]
+
+        return _ring_pass(
+            axis, num_blocks, my_idx, q_blk, k_blk, v_blk, seg_blk,
+            carry, mask_bias,
+        )
+
+    from jax import shard_map
+
+    seq = P(None, axis, None, None)
+    repl4 = P(None, None, None, None)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            seq, seq, seq, P(None, axis), repl4, repl4,
+            P(None, axis, None), P(None, None),
+        ),
+        out_specs=seq,
+    )
+    return fn(q, k, v, segment_ids, cache_k, cache_v, cache_mask, rel_bias)

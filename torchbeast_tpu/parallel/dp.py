@@ -67,8 +67,8 @@ def make_parallel_update_step(
     axis implicitly by XLA's all-reduce (sum-reduced losses over a sharded
     batch == the reference's single-learner loss over the full batch).
     `donate` is a policy understood by learner.donate_argnums_for: True
-    (params+opt, single-threaded drivers), "opt_and_data" (async drivers —
-    everything but the shared params), or False.
+    (params+opt, single-threaded drivers), "opt_only" (async drivers —
+    the shared params stay undonated), or False.
 
     param_shardings (optional): a params-pytree of NamedShardings (see
     parallel/tp.py) to shard weights over the mesh's `model` axis;

@@ -127,26 +127,63 @@ def train(flags):
     # No-ops (with a log line) when no coordinator is configured by flag
     # or TORCHBEAST_COORDINATOR env.
     initialize_distributed(flags.coordinator_address)
+    proc_count = jax.process_count()
+    proc_id = jax.process_index()
+    is_lead = proc_id == 0
+    if proc_count > 1:
+        # Multi-host topology (the reference's per-machine deployment,
+        # polybeast_learner.py:436-444): every host runs its own env
+        # servers + actors + inference, all hosts run the SAME number of
+        # collective update steps over one global mesh, and the lead host
+        # owns logging-dir conventions and checkpoints.
+        if flags.xpid is None:
+            raise ValueError(
+                "multi-host runs need an explicit --xpid (the timestamp "
+                "default would differ per host and break checkpoint "
+                "resume)"
+            )
+        if flags.num_learner_devices <= 1:
+            raise ValueError(
+                "multi-host runs need --num_learner_devices > 1 (each "
+                "host training single-device would silently diverge)"
+            )
+        if flags.num_learner_devices % proc_count != 0:
+            raise ValueError(
+                f"--num_learner_devices {flags.num_learner_devices} must "
+                f"be divisible by the {proc_count} processes"
+            )
+        if flags.batch_size % proc_count != 0:
+            raise ValueError(
+                f"--batch_size {flags.batch_size} (global) must be "
+                f"divisible by the {proc_count} processes"
+            )
+    local_rows = flags.batch_size // proc_count
     if flags.xpid is None:
         flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
     plogger = FileWriter(
-        xpid=flags.xpid, xp_args=vars(flags), rootdir=flags.savedir
+        xpid=flags.xpid if is_lead else f"{flags.xpid}-host{proc_id}",
+        xp_args=vars(flags), rootdir=flags.savedir,
     )
+    # All hosts resume from the LEAD's checkpoint (shared filesystem, as
+    # with the reference's savedir convention).
     checkpoint_path = os.path.join(
         os.path.expanduser(flags.savedir), flags.xpid, "model.ckpt"
     )
 
+    pipes_basename = polybeast_env.host_scoped_basename(
+        flags.pipes_basename, proc_id, flags.num_servers
+    )
     num_actors = flags.num_actors or flags.num_servers
     addresses = [
-        polybeast_env.server_address(
-            flags.pipes_basename, i % flags.num_servers
-        )
+        polybeast_env.server_address(pipes_basename, i % flags.num_servers)
         for i in range(num_actors)
     ]
 
     server_procs = []
     if flags.start_servers:
-        server_procs = polybeast_env.start_servers(flags)
+        server_procs = polybeast_env.start_servers(
+            flags, pipes_basename=pipes_basename
+        )
         time.sleep(0.5)
 
     hp = hparams_from_flags(flags)
@@ -173,11 +210,11 @@ def train(flags):
         stats = restored["stats"]
         log.info("Resuming preempted job, current stats:\n%s", stats)
 
-    # donate="opt_and_data": params stay undonated (inference threads hold
-    # live references), but opt_state + the dequeued batch buffers are
-    # aliased in-place — most of donation's HBM-traffic savings without
-    # invalidating an in-flight act dispatch. Requires update dispatch and
-    # checkpoint reads of opt_state to share state_lock (they do, below).
+    # donate="opt_only": params stay undonated (inference threads hold
+    # live references), but opt_state buffers alias the new opt_state in
+    # place — donation's HBM savings on the optimizer without invalidating
+    # an in-flight act dispatch. Requires update dispatch and checkpoint
+    # reads of opt_state to be serialized (donation_lock, below).
     mesh = None
     if flags.num_learner_devices > 1:
         from torchbeast_tpu.parallel import (
@@ -194,27 +231,39 @@ def train(flags):
             )
         mesh = create_mesh(flags.num_learner_devices)
         update_step = make_parallel_update_step(
-            model, optimizer, hp, mesh, donate="opt_and_data"
+            model, optimizer, hp, mesh, donate="opt_only"
         )
         params = replicate(mesh, params)
         opt_state = replicate(mesh, opt_state)
         shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
-        log.info("Data-parallel learner over %d devices",
-                 flags.num_learner_devices)
+        log.info("Data-parallel learner over %d devices (%d processes)",
+                 flags.num_learner_devices, proc_count)
     else:
         update_step = learner_lib.make_update_step(
-            model, optimizer, hp, donate="opt_and_data"
+            model, optimizer, hp, donate="opt_only"
         )
         shard = None
     act_step = learner_lib.make_act_step(model)
 
+    def local_view(tree):
+        """Single-device view of a replicated global pytree. Multi-host
+        inference and checkpointing must not hand jit/np a global array
+        spanning non-addressable devices — each host acts on its own
+        replica (zero-copy: addressable_data shares the device buffer)."""
+        if proc_count == 1:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: a.addressable_data(0), tree
+        )
+
     # Shared mutable state: the learner rebinds these; inference reads them.
     state = {
         "params": params,
+        "infer_params": local_view(params),
         "opt_state": opt_state,
         "step": step,
         "stats": dict(stats),
-        "rng": jax.random.PRNGKey(flags.seed),
+        "rng": jax.random.PRNGKey(flags.seed + proc_id),
         "done": False,
     }
     state_lock = threading.Lock()
@@ -237,11 +286,13 @@ def train(flags):
     else:
         import torchbeast_tpu.runtime as queue_mod
 
+    # Each host's queue batches its LOCAL rows; shard_batch assembles the
+    # global array across hosts (local_rows == batch_size single-host).
     learner_queue = queue_mod.BatchingQueue(
         batch_dim=1,
-        minimum_batch_size=flags.batch_size,
-        maximum_batch_size=flags.batch_size,
-        maximum_queue_size=flags.max_learner_queue_size or flags.batch_size,
+        minimum_batch_size=local_rows,
+        maximum_batch_size=local_rows,
+        maximum_queue_size=flags.max_learner_queue_size or local_rows,
         check_inputs=True,
     )
     inference_batcher = queue_mod.DynamicBatcher(
@@ -254,7 +305,7 @@ def train(flags):
     def act_fn(env_outputs, agent_state, batch_size):
         """Bucket-static jitted forward (called under the inference lock)."""
         with state_lock:
-            params_now = state["params"]
+            params_now = state["infer_params"]
             state["rng"], key = jax.random.split(state["rng"])
         model_inputs = {
             k: env_outputs[k]
@@ -391,6 +442,8 @@ def train(flags):
                 )
                 with state_lock:
                     state["params"], state["opt_state"] = new_params, new_opt
+                    state["infer_params"] = local_view(new_params)
+                    # Global frames: every host ran this collective update.
                     state["step"] += flags.unroll_length * flags.batch_size
                     now_step = state["step"]
             if pending is not None:
@@ -454,12 +507,12 @@ def train(flags):
                 f"Return {stats_now['mean_episode_return']:.1f}."
                 if "mean_episode_return" in stats_now else "",
             )
-            if now - last_checkpoint > flags.checkpoint_interval_s:
+            if is_lead and now - last_checkpoint > flags.checkpoint_interval_s:
                 with donation_lock, state_lock:
                     save_checkpoint(
                         checkpoint_path,
-                        params=state["params"],
-                        opt_state=state["opt_state"],
+                        params=local_view(state["params"]),
+                        opt_state=local_view(state["opt_state"]),
                         step=state["step"],
                         flags=vars(flags),
                         stats=state["stats"],
@@ -484,15 +537,16 @@ def train(flags):
         actor_thread.join(timeout=10)
         prefetch_thread.join(timeout=10)
         learner_thread.join(timeout=10)
-        with donation_lock, state_lock:
-            save_checkpoint(
-                checkpoint_path,
-                params=state["params"],
-                opt_state=state["opt_state"],
-                step=state["step"],
-                flags=vars(flags),
-                stats=state["stats"],
-            )
+        if is_lead:
+            with donation_lock, state_lock:
+                save_checkpoint(
+                    checkpoint_path,
+                    params=local_view(state["params"]),
+                    opt_state=local_view(state["opt_state"]),
+                    step=state["step"],
+                    flags=vars(flags),
+                    stats=state["stats"],
+                )
         plogger.close(successful=successful)
         for p in server_procs:
             p.terminate()

@@ -41,6 +41,20 @@ def server_address(pipes_basename: str, index: int) -> str:
     return f"{host}:{int(port) + index}"
 
 
+def host_scoped_basename(pipes_basename: str, process_id: int,
+                         num_servers: int) -> str:
+    """Multi-host fan-out: each learner host gets its own address range so
+    its actors connect to its OWN env servers (the reference's per-machine
+    topology, polybeast_learner.py:436-444). unix paths get a -h{pid}
+    suffix; host:port bases step by num_servers per host."""
+    if process_id == 0:
+        return pipes_basename
+    if pipes_basename.startswith("unix:"):
+        return f"{pipes_basename}-h{process_id}"
+    host, _, port = pipes_basename.rpartition(":")
+    return f"{host}:{int(port) + process_id * num_servers}"
+
+
 def _serve(env_name: str, address: str):
     # Child process body. Import here: workers must never inherit JAX state.
     from torchbeast_tpu.envs import create_env
@@ -49,11 +63,12 @@ def _serve(env_name: str, address: str):
     EnvServer(functools.partial(create_env, env_name), address).run()
 
 
-def start_servers(flags, ctx_name: str = "spawn"):
+def start_servers(flags, ctx_name: str = "spawn", pipes_basename=None):
+    basename = pipes_basename or flags.pipes_basename
     ctx = mp.get_context(ctx_name)
     processes = []
     for i in range(flags.num_servers):
-        address = server_address(flags.pipes_basename, i)
+        address = server_address(basename, i)
         p = ctx.Process(
             target=_serve, args=(flags.env, address), daemon=True
         )
