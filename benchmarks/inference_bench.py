@@ -1,0 +1,183 @@
+"""Actor-side inference hot path under load: DynamicBatcher ->
+bucket-padded jitted act, driven by many concurrent fake actors.
+
+Measures what an env-server actor actually experiences: the latency of
+`batcher.compute()` (enqueue -> batched forward -> row slice back), p50
+and p99, plus aggregate steps/s — for each combination of
+{python, native} batcher x {global inference lock, no lock}.
+
+Purpose: decide whether the reference-style global inference lock
+(reference polybeast_learner.py:269, 281-283) costs throughput on this
+runtime, where act_fn is a pure jitted function and params access is
+internally synchronized — the lock's only remaining effect is
+serializing host-side pad/dispatch/device-sync work across inference
+threads.
+
+Run:  python benchmarks/inference_bench.py [--actors 32] [--seconds 5]
+Emits one JSON line per configuration.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--actors", type=int, default=32)
+    parser.add_argument("--seconds", type=float, default=5.0)
+    parser.add_argument("--num_inference_threads", type=int, default=2)
+    parser.add_argument("--max_batch_size", type=int, default=64)
+    parser.add_argument("--model", default="shallow")
+    args = parser.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import numpy as np
+
+    from torchbeast_tpu import learner as learner_lib
+    from torchbeast_tpu.models import create_model
+    from torchbeast_tpu.runtime.inference import inference_loop
+    from torchbeast_tpu.runtime.native import import_native
+    import torchbeast_tpu.runtime as py_runtime
+
+    A = 6
+    model = create_model(args.model, num_actions=A, use_lstm=False)
+    frame = np.zeros((1, 1, 84, 84, 4), np.uint8)
+    dummy = {
+        "frame": frame,
+        "reward": np.zeros((1, 1), np.float32),
+        "done": np.zeros((1, 1), bool),
+        "last_action": np.zeros((1, 1), np.int32),
+    }
+    state0 = model.initial_state(1)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+        dummy,
+        state0,
+    )
+    act_step = learner_lib.make_act_step(model)
+
+    rng_cell = [jax.random.PRNGKey(0)]
+    rng_lock = threading.Lock()
+
+    def act_fn(env_outputs, agent_state, batch_size):
+        with rng_lock:
+            rng_cell[0], key = jax.random.split(rng_cell[0])
+        model_inputs = {
+            k: env_outputs[k][0]
+            for k in ("frame", "reward", "done", "last_action")
+        }
+        out, new_state = act_step(params, key, model_inputs, agent_state)
+        return (
+            {
+                "action": np.asarray(out.action)[None],
+                "policy_logits": np.asarray(out.policy_logits)[None],
+                "baseline": np.asarray(out.baseline)[None],
+            },
+            new_state,
+        )
+
+    def run_config(runtime_name, queue_mod, with_lock):
+        batcher = queue_mod.DynamicBatcher(
+            batch_dim=1,
+            minimum_batch_size=1,
+            maximum_batch_size=args.max_batch_size,
+            timeout_ms=20,
+        )
+        lock = threading.Lock() if with_lock else None
+        servers = [
+            threading.Thread(
+                target=inference_loop,
+                args=(batcher, act_fn, args.max_batch_size),
+                kwargs={"lock": lock},
+                daemon=True,
+            )
+            for _ in range(args.num_inference_threads)
+        ]
+        for t in servers:
+            t.start()
+
+        latencies = []
+        lat_lock = threading.Lock()
+        stop = threading.Event()
+
+        def actor(idx):
+            rng = np.random.default_rng(idx)
+            env = {
+                "frame": rng.integers(
+                    0, 256, (1, 1, 84, 84, 4), dtype=np.uint8
+                ),
+                "reward": np.zeros((1, 1), np.float32),
+                "done": np.zeros((1, 1), bool),
+                "last_action": np.zeros((1, 1), np.int32),
+            }
+            state = model.initial_state(1)
+            mine = []
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                result = batcher.compute({"env": env, "agent_state": state})
+                mine.append(time.perf_counter() - t0)
+                state = result["agent_state"]
+            with lat_lock:
+                latencies.extend(mine)
+
+        actors = [
+            threading.Thread(target=actor, args=(i,), daemon=True)
+            for i in range(args.actors)
+        ]
+        warm_deadline = time.time() + 2.0  # compile the buckets first
+        for t in actors:
+            t.start()
+        while time.time() < warm_deadline:
+            time.sleep(0.1)
+        with lat_lock:
+            latencies.clear()  # drop compile-tainted samples
+        time.sleep(args.seconds)
+        stop.set()
+        for t in actors:
+            t.join(timeout=10)
+        try:
+            batcher.close()
+        except RuntimeError:
+            pass
+        for t in servers:
+            t.join(timeout=10)
+
+        lat = np.sort(np.asarray(latencies))
+        result = {
+            "bench": "inference_hot_path",
+            "runtime": runtime_name,
+            "lock": with_lock,
+            "actors": args.actors,
+            "inference_threads": args.num_inference_threads,
+            "steps_per_sec": round(len(lat) / args.seconds, 1),
+            "p50_ms": round(1000 * float(lat[len(lat) // 2]), 2),
+            "p99_ms": round(1000 * float(lat[int(len(lat) * 0.99)]), 2),
+            "platform": jax.devices()[0].platform,
+        }
+        print(json.dumps(result), flush=True)
+        return result
+
+    configs = [("python", py_runtime)]
+    native = import_native()
+    if native is not None:
+        configs.append(("native", native))
+    else:
+        sys.stderr.write("native runtime not built; python only\n")
+
+    results = []
+    for runtime_name, queue_mod in configs:
+        for with_lock in (True, False):
+            results.append(run_config(runtime_name, queue_mod, with_lock))
+    return results
+
+
+if __name__ == "__main__":
+    main()
