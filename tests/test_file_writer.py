@@ -81,3 +81,24 @@ def test_timings_mean_and_summary():
     summary = t.summary("prefix: ")
     assert "a:" in summary and "b:" in summary and "%" in summary
     assert set(t.stds()) == {"a", "b"}
+
+
+def test_schema_widening_preserves_long_history(tmp_path):
+    """Late-appearing keys patch the header without losing rows (streamed
+    + atomic; regression for the in-memory whole-file rewrite)."""
+    fw = FileWriter(xpid="wide", rootdir=str(tmp_path))
+    for i in range(500):
+        fw.log({"a": i})
+    fw.log({"a": 500, "late_key": 1.5})  # widens after many rows
+    fw.log({"a": 501, "late_key": 2.5})
+
+    with open(tmp_path / "wide" / "logs.csv") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 502
+    assert rows[0]["a"] == "0" and rows[0]["late_key"] in ("", None)
+    assert rows[-1]["late_key"] == "2.5"
+
+    with open(tmp_path / "wide" / "fields.csv") as f:
+        versions = list(csv.reader(f))
+    assert versions[-1][-1] == "late_key"
+    assert len(versions) == 2  # initial schema + one widening

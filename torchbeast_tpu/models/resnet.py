@@ -14,6 +14,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
+from torchbeast_tpu.ops.pool import max_pool2d
 
 
 class ResNetBase(nn.Module):
@@ -34,7 +35,10 @@ class ResNetBase(nn.Module):
         )
         for i, num_ch in enumerate(self.channels):
             x = conv3(num_ch, f"feat_conv_{i}")(x)
-            x = nn.max_pool(
+            # ops.pool.max_pool2d: forward-identical to nn.max_pool, but
+            # its custom VJP avoids SelectAndScatter (10x the forward's
+            # cost on XLA:CPU, slow on some TPU gens) in the backward.
+            x = max_pool2d(
                 x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
             )
             for j in range(2):

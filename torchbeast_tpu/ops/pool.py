@@ -1,0 +1,89 @@
+"""Max pooling with an XLA-friendly backward pass.
+
+The autodiff gradient of `reduce_window(max)` is a SelectAndScatter op,
+which lowers to a mostly-serial scan on XLA:CPU (and a slow path on some
+TPU generations): measured 10x the forward's cost on the IMPALA deep
+trunk's 84x84 pool, making the pool backward the single largest line in
+the learner step's CPU profile.
+
+`max_pool2d` computes the same forward (it IS reduce_window) but defines
+a custom VJP as a sum over the window's kh*kw offsets: dilate the pooled
+output/cotangent back onto the input grid at each offset and credit
+gradient where the input equals the window max — all elementwise ops and
+pads, fully parallel. Measured ~10x faster than SelectAndScatter on the
+trunk shapes (see tests/test_pool.py for numerical parity with the
+autodiff gradient).
+
+Tie semantics: where several inputs in one window tie at the max, the
+cotangent is credited to EVERY tying position (a valid subgradient);
+XLA's SelectAndScatter credits only the first in scan order. Ties are
+measure-zero for conv outputs, so training is unaffected in practice.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Pair = Tuple[int, int]
+
+
+def _reduce_max(x, window: Pair, strides: Pair, padding: Tuple[Pair, Pair]):
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(
+            x.dtype
+        ).min,
+        lax.max,
+        (1, window[0], window[1], 1),
+        (1, strides[0], strides[1], 1),
+        ((0, 0), padding[0], padding[1], (0, 0)),
+    )
+
+
+def _place_on_input_grid(arr, x_shape, offsets, strides, pad_lo, fill):
+    """Place [N, H_out, W_out, C] values at input-grid positions
+    out_idx*stride + offset - pad_lo via one interior-dilated lax.pad
+    (negative edge pads crop out-of-range rows/cols)."""
+    cfg = [(0, 0, 0)]
+    for d in (0, 1):
+        n = arr.shape[1 + d]
+        lo = offsets[d] - pad_lo[d]
+        placed = (n - 1) * strides[d] + 1
+        hi = x_shape[1 + d] - lo - placed
+        cfg.append((lo, hi, strides[d] - 1))
+    cfg.append((0, 0, 0))
+    return lax.pad(arr, jnp.asarray(fill, arr.dtype), cfg)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pool2d(x, window: Pair = (3, 3), strides: Pair = (2, 2),
+               padding: Tuple[Pair, Pair] = ((1, 1), (1, 1))):
+    """NHWC max pooling, forward-identical to flax.linen.max_pool."""
+    return _reduce_max(x, window, strides, padding)
+
+
+def _fwd(x, window, strides, padding):
+    y = _reduce_max(x, window, strides, padding)
+    return y, (x, y)
+
+
+def _bwd(window, strides, padding, residuals, g):
+    x, y = residuals
+    pad_lo = (padding[0][0], padding[1][0])
+    gx = jnp.zeros_like(x)
+    for kh in range(window[0]):
+        for kw in range(window[1]):
+            y_up = _place_on_input_grid(
+                y, x.shape, (kh, kw), strides, pad_lo, jnp.inf
+            )
+            g_up = _place_on_input_grid(
+                g, x.shape, (kh, kw), strides, pad_lo, 0
+            )
+            gx = gx + jnp.where(x == y_up, g_up, jnp.zeros_like(g_up))
+    return (gx,)
+
+
+max_pool2d.defvjp(_fwd, _bwd)

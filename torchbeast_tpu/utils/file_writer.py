@@ -113,18 +113,18 @@ class FileWriter:
 
         self.fieldnames = ["_tick", "_time"]
         if os.path.exists(self.paths["logs"]):
-            # Resume: recover schema and tick counter (reference
-            # file_writer.py:150-168).
-            with open(self.paths["logs"]) as f:
-                reader = csv.reader(f)
-                lines = list(reader)
-            if lines:
-                self.fieldnames = lines[0]
-                if len(lines) > 1:
-                    try:
-                        self._tick = int(lines[-1][0]) + 1
-                    except (ValueError, IndexError):
-                        pass
+            # Resume: recover schema (first line) and tick counter (last
+            # line). Streamed — head + tail only, never the whole file
+            # (multi-GB logs on long runs).
+            with open(self.paths["logs"], newline="") as f:
+                first = next(csv.reader(f), None)
+            if first:
+                self.fieldnames = first
+                last = self._tail_line(self.paths["logs"])
+                try:
+                    self._tick = int(last.split(",", 1)[0]) + 1
+                except (ValueError, AttributeError):
+                    pass  # header-only file, or non-numeric first cell
 
     def log(self, to_log: Dict, tick: Optional[int] = None, verbose: bool = False):
         if tick is not None:
@@ -160,16 +160,30 @@ class FileWriter:
         # file_writer.py:183-189).
         with open(self.paths["fields"], "a") as f:
             csv.writer(f).writerow(self.fieldnames)
-        # Rewrite logs.csv header when the schema widens.
+        # Patch the logs.csv header to the widened schema. Streamed line-
+        # by-line through a temp file + atomic replace: bounded memory on
+        # arbitrarily long runs, and a crash mid-patch can never corrupt
+        # the log. Fieldnames only ever grow, so this runs at most once
+        # per distinct key the run ever logs — not per log() call.
         if os.path.exists(self.paths["logs"]):
-            with open(self.paths["logs"]) as f:
-                lines = list(csv.reader(f))
-            if lines:
-                rows = lines[1:]
-                with open(self.paths["logs"], "w") as f:
-                    writer = csv.writer(f)
-                    writer.writerow(self.fieldnames)
-                    writer.writerows(rows)
+            tmp = self.paths["logs"] + ".tmp"
+            with open(self.paths["logs"]) as src, open(tmp, "w") as dst:
+                csv.writer(dst).writerow(self.fieldnames)
+                next(src, None)  # drop the old (narrower) header line
+                for line in src:
+                    dst.write(line)
+            os.replace(tmp, self.paths["logs"])
+
+    @staticmethod
+    def _tail_line(path, chunk: int = 65536):
+        """Last non-empty line of a text file, reading only its tail."""
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - chunk))
+            tail = f.read().decode("utf-8", errors="replace")
+        lines = [ln for ln in tail.splitlines() if ln.strip()]
+        return lines[-1] if lines else None
 
     def _save_metadata(self):
         with open(self.paths["meta"], "w") as f:
