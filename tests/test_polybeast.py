@@ -99,3 +99,27 @@ def test_polybeast_train_native_feedforward(tmp_path):
     stats = polybeast.train(flags)
     assert stats["step"] >= 60
     assert np.isfinite(stats["total_loss"])
+
+
+def test_poly_transformer_sequence_parallel(tmp_path):
+    """The async driver trains the transformer with ring attention over a
+    4-way seq mesh (unroll+1 = 8 divisible by 4; the T=1 inference path
+    falls back to dense with the same params)."""
+    from torchbeast_tpu import polybeast
+
+    flags = polybeast.make_parser().parse_args([
+        "--env", "Mock",
+        "--xpid", "seqpar",
+        "--num_servers", "2",
+        "--batch_size", "2",
+        "--unroll_length", "7",
+        "--total_steps", "56",
+        "--model", "transformer",
+        "--sequence_parallel", "4",
+        "--savedir", str(tmp_path),
+        "--pipes_basename", f"unix:{tmp_path}/pipes",
+        "--checkpoint_interval_s", "100000",
+    ])
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 56
+    assert np.isfinite(stats["total_loss"])

@@ -56,15 +56,19 @@ class _Block(nn.Module):
     dtype: Any = jnp.float32
     mesh: Any = None  # set -> ring attention over mesh axis `seq_axis`
     seq_axis: str = "seq"
+    attention_impl: str = "dense"  # or "pallas": fused single-chip kernel
 
     @nn.compact
-    def __call__(self, x, cache, mask, offsets, cache_mask=None, seg=None):
+    def __call__(self, x, cache, mask, offsets, cache_mask=None, seg=None,
+                 cache_valid=None, no_done=None):
         """x: [B, T, d]; cache: (k, v) with k/v [B, M, H, hd];
         mask: [B, T, M+T] (True = may attend); offsets: [T, M+T] relative
         distances query_time - key_time in [0, M]. cache_mask [B, T, M]
         and seg [B, T] feed the ring path (which rebuilds the in-unroll
-        band/segment mask per block instead of materializing [T, T]).
-        Returns (y, new_k, new_v) where new_k/new_v are this unroll's
+        band/segment mask per block instead of materializing [T, T]);
+        cache_valid [B, M] and no_done [B, T] feed the fused pallas
+        kernel (which rebuilds the whole mask in-kernel). Returns
+        (y, new_k, new_v) where new_k/new_v are this unroll's
         [B, T, H, hd]."""
         B, T, _ = x.shape
         H = self.num_heads
@@ -102,6 +106,23 @@ class _Block(nn.Module):
                 self.mesh,
                 self.seq_axis,
             ).astype(v.dtype)
+        elif self.attention_impl == "pallas":
+            from torchbeast_tpu.ops.pallas_attention import (
+                attention_interpret_default,
+                transformer_attention,
+            )
+
+            k_all = jnp.concatenate([cache[0].astype(k.dtype), k], axis=1)
+            v_all = jnp.concatenate([cache[1].astype(v.dtype), v], axis=1)
+            attended = transformer_attention(
+                self.memory_len,
+                attention_interpret_default(),
+                q, k_all, v_all,
+                seg.astype(jnp.int32),
+                cache_valid.astype(jnp.float32),
+                no_done,
+                rel_bias,
+            ).astype(v.dtype)
         else:
             k_all = jnp.concatenate([cache[0].astype(k.dtype), k], axis=1)
             v_all = jnp.concatenate([cache[1].astype(v.dtype), v], axis=1)
@@ -136,6 +157,7 @@ class TransformerNet(nn.Module):
     dtype: Any = jnp.float32
     mesh: Optional[Any] = None  # sequence-parallel training mesh
     seq_axis: str = "seq"
+    attention_impl: str = "dense"  # "dense" | "pallas" (fused kernel)
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -197,10 +219,12 @@ class TransformerNet(nn.Module):
                 d_model=self.d_model, num_heads=self.num_heads,
                 memory_len=M, dtype=self.dtype,
                 mesh=self.mesh, seq_axis=self.seq_axis,
+                attention_impl=self.attention_impl,
                 name=f"block_{layer}",
             )(
                 x, (k_cache_b, v_cache_b), mask, offsets,
                 cache_mask=cache_mask, seg=seg,
+                cache_valid=valid_b, no_done=no_done_yet,
             )
 
             # Roll the cache: last M of [old cache; this unroll], validity

@@ -75,6 +75,12 @@ def make_parser():
                              "the MXU; params and losses stay float32).")
     parser.add_argument("--serial_envs", action="store_true",
                         help="Step envs in-process (tests/cheap envs).")
+    parser.add_argument("--attention_impl", default="dense",
+                        choices=["dense", "pallas"],
+                        help="Transformer attention implementation: XLA "
+                             "dense ops, or the fused Pallas kernel "
+                             "(single-chip; compiled on TPU, interpreted "
+                             "elsewhere).")
     parser.add_argument("--sequence_parallel", type=int, default=0,
                         help="Shard the transformer's unroll (time) axis "
                              "over N devices: in-unroll attention runs as "
@@ -158,12 +164,28 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         else jnp.float32
     )
     extra = {}
+    attention_impl = getattr(flags, "attention_impl", "dense")
+    if attention_impl != "dense":
+        if flags.model != "transformer":
+            raise ValueError(
+                "--attention_impl applies to --model transformer only"
+            )
+        extra["attention_impl"] = attention_impl
     seq_par = getattr(flags, "sequence_parallel", 0)
     if seq_par and seq_par > 1:
         if flags.model != "transformer":
             raise ValueError(
                 "--sequence_parallel needs --model transformer (the "
                 "conv+LSTM families have no sequence-sharded formulation)"
+            )
+        if attention_impl != "dense":
+            # In _Block the ring branch wins whenever T divides the seq
+            # axis, so the fused kernel would silently only serve the
+            # T=1 acting path — reject instead of surprising the user.
+            raise ValueError(
+                "--attention_impl pallas and --sequence_parallel are "
+                "mutually exclusive (the ring path replaces the fused "
+                "kernel on the learner forward)"
             )
         from jax.sharding import Mesh
 

@@ -87,6 +87,11 @@ def make_parser():
                         help="Use the C++ queues/batcher/actor-pool "
                              "(_tbt_core; build with "
                              "scripts/build_native.sh).")
+    parser.add_argument("--sequence_parallel", type=int, default=0,
+                        help="Shard the transformer's unroll (time) axis "
+                             "over N devices (ring attention over a `seq` "
+                             "mesh; model=transformer only, unroll_length+1 "
+                             "divisible by N; acting falls back to dense).")
     parser.add_argument("--num_learner_devices", type=int, default=1,
                         help="Data-parallel learner over this many chips "
                              "(params replicated, batch sharded over the "
@@ -157,6 +162,12 @@ def train(flags):
                 f"--batch_size {flags.batch_size} (global) must be "
                 f"divisible by the {proc_count} processes"
             )
+    if flags.sequence_parallel > 1 and flags.num_learner_devices > 1:
+        raise ValueError(
+            "--sequence_parallel and --num_learner_devices are mutually "
+            "exclusive: the update step runs over ONE mesh, and the "
+            "model's seq mesh would conflict with the data-parallel mesh"
+        )
     local_rows = flags.batch_size // proc_count
     if flags.xpid is None:
         flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
