@@ -105,3 +105,21 @@ def test_train_transformer_sequence_parallel(tmp_path):
     stats = monobeast.train(flags)
     assert stats["step"] >= 56
     assert np.isfinite(stats["total_loss"])
+
+
+def test_train_transformer_zigzag_sequence_parallel(tmp_path):
+    """Sequence-parallel training with the zig-zag ring schedule
+    (T+1 = 16 divisible by 2N = 8 on a 4-way seq mesh)."""
+    flags = make_flags(
+        tmp_path,
+        xpid="smoke-zigzag",
+        model="transformer",
+        sequence_parallel=4,
+        ring_schedule="zigzag",
+        unroll_length=15,
+        env="Catch",
+        total_steps=64,
+    )
+    stats = monobeast.train(flags)
+    assert stats["step"] >= 64
+    assert np.isfinite(stats["total_loss"])

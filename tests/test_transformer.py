@@ -269,3 +269,76 @@ def test_ring_path_falls_back_to_dense_for_short_t():
         np.asarray(out_r.policy_logits), np.asarray(out_d.policy_logits),
         rtol=1e-6,
     )
+
+
+def test_zigzag_ring_path_matches_dense():
+    """Zig-zag-scheduled sequence-parallel training path: same numerics
+    as dense, with cache + dones + band clipping (T=32 over the 8-way
+    mesh -> 16 chunks of 2)."""
+    t = 32
+    model, params = init_model(memory_len=8)
+    warm = make_inputs(seed=51, t=t)
+    done = np.zeros((t, B), bool)
+    done[9] = True
+    done[23, 1] = True
+    inputs = make_inputs(seed=52, t=t, done=done)
+
+    state0 = model.initial_state(B)
+    _, cache = model.apply(params, warm, state0, sample_action=False)
+    dense_out, dense_state = model.apply(
+        params, inputs, cache, sample_action=False
+    )
+
+    zig = TransformerNet(
+        num_actions=model.num_actions,
+        num_layers=model.num_layers,
+        d_model=model.d_model,
+        num_heads=model.num_heads,
+        memory_len=model.memory_len,
+        mesh=_seq_mesh(8),
+        ring_schedule="zigzag",
+    )
+    zig_out, zig_state = zig.apply(params, inputs, cache,
+                                   sample_action=False)
+    np.testing.assert_allclose(
+        np.asarray(zig_out.policy_logits),
+        np.asarray(dense_out.policy_logits),
+        rtol=2e-4, atol=2e-5,
+    )
+    for (dk, dv, dval), (zk, zv, zval) in zip(dense_state, zig_state):
+        np.testing.assert_allclose(np.asarray(zk), np.asarray(dk),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(zval), np.asarray(dval))
+
+
+def test_zigzag_ring_path_gradients_match_dense():
+    t = 16
+    model, params = init_model(memory_len=4)
+    inputs = make_inputs(seed=61, t=t)
+    state = model.initial_state(B)
+    zig = TransformerNet(
+        num_actions=model.num_actions,
+        num_layers=model.num_layers,
+        d_model=model.d_model,
+        num_heads=model.num_heads,
+        memory_len=model.memory_len,
+        mesh=_seq_mesh(8),
+        ring_schedule="zigzag",
+    )
+
+    def loss(m):
+        def f(p):
+            out, _ = m.apply(p, inputs, state, sample_action=False)
+            return jnp.sum(out.policy_logits ** 2) + jnp.sum(
+                out.baseline ** 2
+            )
+        return f
+
+    g_dense = jax.grad(loss(model))(params)
+    g_zig = jax.grad(loss(zig))(params)
+    flat_d, _ = jax.tree_util.tree_flatten(g_dense)
+    flat_z, _ = jax.tree_util.tree_flatten(g_zig)
+    for gd, gz in zip(flat_d, flat_z):
+        np.testing.assert_allclose(
+            np.asarray(gz), np.asarray(gd), rtol=2e-3, atol=2e-4
+        )

@@ -359,6 +359,9 @@ def main(flags):
 
 
 def cli():
+    from torchbeast_tpu.utils import install_preemption_handler
+
+    install_preemption_handler()  # SIGTERM -> clean checkpointed exit
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     main(make_parser().parse_args())

@@ -56,6 +56,7 @@ class _Block(nn.Module):
     dtype: Any = jnp.float32
     mesh: Any = None  # set -> ring attention over mesh axis `seq_axis`
     seq_axis: str = "seq"
+    ring_schedule: str = "contiguous"  # or "zigzag" (balanced causal work)
     attention_impl: str = "dense"  # or "pallas": fused single-chip kernel
 
     @nn.compact
@@ -85,10 +86,11 @@ class _Block(nn.Module):
             "rel_bias", nn.initializers.zeros, (H, self.memory_len + 1)
         )
 
-        use_ring = (
-            self.mesh is not None
-            and T % self.mesh.shape[self.seq_axis] == 0
+        blocks = (
+            self.mesh.shape[self.seq_axis] if self.mesh is not None else 0
         )
+        divisor = 2 * blocks if self.ring_schedule == "zigzag" else blocks
+        use_ring = self.mesh is not None and T % divisor == 0
         if use_ring:
             # Softmax runs in f32 on both paths; ring also keeps the
             # einsums f32 (scores never materialize globally, so the
@@ -105,6 +107,7 @@ class _Block(nn.Module):
                 seg,
                 self.mesh,
                 self.seq_axis,
+                schedule=self.ring_schedule,
             ).astype(v.dtype)
         elif self.attention_impl == "pallas":
             from torchbeast_tpu.ops.pallas_attention import (
@@ -157,6 +160,7 @@ class TransformerNet(nn.Module):
     dtype: Any = jnp.float32
     mesh: Optional[Any] = None  # sequence-parallel training mesh
     seq_axis: str = "seq"
+    ring_schedule: str = "contiguous"  # "contiguous" | "zigzag"
     attention_impl: str = "dense"  # "dense" | "pallas" (fused kernel)
 
     @nn.compact
@@ -219,6 +223,7 @@ class TransformerNet(nn.Module):
                 d_model=self.d_model, num_heads=self.num_heads,
                 memory_len=M, dtype=self.dtype,
                 mesh=self.mesh, seq_axis=self.seq_axis,
+                ring_schedule=self.ring_schedule,
                 attention_impl=self.attention_impl,
                 name=f"block_{layer}",
             )(

@@ -89,6 +89,11 @@ def make_parser():
                              "so T+1 is divisible by N — short/acting "
                              "forwards fall back to dense with the same "
                              "params).")
+    parser.add_argument("--ring_schedule", default="contiguous",
+                        choices=["contiguous", "zigzag"],
+                        help="Ring attention block schedule: zigzag "
+                             "balances causal work (~2x fewer busiest-"
+                             "device FLOPs; needs T+1 divisible by 2N).")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--checkpoint_interval_s", type=int, default=600,
                         help="Seconds between checkpoints (reference: 10min).")
@@ -172,6 +177,14 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             )
         extra["attention_impl"] = attention_impl
     seq_par = getattr(flags, "sequence_parallel", 0)
+    if (
+        getattr(flags, "ring_schedule", "contiguous") != "contiguous"
+        and not (seq_par and seq_par > 1)
+    ):
+        raise ValueError(
+            "--ring_schedule only takes effect with --sequence_parallel "
+            "> 1 (no ring attention runs without a seq mesh)"
+        )
     if seq_par and seq_par > 1:
         if flags.model != "transformer":
             raise ValueError(
@@ -195,17 +208,21 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 f"--sequence_parallel {seq_par} but only "
                 f"{len(devices)} devices are visible"
             )
-        if (flags.unroll_length + 1) % seq_par != 0:
+        ring_schedule = getattr(flags, "ring_schedule", "contiguous")
+        divisor = 2 * seq_par if ring_schedule == "zigzag" else seq_par
+        if (flags.unroll_length + 1) % divisor != 0:
             # The learner forward sees T = unroll_length + 1 steps; if the
             # mesh doesn't divide it, the model would silently fall back
             # to dense attention — the opposite of what the flag asks for.
             raise ValueError(
-                f"--sequence_parallel {seq_par} requires unroll_length+1 "
-                f"divisible by it (got {flags.unroll_length + 1})"
+                f"--sequence_parallel {seq_par} "
+                f"({ring_schedule}) requires unroll_length+1 divisible "
+                f"by {divisor} (got {flags.unroll_length + 1})"
             )
         extra["mesh"] = Mesh(
             np.asarray(devices[:seq_par]), ("seq",)
         )
+        extra["ring_schedule"] = ring_schedule
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
         dtype=dtype, **extra,
@@ -475,6 +492,9 @@ def main(flags):
 
 
 def cli():
+    from torchbeast_tpu.utils import install_preemption_handler
+
+    install_preemption_handler()  # SIGTERM -> clean checkpointed exit
     # Make the JAX_PLATFORMS env var authoritative even when a site hook
     # (e.g. a TPU-plugin sitecustomize) already forced a platform list.
     if os.environ.get("JAX_PLATFORMS"):

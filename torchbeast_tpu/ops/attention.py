@@ -105,9 +105,9 @@ def _ring_pass(axis, num_blocks, my_idx, q_blk, k_blk, v_blk, seg_blk,
 
     NOTE: under the contiguous schedule every device runs all P steps,
     including the ~P/2 blocks its causal mask fully rejects (their
-    weights are exact zeros). ring_attention(schedule="zigzag") fixes
-    this for the plain causal op (measured ~1.8x wall-clock at T=4096 on
-    the 8-way CPU mesh); the transformer variant still uses contiguous.
+    weights are exact zeros). schedule="zigzag" fixes this for BOTH ring
+    ops (measured ~1.8x wall-clock at T=4096 on the 8-way CPU mesh for
+    the plain causal op).
     """
     Tb = q_blk.shape[1]
     q_pos = my_idx * Tb + jnp.arange(Tb)
@@ -132,6 +132,91 @@ def _ring_pass(axis, num_blocks, my_idx, q_blk, k_blk, v_blk, seg_blk,
         0, num_blocks, body, (*carry, k_blk, v_blk, seg_blk)
     )
     return acc / row_sum.transpose(0, 2, 1)[..., None]
+
+
+def _zigzag_pass(axis, num_blocks, c, my_idx, q_blk, k_blk, v_blk, seg_blk,
+                 accs_e, accs_l, mask_bias_fn):
+    """Shared zig-zag scaffold: the step-0 interactions, the
+    rotate-then-cond ring loop, and the finalize — used by both the plain
+    causal and the transformer variants.
+
+    mask_bias_fn(q_pos, k_pos, seg_q, seg_k) -> (mask [B?, Tq, Tk],
+    bias-or-None) builds every computed interaction's mask/bias from
+    GLOBAL positions (full-visibility pairs simply get an all-true causal
+    term). accs_e/accs_l seed the online softmax for the early/late query
+    chunks — e.g. with a cache leg already accumulated.
+    """
+    e_pos = my_idx * c + jnp.arange(c)
+    l_pos = (2 * num_blocks - 1 - my_idx) * c + jnp.arange(c)
+    q_e, q_l = q_blk[:, :c], q_blk[:, c:]
+    seg_e_q, seg_l_q = seg_blk[:, :c], seg_blk[:, c:]
+
+    def attend_at(accs, q_chunk, q_pos, seg_q, k_chunk, v_chunk, k_pos,
+                  seg_k):
+        mask, bias = mask_bias_fn(q_pos, k_pos, seg_q, seg_k)
+        return _block_attend(q_chunk, k_chunk, v_chunk, mask, *accs,
+                             bias=bias)
+
+    # Step 0 (j == i): both diagonal interactions + the always-visible
+    # late x early one.
+    accs_e = attend_at(accs_e, q_e, e_pos, seg_e_q,
+                       k_blk[:, :c], v_blk[:, :c], e_pos, seg_e_q)
+    accs_l = attend_at(accs_l, q_l, l_pos, seg_l_q,
+                       k_blk[:, c:], v_blk[:, c:], l_pos, seg_l_q)
+    accs_l = attend_at(accs_l, q_l, l_pos, seg_l_q,
+                       k_blk[:, :c], v_blk[:, :c], e_pos, seg_e_q)
+
+    def body(step, carry):
+        accs_e, accs_l, k_cur, v_cur, seg_cur = carry
+        # Rotate FIRST: after s rotations we hold device (i-s)'s pair.
+        perm_ring = [(a, (a + 1) % num_blocks) for a in range(num_blocks)]
+        k_cur = jax.lax.ppermute(k_cur, axis, perm_ring)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm_ring)
+        seg_cur = jax.lax.ppermute(seg_cur, axis, perm_ring)
+        j = (my_idx - step) % num_blocks
+        ke_pos = j * c + jnp.arange(c)
+        kl_pos = (2 * num_blocks - 1 - j) * c + jnp.arange(c)
+        k_e, k_l = k_cur[:, :c], k_cur[:, c:]
+        v_e, v_l = v_cur[:, :c], v_cur[:, c:]
+        seg_e_k, seg_l_k = seg_cur[:, :c], seg_cur[:, c:]
+
+        # Always: q_late x k_early (early chunks are always before).
+        accs_l2 = attend_at(accs_l, q_l, l_pos, seg_l_q,
+                            k_e, v_e, ke_pos, seg_e_k)
+
+        # One of the two same-half interactions, chosen by j vs i — the
+        # other is structurally invisible and skipped entirely.
+        def early_branch(operands):
+            accs_e, accs_l, k_e, v_e, k_l, v_l, seg_e_k, seg_l_k = operands
+            return (
+                attend_at(accs_e, q_e, e_pos, seg_e_q,
+                          k_e, v_e, ke_pos, seg_e_k),
+                accs_l,
+            )
+
+        def late_branch(operands):
+            accs_e, accs_l, k_e, v_e, k_l, v_l, seg_e_k, seg_l_k = operands
+            return (
+                accs_e,
+                attend_at(accs_l, q_l, l_pos, seg_l_q,
+                          k_l, v_l, kl_pos, seg_l_k),
+            )
+
+        accs_e, accs_l2 = jax.lax.cond(
+            j < my_idx, early_branch, late_branch,
+            (accs_e, accs_l2, k_e, v_e, k_l, v_l, seg_e_k, seg_l_k),
+        )
+        return accs_e, accs_l2, k_cur, v_cur, seg_cur
+
+    accs_e, accs_l, _, _, _ = jax.lax.fori_loop(
+        1, num_blocks, body, (accs_e, accs_l, k_blk, v_blk, seg_blk)
+    )
+
+    def finalize(accs):
+        acc, _, row_sum = accs
+        return acc / row_sum.transpose(0, 2, 1)[..., None]
+
+    return jnp.concatenate([finalize(accs_e), finalize(accs_l)], axis=1)
 
 
 def zigzag_permutation(t: int, num_blocks: int) -> np.ndarray:
@@ -273,88 +358,16 @@ def _zigzag_ring_attention(q, k, v, mesh, axis, segment_ids):
     def local_fn(q_blk, k_blk, v_blk, seg_blk):
         my_idx = jax.lax.axis_index(axis)
         q_e, q_l = q_blk[:, :c], q_blk[:, c:]
-        seg_e_q, seg_l_q = seg_blk[:, :c], seg_blk[:, c:]
 
-        def seg_mask(seg_q, seg_k):
-            return seg_q[:, :, None] == seg_k[:, None, :]
+        def mask_bias(q_pos, k_pos, seg_q, seg_k):
+            causal = q_pos[:, None] >= k_pos[None, :]
+            mask = causal[None] & (seg_q[:, :, None] == seg_k[:, None, :])
+            return mask, None
 
-        def attend(accs, q_chunk, k_chunk, v_chunk, mask, bias=None):
-            return _block_attend(q_chunk, k_chunk, v_chunk, mask, *accs,
-                                 bias=bias)
-
-        # Step 0: the diagonal pair (j == i) + the always-visible
-        # late x early interaction.
-        tril = jnp.tril(jnp.ones((c, c), bool))[None]
-        accs_e = attend(
-            _online_softmax_init(q_e), q_e, k_blk[:, :c], v_blk[:, :c],
-            tril & seg_mask(seg_e_q, seg_blk[:, :c]),
-        )
-        accs_l = attend(
-            _online_softmax_init(q_l), q_l, k_blk[:, c:], v_blk[:, c:],
-            tril & seg_mask(seg_l_q, seg_blk[:, c:]),
-        )
-        accs_l = attend(
-            accs_l, q_l, k_blk[:, :c], v_blk[:, :c],
-            seg_mask(seg_l_q, seg_blk[:, :c]),
-        )
-
-        def body(step, carry):
-            accs_e, accs_l, k_cur, v_cur, seg_cur = carry
-            # Rotate FIRST: after s rotations we hold device (i-s)'s pair.
-            perm_ring = [
-                (a, (a + 1) % num_blocks) for a in range(num_blocks)
-            ]
-            k_cur = jax.lax.ppermute(k_cur, axis, perm_ring)
-            v_cur = jax.lax.ppermute(v_cur, axis, perm_ring)
-            seg_cur = jax.lax.ppermute(seg_cur, axis, perm_ring)
-            j = (my_idx - step) % num_blocks
-
-            k_e, k_l = k_cur[:, :c], k_cur[:, c:]
-            v_e, v_l = v_cur[:, :c], v_cur[:, c:]
-            seg_e_k, seg_l_k = seg_cur[:, :c], seg_cur[:, c:]
-
-            # Always: q_late x k_early (full visibility, segment-masked).
-            accs_l2 = attend(accs_l, q_l, k_e, v_e,
-                             seg_mask(seg_l_q, seg_e_k))
-
-            # One of the two same-half interactions, chosen by j vs i —
-            # the other is structurally invisible and skipped entirely.
-            def early_branch(operands):
-                accs_e, accs_l, k_e, v_e, k_l, v_l, seg_e_k, seg_l_k = (
-                    operands
-                )
-                return (
-                    attend(accs_e, q_e, k_e, v_e,
-                           seg_mask(seg_e_q, seg_e_k)),
-                    accs_l,
-                )
-
-            def late_branch(operands):
-                accs_e, accs_l, k_e, v_e, k_l, v_l, seg_e_k, seg_l_k = (
-                    operands
-                )
-                return (
-                    accs_e,
-                    attend(accs_l, q_l, k_l, v_l,
-                           seg_mask(seg_l_q, seg_l_k)),
-                )
-
-            accs_e, accs_l2 = jax.lax.cond(
-                j < my_idx, early_branch, late_branch,
-                (accs_e, accs_l2, k_e, v_e, k_l, v_l, seg_e_k, seg_l_k),
-            )
-            return accs_e, accs_l2, k_cur, v_cur, seg_cur
-
-        accs_e, accs_l, _, _, _ = jax.lax.fori_loop(
-            1, num_blocks, body, (accs_e, accs_l, k_blk, v_blk, seg_blk)
-        )
-
-        def finalize(accs):
-            acc, _, row_sum = accs
-            return acc / row_sum.transpose(0, 2, 1)[..., None]
-
-        return jnp.concatenate(
-            [finalize(accs_e), finalize(accs_l)], axis=1
+        return _zigzag_pass(
+            axis, num_blocks, c, my_idx, q_blk, k_blk, v_blk, seg_blk,
+            _online_softmax_init(q_e), _online_softmax_init(q_l),
+            mask_bias,
         )
 
     from jax import shard_map
@@ -373,6 +386,7 @@ def _zigzag_ring_attention(q, k, v, mesh, axis, segment_ids):
 def ring_transformer_attention(
     q, k, v, cache_k, cache_v, cache_mask, rel_bias, memory_len: int,
     segment_ids, mesh: Mesh, axis: str = "seq",
+    schedule: str = "contiguous",
 ):
     """Sequence-parallel version of the transformer policy's in-unroll
     attention (models/transformer.py _Block): band-causal windowing to the
@@ -393,9 +407,19 @@ def ring_transformer_attention(
     rel_bias:     [H, M+1] learned bias over offsets 0..M.
     segment_ids:  [B, T] int, sharded along T.
     Returns [B, T, H, D], sharded along T.
+
+    schedule: "contiguous" or "zigzag" (see ring_attention — same ~2x
+    busiest-device FLOP saving, with the band/bias/cache semantics kept).
     """
     num_blocks = mesh.shape[axis]
     M = memory_len
+    if schedule == "zigzag":
+        return _zigzag_transformer_ring(
+            q, k, v, cache_k, cache_v, cache_mask, rel_bias, M,
+            segment_ids, mesh, axis,
+        )
+    if schedule != "contiguous":
+        raise ValueError(f"Unknown ring schedule {schedule!r}")
 
     def local_fn(q_blk, k_blk, v_blk, seg_blk, c_k, c_v, c_mask, bias_tbl):
         my_idx = jax.lax.axis_index(axis)
@@ -437,3 +461,83 @@ def ring_transformer_attention(
         out_specs=seq,
     )
     return fn(q, k, v, segment_ids, cache_k, cache_v, cache_mask, rel_bias)
+
+
+def _zigzag_transformer_ring(q, k, v, cache_k, cache_v, cache_mask,
+                             rel_bias, memory_len, segment_ids, mesh, axis):
+    """Zig-zag-scheduled transformer ring attention.
+
+    Same chunk-pair layout and structural skipping as
+    _zigzag_ring_attention (device i holds chunks (i, 2P-1-i); two
+    computed interactions per ring step chosen by lax.cond), with the
+    transformer semantics layered on: every computed interaction applies
+    the band + segment mask and the relative-position bias from GLOBAL
+    positions, and each device's two query chunks attend the replicated
+    cache locally first. The band can mask additional distant pairs
+    beyond causality; those are where'd out rather than skipped
+    structurally (at RL scale the band spans most of the unroll).
+    """
+    num_blocks = mesh.shape[axis]
+    M = memory_len
+    B, T, H, D = q.shape
+    if T % (2 * num_blocks) != 0:
+        raise ValueError(
+            f"zigzag schedule needs T ({T}) divisible by 2P "
+            f"({2 * num_blocks})"
+        )
+    c = T // (2 * num_blocks)
+    perm = zigzag_permutation(T, num_blocks)
+    inv_perm = np.argsort(perm)
+
+    seq_sh = NamedSharding(mesh, P(None, axis, None, None))
+    seg_sh = NamedSharding(mesh, P(None, axis))
+    cm_sh = NamedSharding(mesh, P(None, axis, None))
+    constrain = jax.lax.with_sharding_constraint
+    qz = constrain(jnp.take(q, perm, axis=1), seq_sh)
+    kz = constrain(jnp.take(k, perm, axis=1), seq_sh)
+    vz = constrain(jnp.take(v, perm, axis=1), seq_sh)
+    segz = constrain(jnp.take(segment_ids, perm, axis=1), seg_sh)
+    cmz = constrain(jnp.take(cache_mask, perm, axis=1), cm_sh)
+
+    def local_fn(q_blk, k_blk, v_blk, seg_blk, cm_blk, c_k, c_v, bias_tbl):
+        my_idx = jax.lax.axis_index(axis)
+        e_pos = my_idx * c + jnp.arange(c)
+        l_pos = (2 * num_blocks - 1 - my_idx) * c + jnp.arange(c)
+        q_e, q_l = q_blk[:, :c], q_blk[:, c:]
+
+        def band_seg_bias(q_pos, k_pos, seg_q, seg_k):
+            offsets = q_pos[:, None] - k_pos[None, :]
+            band = (offsets >= 0) & (offsets <= M)
+            mask = band[None] & (seg_q[:, :, None] == seg_k[:, None, :])
+            return mask, bias_tbl[:, jnp.clip(offsets, 0, M)]
+
+        def cache_leg(q_chunk, q_pos, cm_chunk):
+            offs = q_pos[:, None] + M - jnp.arange(M)[None, :]
+            bias = bias_tbl[:, jnp.clip(offs, 0, M)]
+            return _block_attend(
+                q_chunk, c_k, c_v, cm_chunk,
+                *_online_softmax_init(q_chunk), bias=bias,
+            )
+
+        return _zigzag_pass(
+            axis, num_blocks, c, my_idx, q_blk, k_blk, v_blk, seg_blk,
+            cache_leg(q_e, e_pos, cm_blk[:, :c]),
+            cache_leg(q_l, l_pos, cm_blk[:, c:]),
+            band_seg_bias,
+        )
+
+    from jax import shard_map
+
+    seq = P(None, axis, None, None)
+    repl4 = P(None, None, None, None)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            seq, seq, seq, P(None, axis), P(None, axis, None),
+            repl4, repl4, P(None, None),
+        ),
+        out_specs=seq,
+    )
+    out_z = fn(qz, kz, vz, segz, cmz, cache_k, cache_v, rel_bias)
+    return constrain(jnp.take(out_z, inv_perm, axis=1), seq_sh)

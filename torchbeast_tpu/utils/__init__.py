@@ -4,3 +4,4 @@ from torchbeast_tpu.utils.checkpoint import (  # noqa: F401
 )
 from torchbeast_tpu.utils.file_writer import FileWriter  # noqa: F401
 from torchbeast_tpu.utils.prof import Timings  # noqa: F401
+from torchbeast_tpu.utils.preempt import install_preemption_handler  # noqa: F401
