@@ -134,6 +134,8 @@ def test_model_pallas_matches_dense():
     for (dk, dv, dval), (pk, pv, pval) in zip(state_d, state_p):
         np.testing.assert_allclose(np.asarray(pk), np.asarray(dk),
                                    rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(pv), np.asarray(dv),
+                                   rtol=2e-4, atol=2e-5)
         np.testing.assert_array_equal(np.asarray(pval), np.asarray(dval))
 
 

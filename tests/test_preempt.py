@@ -32,18 +32,29 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
         text=True,
         env=env,
     )
-    # Wait for training to actually start (first SPS log line).
+    # Wait for training to actually start (first SPS log line). select()
+    # before each read so a silent-but-alive driver fails at the deadline
+    # instead of blocking the suite in readline() forever.
+    import select
+
     deadline = time.time() + 120
     started = False
     lines = []
     while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if not ready:
+            if proc.poll() is not None:
+                break
+            continue
         line = proc.stdout.readline()
         lines.append(line)
         if "Steps " in line:
             started = True
             break
-        if proc.poll() is not None:
+        if not line and proc.poll() is not None:
             break
+    if not started:
+        proc.kill()
     assert started, "driver never started:\n" + "".join(lines)
 
     proc.send_signal(signal.SIGTERM)

@@ -228,6 +228,8 @@ def test_ring_path_matches_dense_forward_and_state():
     for (dk, dv, dval), (rk, rv, rval) in zip(dense_state, ring_state):
         np.testing.assert_allclose(np.asarray(rk), np.asarray(dk),
                                    rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(rv), np.asarray(dv),
+                                   rtol=2e-4, atol=2e-5)
         np.testing.assert_array_equal(np.asarray(rval), np.asarray(dval))
 
 
@@ -307,6 +309,8 @@ def test_zigzag_ring_path_matches_dense():
     )
     for (dk, dv, dval), (zk, zv, zval) in zip(dense_state, zig_state):
         np.testing.assert_allclose(np.asarray(zk), np.asarray(dk),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(zv), np.asarray(dv),
                                    rtol=2e-4, atol=2e-5)
         np.testing.assert_array_equal(np.asarray(zval), np.asarray(dval))
 
