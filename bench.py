@@ -38,6 +38,8 @@ import subprocess
 import sys
 import time
 
+import numpy as np
+
 T = 80
 B = 32
 STEPS = 10
@@ -154,7 +156,16 @@ def run_bench():
     import __graft_entry__
     import jax.numpy as jnp
 
-    def measure(dtype):
+    # Timing sync: fetch the final loss to HOST (device_get) rather than
+    # block_until_ready — on the remote-TPU tunnel backend the latter has
+    # been observed returning before compute finishes (a run "measured"
+    # 0.79 ms for a 72 ms step); a host fetch of a scalar that data-depends
+    # on the whole chained loop cannot lie. A per-step fetch would add a
+    # full tunnel round-trip (~50 ms) to every step, so fetch once at the
+    # end — unless the plausibility guard below trips, in which case
+    # re-measure with the per-step fetch and report that (conservative)
+    # number.
+    def measure(dtype, sync_each=False):
         model, params, batch, state = __graft_entry__._flagship(
             batch_size=B, t=T, dtype=dtype
         )
@@ -174,22 +185,43 @@ def run_bench():
             params, opt_state, stats = update_step(
                 params, opt_state, batch_d, state_d
             )
-        jax.block_until_ready(stats["total_loss"])
+        float(stats["total_loss"])
 
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, stats = update_step(
                 params, opt_state, batch_d, state_d
             )
-        jax.block_until_ready(stats["total_loss"])
+            if sync_each:
+                float(stats["total_loss"])
+        float(stats["total_loss"])
         elapsed = time.perf_counter() - t0
         return T * B * steps / elapsed, 1000 * elapsed / steps, flops
 
-    frames_per_sec, step_ms, flops = measure(jnp.float32)
+    def measure_plausible(dtype):
+        """measure(), re-run with per-step sync if the implied TFLOP/s
+        exceeds this chip's physical peak (i.e. the async timing lied)."""
+        fps, ms, flops = measure(dtype)
+        kind = device.device_kind.lower()
+        peak = next(
+            (p for name, p in PEAK_BF16_TFLOPS.items() if name in kind),
+            max(PEAK_BF16_TFLOPS.values()),
+        )
+        if flops and flops / (ms / 1000) / 1e12 > peak:
+            sys.stderr.write(
+                f"bench: implausible {ms:.2f} ms/step (> {peak} TFLOP/s); "
+                "re-measuring with per-step host sync\n"
+            )
+            fps, ms, flops = measure(dtype, sync_each=True)
+        return fps, ms, flops
+
+    frames_per_sec, step_ms, flops = measure_plausible(jnp.float32)
     # bf16 trunk variant: only worth the extra compile on an accelerator.
     bf16_frames_per_sec = bf16_step_ms = bf16_flops = None
     if on_accel:
-        bf16_frames_per_sec, bf16_step_ms, bf16_flops = measure(jnp.bfloat16)
+        bf16_frames_per_sec, bf16_step_ms, bf16_flops = measure_plausible(
+            jnp.bfloat16
+        )
 
     # Per-dtype achieved TFLOP/s; MFU only for the bf16 run against the
     # chip's bf16 peak (comparing an f32 run to a bf16 peak would
@@ -220,11 +252,14 @@ def run_bench():
         state = jax.device_put(model.initial_state(batch_size))
         key = jax.random.PRNGKey(0)
         out, state = act_step(params, key, env_output, state)  # compile
-        jax.block_until_ready(out.action)
+        np.asarray(out.action)
         t0 = time.perf_counter()
         for _ in range(n):
             out, state = act_step(params, key, env_output, state)
-        jax.block_until_ready(out.action)
+            # The act path's real contract is actions-on-host every call
+            # (the DynamicBatcher replies to blocked actors), so the
+            # per-call fetch IS the workload, not measurement overhead.
+            np.asarray(out.action)
         return batch_size * n / (time.perf_counter() - t0)
 
     inference_sps = measure_inference(n=20 if on_accel else 3)
@@ -250,13 +285,13 @@ def run_bench():
         params, opt_state, carry, stats = train_step(
             params, opt_state, carry
         )  # compile
-        jax.block_until_ready(stats["total_loss"])
+        float(stats["total_loss"])
         t0 = time.perf_counter()
         for _ in range(n):
             params, opt_state, carry, stats = train_step(
                 params, opt_state, carry
             )
-        jax.block_until_ready(stats["total_loss"])
+        float(stats["total_loss"])  # host fetch: honest sync (see measure)
         return batch_size * unroll * n / (time.perf_counter() - t0)
 
     try:

@@ -34,28 +34,32 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
     )
     # Wait for training to actually start (first SPS log line). select()
     # before each read so a silent-but-alive driver fails at the deadline
-    # instead of blocking the suite in readline() forever.
+    # instead of blocking the suite in readline() forever. Read raw bytes
+    # via os.read — NOT proc.stdout.readline(): the buffered wrapper can
+    # swallow a whole chunk (including the awaited line) while select()
+    # keeps reporting the fd itself as idle.
     import select
 
     deadline = time.time() + 120
     started = False
-    lines = []
+    buf = ""
+    fd = proc.stdout.fileno()
     while time.time() < deadline:
-        ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+        ready, _, _ = select.select([fd], [], [], 1.0)
         if not ready:
             if proc.poll() is not None:
                 break
             continue
-        line = proc.stdout.readline()
-        lines.append(line)
-        if "Steps " in line:
-            started = True
+        chunk = os.read(fd, 65536).decode(errors="replace")
+        if not chunk:  # EOF
             break
-        if not line and proc.poll() is not None:
+        buf += chunk
+        if "Steps " in buf:
+            started = True
             break
     if not started:
         proc.kill()
-    assert started, "driver never started:\n" + "".join(lines)
+    assert started, "driver never started:\n" + buf
 
     proc.send_signal(signal.SIGTERM)
     try:

@@ -22,6 +22,29 @@ class ResNetBase(nn.Module):
 
     channels: Sequence[int] = (16, 32, 32)
     dtype: Any = jnp.float32
+    remat: bool = True
+
+    def _stage(self, x, i):
+        conv3 = lambda feat, name: nn.Conv(  # noqa: E731
+            feat, (3, 3), strides=(1, 1), padding="SAME", dtype=self.dtype,
+            name=name,
+        )
+        num_ch = self.channels[i]
+        x = conv3(num_ch, f"feat_conv_{i}")(x)
+        # ops.pool.max_pool2d: forward-identical to nn.max_pool, but
+        # its custom VJP avoids SelectAndScatter (10x the forward's
+        # cost on XLA:CPU, slow on some TPU gens) in the backward.
+        x = max_pool2d(
+            x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+        )
+        for j in range(2):
+            res_input = x
+            x = nn.relu(x)
+            x = conv3(num_ch, f"res_{i}_{j}_conv1")(x)
+            x = nn.relu(x)
+            x = conv3(num_ch, f"res_{i}_{j}_conv2")(x)
+            x = x + res_input
+        return x
 
     @nn.compact
     def __call__(self, frame):
@@ -29,25 +52,20 @@ class ResNetBase(nn.Module):
         x = frame.reshape((T * B,) + frame.shape[2:])
         x = x.astype(self.dtype) / 255.0
 
-        conv3 = lambda feat, name: nn.Conv(  # noqa: E731
-            feat, (3, 3), strides=(1, 1), padding="SAME", dtype=self.dtype,
-            name=name,
+        # Rematerialize each stage in the backward pass: at the reference's
+        # T=80 x B=32 the stage-1 activations alone are ~1.1 GB f32 each
+        # and the un-remat'd backward needs >22 GB — past a v5e's 16 GB
+        # HBM. Saving only the three stage inputs (~0.7 GB) and recomputing
+        # inside each stage trades ~1/4 extra trunk FLOPs for a fit.
+        # Wrapping the *method* keeps the `name=` scopes, so param paths
+        # (trunk/feat_conv_0, ...) are identical either way.
+        stage = (
+            nn.remat(ResNetBase._stage, static_argnums=(2,))
+            if self.remat
+            else ResNetBase._stage
         )
-        for i, num_ch in enumerate(self.channels):
-            x = conv3(num_ch, f"feat_conv_{i}")(x)
-            # ops.pool.max_pool2d: forward-identical to nn.max_pool, but
-            # its custom VJP avoids SelectAndScatter (10x the forward's
-            # cost on XLA:CPU, slow on some TPU gens) in the backward.
-            x = max_pool2d(
-                x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
-            )
-            for j in range(2):
-                res_input = x
-                x = nn.relu(x)
-                x = conv3(num_ch, f"res_{i}_{j}_conv1")(x)
-                x = nn.relu(x)
-                x = conv3(num_ch, f"res_{i}_{j}_conv2")(x)
-                x = x + res_input
+        for i in range(len(self.channels)):
+            x = stage(self, x, i)
 
         x = nn.relu(x)
         x = x.reshape((T * B, -1))  # 11*11*32 = 3872 for 84x84 input
@@ -59,6 +77,7 @@ class ResNet(nn.Module):
     num_actions: int
     use_lstm: bool = False
     dtype: Any = jnp.float32
+    remat: bool = True
 
     hidden_size: int = 256
 
@@ -67,7 +86,9 @@ class ResNet(nn.Module):
         frame = inputs["frame"]  # [T, B, H, W, C] uint8
         T, B = frame.shape[:2]
 
-        x = ResNetBase(dtype=self.dtype, name="trunk")(frame)
+        x = ResNetBase(
+            dtype=self.dtype, remat=self.remat, name="trunk"
+        )(frame)
 
         clipped_reward = jnp.clip(
             inputs["reward"].astype(jnp.float32), -1, 1
