@@ -207,6 +207,8 @@ def run_bench():
             (p for name, p in PEAK_BF16_TFLOPS.items() if name in kind),
             max(PEAK_BF16_TFLOPS.values()),
         )
+        if dtype == jnp.float32:
+            peak /= 2  # TPU f32 peak is ~half the bf16 figure
         if flops and flops / (ms / 1000) / 1e12 > peak:
             sys.stderr.write(
                 f"bench: implausible {ms:.2f} ms/step (> {peak} TFLOP/s); "

@@ -153,3 +153,44 @@ def test_resnet_feature_size():
     )
     fc_kernel = params["params"]["trunk"]["fc"]["kernel"]
     assert fc_kernel.shape == (3872, 256)
+
+
+@pytest.mark.parametrize("remat", [False, True, (True, False, False)])
+def test_resnet_remat_variants_identical(remat):
+    # Rematerialization is a scheduling choice, not a numerical one: every
+    # remat setting must produce the same params tree, outputs, and
+    # gradients as the un-remat'd trunk.
+    inputs = make_inputs(t=3, b=2)
+    outs = []
+    for flag in (False, remat):
+        model = ResNet(num_actions=NUM_ACTIONS, use_lstm=True, remat=flag)
+        state = model.initial_state(2)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+            inputs,
+            state,
+        )
+
+        def loss(p):
+            out, _ = model.apply(p, inputs, state, sample_action=False)
+            return jnp.sum(out.baseline ** 2) + jnp.sum(out.policy_logits ** 2)
+
+        l, g = jax.jit(jax.value_and_grad(loss))(params)
+        outs.append((l, g))
+    (l0, g0), (l1, g1) = outs
+    assert jax.tree_util.tree_structure(g0) == jax.tree_util.tree_structure(g1)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_remat_length_validated():
+    model = ResNet(num_actions=NUM_ACTIONS, remat=(True, False))
+    inputs = make_inputs(t=2, b=1)
+    with pytest.raises(ValueError, match="one flag per stage"):
+        model.init(
+            {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+            inputs,
+            (),
+        )

@@ -1,0 +1,100 @@
+"""Pallas max-pool backward (ops/pallas_pool.py): parity with the
+autodiff (SelectAndScatter) gradient, run in interpret mode on CPU.
+
+f32 random inputs are tie-free almost surely, so parity is exact. bf16's
+8-bit mantissa makes within-window ties common; on ties this kernel (like
+the CPU tap-sum VJP) credits every tying position where SelectAndScatter
+credits one, so bf16 is compared only at positions with a unique window
+max."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchbeast_tpu.ops.pallas_pool import pool_bwd, supports
+
+SHAPES = [
+    (6, 84, 84, 16),  # trunk stage 1
+    (3, 42, 42, 32),  # trunk stage 2
+    (5, 21, 21, 32),  # trunk stage 3 (odd H/W)
+    (2, 11, 13, 8),   # odd + non-square + ragged N vs block_n
+]
+
+
+def _fwd(x):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        ((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_f32_matches_autodiff(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    y, vjp = jax.vjp(_fwd, x)
+    g = jnp.asarray(rng.standard_normal(y.shape), jnp.float32)
+    gx_ref = vjp(g)[0]
+    gx = pool_bwd(x, y, g, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(gx), np.asarray(gx_ref), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_bf16_matches_on_unique_argmax_positions():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((4, 84, 84, 16)), jnp.bfloat16)
+    y, vjp = jax.vjp(_fwd, x)
+    g = jnp.asarray(rng.standard_normal(y.shape), jnp.bfloat16)
+    gx_ref = np.asarray(vjp(g)[0], np.float32)
+    gx = np.asarray(pool_bwd(x, y, g, interpret=True), np.float32)
+
+    # Tie map: how many positions in each window equal its max. A position
+    # is "safe" if every window that reaches it has exactly one winner.
+    xf = np.asarray(x, np.float32)
+    yf = np.asarray(y, np.float32)
+    N, H, W, C = xf.shape
+    Ho, Wo = yf.shape[1], yf.shape[2]
+    xp = np.pad(xf, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                constant_values=-np.inf)
+    counts = np.zeros_like(yf)
+    for kh in range(3):
+        for kw in range(3):
+            tap = xp[:, kh : kh + 2 * Ho : 2, kw : kw + 2 * Wo : 2, :]
+            counts += (tap == yf)
+    # windows with a unique winner
+    unique = counts == 1
+    # input positions touched only by unique-winner windows
+    safe = np.ones_like(xf, bool)
+    for kh in range(3):
+        for kw in range(3):
+            tap_unique = np.ones((N, H + 2, W + 2, C), bool)
+            sl_h = slice(kh, kh + 2 * Ho, 2)
+            sl_w = slice(kw, kw + 2 * Wo, 2)
+            tap_unique[:, sl_h, sl_w, :] = unique
+            safe &= tap_unique[:, 1 : 1 + H, 1 : 1 + W, :]
+    assert safe.mean() > 0.5  # the comparison is not vacuous
+    np.testing.assert_allclose(gx[safe], gx_ref[safe], rtol=0.05, atol=0.05)
+
+
+def test_supports_gate():
+    x = jnp.zeros((2, 8, 8, 4), jnp.float32)
+    assert supports(x, (3, 3), (2, 2), ((1, 1), (1, 1)))
+    assert not supports(x, (2, 2), (2, 2), ((0, 0), (0, 0)))
+    assert not supports(x, (3, 3), (1, 1), ((1, 1), (1, 1)))
+    assert not supports(
+        jnp.zeros((2, 8, 8, 4), jnp.int32), (3, 3), (2, 2), ((1, 1), (1, 1))
+    )
+
+
+def test_block_n_does_not_change_result():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((7, 21, 21, 8)), jnp.float32)
+    y = _fwd(x)
+    g = jnp.asarray(rng.standard_normal(y.shape), jnp.float32)
+    a = pool_bwd(x, y, g, block_n=2, interpret=True)
+    b = pool_bwd(x, y, g, block_n=7, interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

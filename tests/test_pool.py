@@ -8,7 +8,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from torchbeast_tpu.ops.pool import max_pool2d
+from torchbeast_tpu.ops.pool import _max_pool2d_tapsum, max_pool2d
 
 CONFIGS = [
     # (shape, window, strides, padding) — the IMPALA trunk pools + extras
@@ -41,7 +41,9 @@ def test_gradient_matches_autodiff(shape, window, strides, padding):
     )
 
     def ours(x):
-        return jnp.sum(max_pool2d(x, window, strides, padding) * ct)
+        # tap-sum VJP explicitly: on an accelerator max_pool2d's dispatch
+        # would compare the native gradient with itself (vacuous).
+        return jnp.sum(_max_pool2d_tapsum(x, window, strides, padding) * ct)
 
     def ref(x):
         return jnp.sum(nn.max_pool(x, window, strides, padding) * ct)
@@ -54,12 +56,14 @@ def test_gradient_matches_autodiff(shape, window, strides, padding):
 
 
 def test_tie_gradient_is_a_subgradient():
-    # All-equal window: ours credits every tying position; the window's
-    # total credited gradient equals the cotangent times #windows the
-    # position wins — still sums to a valid subgradient (non-zero, finite).
+    # All-equal window: the tap-sum VJP credits every tying position; the
+    # window's total credited gradient equals the cotangent times #windows
+    # the position wins — still a valid subgradient (non-zero, finite).
+    # Pinned on the tap-sum path explicitly: max_pool2d's platform dispatch
+    # would use SelectAndScatter (one credit per window) on accelerators.
     x = jnp.ones((1, 4, 4, 1), jnp.float32)
-    g = jax.grad(lambda x: jnp.sum(max_pool2d(x, (2, 2), (2, 2),
-                                              ((0, 0), (0, 0)))))(x)
+    g = jax.grad(lambda x: jnp.sum(_max_pool2d_tapsum(x, (2, 2), (2, 2),
+                                                      ((0, 0), (0, 0)))))(x)
     assert np.isfinite(np.asarray(g)).all()
     # Each non-overlapping 2x2 window distributes 1.0 to its 4 tying
     # members in this formulation.

@@ -22,7 +22,13 @@ class ResNetBase(nn.Module):
 
     channels: Sequence[int] = (16, 32, 32)
     dtype: Any = jnp.float32
-    remat: bool = True
+    # Per-stage rematerialization: True/False for all stages, or a tuple of
+    # per-stage booleans. Stage 0's activations are the memory hog (~1.1 GB
+    # each at T=80 B=32 vs ~0.14-0.54 GB for later stages); (True, False,
+    # False) trades ~2 GB of saved activations for skipping ~60% of the
+    # recompute FLOPs. Default: remat everything — the configuration whose
+    # fit on a 15.75 GB v5e is measured.
+    remat: Any = True
 
     def _stage(self, x, i):
         conv3 = lambda feat, name: nn.Conv(  # noqa: E731
@@ -52,20 +58,27 @@ class ResNetBase(nn.Module):
         x = frame.reshape((T * B,) + frame.shape[2:])
         x = x.astype(self.dtype) / 255.0
 
-        # Rematerialize each stage in the backward pass: at the reference's
-        # T=80 x B=32 the stage-1 activations alone are ~1.1 GB f32 each
-        # and the un-remat'd backward needs >22 GB — past a v5e's 16 GB
-        # HBM. Saving only the three stage inputs (~0.7 GB) and recomputing
-        # inside each stage trades ~1/4 extra trunk FLOPs for a fit.
-        # Wrapping the *method* keeps the `name=` scopes, so param paths
-        # (trunk/feat_conv_0, ...) are identical either way.
-        stage = (
-            nn.remat(ResNetBase._stage, static_argnums=(2,))
-            if self.remat
-            else ResNetBase._stage
+        # Rematerialize stages in the backward pass: at the reference's
+        # T=80 x B=32 the stage-0 activations alone are ~1.1 GB f32 each
+        # and the fully un-remat'd backward needs >22 GB — past a v5e's
+        # 16 GB HBM. A remat'd stage saves only its input and recomputes
+        # inside during the backward. Wrapping the *method* keeps the
+        # `name=` scopes, so param paths (trunk/feat_conv_0, ...) are
+        # identical either way.
+        flags = (
+            tuple(self.remat)
+            if isinstance(self.remat, (tuple, list))
+            else (self.remat,) * len(self.channels)
         )
+        if len(flags) != len(self.channels):
+            raise ValueError(
+                f"remat={self.remat!r} must have one flag per stage "
+                f"({len(self.channels)})"
+            )
+        rematted = nn.remat(ResNetBase._stage, static_argnums=(2,))
         for i in range(len(self.channels)):
-            x = stage(self, x, i)
+            fn = rematted if flags[i] else ResNetBase._stage
+            x = fn(self, x, i)
 
         x = nn.relu(x)
         x = x.reshape((T * B, -1))  # 11*11*32 = 3872 for 84x84 input
@@ -77,7 +90,7 @@ class ResNet(nn.Module):
     num_actions: int
     use_lstm: bool = False
     dtype: Any = jnp.float32
-    remat: bool = True
+    remat: Any = True  # bool or per-stage tuple, see ResNetBase.remat
 
     hidden_size: int = 256
 

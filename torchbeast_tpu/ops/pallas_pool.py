@@ -1,0 +1,131 @@
+"""Pallas TPU kernel for the 3x3/stride-2 max-pool backward.
+
+The autodiff backward of `reduce_window(max)` is SelectAndScatter; on a
+v5e it costs ~10x the pool forward at the IMPALA trunk's stage-1 shape
+and is the learner step's largest single op. This kernel computes the
+same gradient in one fused pass:
+
+    gx[n, h, w, c] = sum over taps (kh, kw) of
+        g[n, oh, ow, c] * (x[n, h, w, c] == y[n, oh, ow, c])
+        where (oh, ow) = ((h + 1 - kh) / 2, (w + 1 - kw) / 2)
+        and the tap only contributes when those divisions are exact.
+
+Geometry: arrays are viewed as [N, H, W*C] so the channel dim rides the
+lane dimension fused with W — full 128-lane VPU utilization instead of
+C/128. The kernel sees x and 2x-upsampled/padded y and g ("doubled grid":
+y_up[i] = y[i // 2]); each tap is then a STATIC slice of that grid plus a
+parity mask from `broadcasted_iota`, so nothing in the kernel is strided,
+scattered, or gathered. The (cheap, output-sized) upsample+pad runs in
+XLA before the call.
+
+Tie semantics match ops.pool's CPU tap-sum VJP: every input position that
+ties at the window max is credited (a valid subgradient). SelectAndScatter
+credits only the first in scan order; ties are measure-zero for conv
+activations.
+
+Specialized to window (3, 3), strides (2, 2), padding ((1, 1), (1, 1)) —
+the only configuration the IMPALA trunks use (reference
+polybeast_learner.py:168, monobeast.py:563 use stride-2 3x3 pools);
+`supports(...)` gates the dispatch and everything else falls back to the
+caller's default backward.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_WINDOW = (3, 3)
+_STRIDES = (2, 2)
+_PADDING = ((1, 1), (1, 1))
+
+
+def supports(x, window, strides, padding) -> bool:
+    return (
+        tuple(window) == _WINDOW
+        and tuple(strides) == _STRIDES
+        and tuple(tuple(p) for p in padding) == _PADDING
+        and x.ndim == 4
+        and jnp.issubdtype(x.dtype, jnp.floating)
+    )
+
+
+def _kernel(x_ref, y_ref, g_ref, gx_ref, *, H, WC, C, taps=3):
+    """One [bn, H, W*C] block: accumulate all taps' credited gradient.
+
+    y_ref/g_ref hold the doubled grid [bn, 2Ho + 2, (2Wo + 2) * C] with a
+    one-slot border (y border = +inf so it never equals x; g border = 0).
+    """
+    x = x_ref[:]
+    # Parity masks: tap (kh, kw) reaches input (h, w) iff h + 1 - kh and
+    # w + 1 - kw are both even (i.e. land on an even doubled-grid slot).
+    h_idx = lax.broadcasted_iota(jnp.int32, (1, H, WC), 1)
+    w_idx = lax.broadcasted_iota(jnp.int32, (1, H, WC), 2) // C
+    gx = jnp.zeros_like(x)
+    for kh in range(taps):
+        # (h + 1 - kh) % 2 == 0, written % 2 == (1 - kh) % 2 on h alone.
+        mh = (h_idx % 2) == ((1 - kh) % 2)
+        for kw in range(taps):
+            mw = (w_idx % 2) == ((1 - kw) % 2)
+            # Doubled-grid slice for this tap: row h reads upsampled row
+            # h + 1 - kh, i.e. padded row h + 2 - kh; same for lanes in
+            # units of C.
+            y_tap = y_ref[:, 2 - kh : 2 - kh + H,
+                          (2 - kw) * C : (2 - kw) * C + WC]
+            g_tap = g_ref[:, 2 - kh : 2 - kh + H,
+                          (2 - kw) * C : (2 - kw) * C + WC]
+            hit = (x == y_tap) & mh & mw
+            gx = gx + jnp.where(hit, g_tap, jnp.zeros_like(g_tap))
+    gx_ref[:] = gx
+
+
+def _doubled_grid(a, H_pad_value):
+    """[N, Ho, Wo, C] -> [N, 2Ho + 2, (2Wo + 2) * C]: 2x nearest-neighbor
+    upsample plus a one-slot border filled with `H_pad_value`."""
+    N, Ho, Wo, C = a.shape
+    up = jnp.broadcast_to(
+        a[:, :, None, :, None, :], (N, Ho, 2, Wo, 2, C)
+    ).reshape(N, 2 * Ho, 2 * Wo, C)
+    up = jnp.pad(
+        up, ((0, 0), (1, 1), (1, 1), (0, 0)),
+        constant_values=H_pad_value,
+    )
+    return up.reshape(N, 2 * Ho + 2, (2 * Wo + 2) * C)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def pool_bwd(x, y, g, block_n: int = 4, interpret: bool = False):
+    """Gradient of `reduce_window(max, 3x3, stride 2, pad 1)` wrt x.
+
+    x: [N, H, W, C] pool input; y: pooled output; g: cotangent of y.
+    """
+    from jax.experimental import pallas as pl
+
+    N, H, W, C = x.shape
+    _, Ho, Wo, _ = y.shape
+    WC = W * C
+
+    y_d = _doubled_grid(y, jnp.inf)
+    g_d = _doubled_grid(g, 0)
+    x3 = x.reshape(N, H, WC)
+
+    grid = (pl.cdiv(N, block_n),)
+    kernel = functools.partial(_kernel, H=H, WC=WC, C=C)
+    gx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, H, WC), lambda n: (n, 0, 0)),
+            pl.BlockSpec(
+                (block_n, 2 * Ho + 2, (2 * Wo + 2) * C), lambda n: (n, 0, 0)
+            ),
+            pl.BlockSpec(
+                (block_n, 2 * Ho + 2, (2 * Wo + 2) * C), lambda n: (n, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((block_n, H, WC), lambda n: (n, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, H, WC), x.dtype),
+        interpret=interpret,
+    )(x3, y_d, g_d)
+    return gx.reshape(N, H, W, C)
