@@ -46,9 +46,10 @@ STEPS = 10
 WARMUP = 2
 
 # Probe schedule: (timeout_s, sleep_after_failure_s). Total worst case
-# ~13 min before the CPU fallback — the tunnel often comes back within
-# minutes, and a real-TPU number is worth the wait.
-PROBE_SCHEDULE = ((120, 30), (300, 60), (300, 0))
+# ~33 min before the CPU fallback — the tunnel has been observed wedging
+# for long stretches, and a real-TPU number is worth the wait (a CPU
+# fallback line is close to worthless as a TPU benchmark).
+PROBE_SCHEDULE = ((120, 30), (300, 60), (300, 120), (300, 300), (300, 0))
 
 # Peak bf16 TFLOP/s per chip by device kind (public figures). MFU is
 # best-effort: unknown kinds report achieved TFLOP/s with mfu=null.

@@ -37,6 +37,17 @@ class FramedSocket {
 
   FramedSocket(const FramedSocket&) = delete;
   FramedSocket& operator=(const FramedSocket&) = delete;
+  FramedSocket(FramedSocket&& other) noexcept : fd_(other.fd_) {
+    other.fd_ = -1;
+  }
+
+  // Wrap an already-connected fd (server-accepted stream); takes
+  // ownership (closes it in the destructor).
+  static FramedSocket adopt(int fd) {
+    FramedSocket s;
+    s.fd_ = fd;
+    return s;
+  }
 
   // "unix:/path" or "host:port", retrying until deadline_s.
   void connect(const std::string& address, double deadline_s) {

@@ -22,6 +22,7 @@
 #include <vector>
 
 #include "actor_pool.h"
+#include "env_server.h"
 #include "queues.h"
 
 namespace {
@@ -621,6 +622,277 @@ PyTypeObject PyActorPoolType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+// --- EnvServer
+// C++ socket/threading mechanics (csrc/env_server.h) + Python hooks that
+// take the GIL only around env calls, mirroring the reference's embedding
+// of Python envs in a C++ gRPC server (rpcenv.cc:36-156, GIL handling at
+// 47/95). Wraps each raw env in the same torchbeast_tpu Environment
+// adapter the Python server uses, so episode accounting and auto-reset
+// semantics are literally shared code.
+
+namespace wire = tbt::wire;
+
+// RAII GIL for hook bodies running on C++ server threads.
+struct GILGuard {
+  PyGILState_STATE state;
+  GILGuard() : state(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state); }
+};
+
+// RAII owned reference: decrefs on every exit path (hook bodies throw
+// through C++ exceptions, which would skip manual Py_DECREFs).
+struct PyRef {
+  PyObject* p;
+  explicit PyRef(PyObject* p) : p(p) {}
+  ~PyRef() { Py_XDECREF(p); }
+  PyRef(const PyRef&) = delete;
+  PyRef& operator=(const PyRef&) = delete;
+  explicit operator bool() const { return p != nullptr; }
+};
+
+// Fetch + clear the pending Python error and raise it as a C++ exception
+// (the server reports it to the client as an error frame).
+[[noreturn]] void throw_py_error() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptraceback = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptraceback);
+  std::string msg = "python error";
+  if (ptype) {
+    PyObject* name = PyObject_GetAttrString(ptype, "__name__");
+    if (name && PyUnicode_Check(name)) {
+      msg = PyUnicode_AsUTF8(name);
+    }
+    Py_XDECREF(name);
+  }
+  if (pvalue) {
+    PyObject* str = PyObject_Str(pvalue);
+    if (str && PyUnicode_Check(str)) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(str);
+    }
+    Py_XDECREF(str);
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptraceback);
+  PyErr_Clear();
+  throw std::runtime_error(msg);
+}
+
+// Copy a numpy-coercible Python value into an owned wire Array (a deep
+// copy: the result outlives the GIL scope, so it must not borrow numpy
+// buffers the way nest_from_py does).
+Array array_copy_from_py(PyObject* obj) {
+  PyArrayObject* arr = reinterpret_cast<PyArrayObject*>(
+      PyArray_FROM_OF(obj, NPY_ARRAY_C_CONTIGUOUS | NPY_ARRAY_ALIGNED));
+  if (!arr) throw_py_error();
+  DType dtype;
+  if (!npy_to_dtype(PyArray_TYPE(arr), &dtype)) {
+    int t = PyArray_TYPE(arr);
+    Py_DECREF(arr);
+    throw std::invalid_argument("unsupported step dtype " +
+                                std::to_string(t));
+  }
+  std::vector<int64_t> shape(PyArray_NDIM(arr));
+  for (int i = 0; i < PyArray_NDIM(arr); ++i) shape[i] = PyArray_DIM(arr, i);
+  Array out(dtype, std::move(shape));
+  std::memcpy(out.mutable_data(), PyArray_DATA(arr), out.nbytes());
+  Py_DECREF(arr);
+  return out;
+}
+
+// Step dict (from Environment.initial()/step()) -> wire message. Adds
+// type="step" and, when non-negative, num_actions (the initial Step
+// doubles as the env spec, matching runtime/env_server.py).
+// Borrows `dict` (caller keeps ownership; safe against throws).
+wire::ValueNest step_to_wire(PyObject* dict, int64_t num_actions) {
+  if (!PyDict_Check(dict)) {
+    throw std::invalid_argument("env step must return a dict");
+  }
+  wire::ValueNest::Dict out;
+  out.emplace("type", wire::ValueNest(wire::Value::of_string("step")));
+  if (num_actions >= 0)
+    out.emplace("num_actions",
+                wire::ValueNest(wire::Value::of_int(num_actions)));
+  PyObject *key, *value;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(dict, &pos, &key, &value)) {
+    if (!PyUnicode_Check(key))
+      throw std::invalid_argument("step dict keys must be str");
+    out.emplace(PyUnicode_AsUTF8(key),
+                wire::ValueNest(wire::Value::of(array_copy_from_py(value))));
+  }
+  return wire::ValueNest(std::move(out));
+}
+
+int64_t action_from_wire(const wire::ValueNest& msg) {
+  if (!msg.is_dict()) throw std::invalid_argument("expected action dict");
+  const auto& dict = msg.dict();
+  auto type_it = dict.find("type");
+  if (type_it == dict.end() || !type_it->second.is_leaf() ||
+      type_it->second.leaf().kind != wire::Value::Kind::kString ||
+      type_it->second.leaf().s != "action")
+    throw std::invalid_argument("expected an action message");
+  auto it = dict.find("action");
+  if (it == dict.end() || !it->second.is_leaf())
+    throw std::invalid_argument("action message missing 'action'");
+  const wire::Value& v = it->second.leaf();
+  if (v.kind == wire::Value::Kind::kInt) return v.i;
+  if (v.kind == wire::Value::Kind::kArray) {
+    const Array& a = v.array;
+    if (a.numel() != 1)
+      throw std::invalid_argument("action array must have one element");
+    switch (a.dtype()) {
+      case DType::kI32:
+        return *reinterpret_cast<const int32_t*>(a.data());
+      case DType::kI64:
+        return *reinterpret_cast<const int64_t*>(a.data());
+      default:
+        throw std::invalid_argument("action array must be int32/int64");
+    }
+  }
+  throw std::invalid_argument("action must be an int");
+}
+
+// Per-stream Python state: the Environment adapter instance.
+struct PyStreamState {
+  PyObject* env = nullptr;
+};
+
+tbt::StreamHooks make_py_hooks(PyObject* env_init) {
+  auto state = std::make_shared<PyStreamState>();
+  tbt::StreamHooks hooks;
+  hooks.initial = [env_init, state]() -> wire::ValueNest {
+    GILGuard gil;
+    PyObject* raw = PyObject_CallNoArgs(env_init);
+    if (!raw) throw_py_error();
+    PyObject* envs_mod = PyImport_ImportModule("torchbeast_tpu.envs");
+    if (!envs_mod) {
+      Py_DECREF(raw);
+      throw_py_error();
+    }
+    PyObject* na =
+        PyObject_CallMethod(envs_mod, "num_actions_of", "O", raw);
+    Py_DECREF(envs_mod);
+    if (!na) {
+      Py_DECREF(raw);
+      throw_py_error();
+    }
+    int64_t num_actions = PyLong_AsLongLong(na);
+    Py_DECREF(na);
+    PyObject* env_mod =
+        PyImport_ImportModule("torchbeast_tpu.envs.environment");
+    if (!env_mod) {
+      Py_DECREF(raw);
+      throw_py_error();
+    }
+    PyObject* env =
+        PyObject_CallMethod(env_mod, "Environment", "O", raw);
+    Py_DECREF(env_mod);
+    Py_DECREF(raw);
+    if (!env) throw_py_error();
+    state->env = env;
+    PyRef step(PyObject_CallMethod(env, "initial", nullptr));
+    if (!step) throw_py_error();
+    return step_to_wire(step.p, num_actions);
+  };
+  hooks.step = [state](const wire::ValueNest& msg) -> wire::ValueNest {
+    int64_t action = action_from_wire(msg);  // no GIL needed
+    GILGuard gil;
+    PyRef step(PyObject_CallMethod(
+        state->env, "step", "L", static_cast<long long>(action)));
+    if (!step) throw_py_error();
+    return step_to_wire(step.p, -1);
+  };
+  hooks.close = [state] {
+    if (!state->env) return;
+    GILGuard gil;
+    PyObject* r = PyObject_CallMethod(state->env, "close", nullptr);
+    if (r)
+      Py_DECREF(r);
+    else
+      PyErr_Clear();
+    Py_DECREF(state->env);
+    state->env = nullptr;
+  };
+  return hooks;
+}
+
+struct PyEnvServer {
+  PyObject_HEAD
+  std::shared_ptr<tbt::EnvServer> server;
+  PyObject* env_init;
+};
+
+PyTypeObject PyEnvServerType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+int env_server_init(PyEnvServer* self, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {"env_init", "address", nullptr};
+  PyObject* env_init;
+  const char* address;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "Os",
+                                   const_cast<char**>(kwlist), &env_init,
+                                   &address))
+    return -1;
+  if (!PyCallable_Check(env_init)) {
+    PyErr_SetString(PyExc_TypeError, "env_init must be callable");
+    return -1;
+  }
+  Py_INCREF(env_init);
+  self->env_init = env_init;
+  try {
+    self->server = std::make_shared<tbt::EnvServer>(
+        address, [env_init] { return make_py_hooks(env_init); });
+    return 0;
+  } catch (...) {
+    set_py_error();
+    return -1;
+  }
+}
+
+PyObject* env_server_run(PyEnvServer* self, PyObject*) {
+  auto server = self->server;
+  if (!call_nogil([&] { server->run(); })) return nullptr;
+  // run() returns after stop(); make sure stream threads are gone before
+  // the caller proceeds to tear anything down.
+  if (!call_nogil([&] { server->join_all(); })) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject* env_server_stop(PyEnvServer* self, PyObject*) {
+  auto server = self->server;
+  if (!call_nogil([&] { server->stop(); })) return nullptr;
+  Py_RETURN_NONE;
+}
+
+void env_server_dealloc(PyEnvServer* self) {
+  // EnvServer's destructor stops and JOINS stream threads, whose hooks
+  // take the GIL — joining while holding it would deadlock.
+  auto release = [&] { self->server.reset(); };
+  if (self->server) call_nogil(release);
+  self->server.~shared_ptr();
+  Py_XDECREF(self->env_init);
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* env_server_new(PyTypeObject* type, PyObject*, PyObject*) {
+  PyEnvServer* self =
+      reinterpret_cast<PyEnvServer*>(type->tp_alloc(type, 0));
+  if (self) {
+    new (&self->server) std::shared_ptr<tbt::EnvServer>();
+    self->env_init = nullptr;
+  }
+  return reinterpret_cast<PyObject*>(self);
+}
+
+PyMethodDef env_server_methods[] = {
+    {"run", reinterpret_cast<PyCFunction>(env_server_run), METH_NOARGS,
+     nullptr},
+    {"stop", reinterpret_cast<PyCFunction>(env_server_stop), METH_NOARGS,
+     nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
 // ---------------------------------------------------------------- module
 PyModuleDef module_def = {
     PyModuleDef_HEAD_INIT, "_tbt_core",
@@ -667,11 +939,16 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
             pool_new, reinterpret_cast<initproc>(pool_init),
             reinterpret_cast<destructor>(pool_dealloc), pool_methods, nullptr,
             nullptr, nullptr);
+  init_type(&PyEnvServerType, "_tbt_core.EnvServer", sizeof(PyEnvServer),
+            env_server_new, reinterpret_cast<initproc>(env_server_init),
+            reinterpret_cast<destructor>(env_server_dealloc),
+            env_server_methods, nullptr, nullptr, nullptr);
 
   if (PyType_Ready(&PyBatchingQueueType) < 0 ||
       PyType_Ready(&PyBatchType) < 0 ||
       PyType_Ready(&PyDynamicBatcherType) < 0 ||
-      PyType_Ready(&PyActorPoolType) < 0)
+      PyType_Ready(&PyActorPoolType) < 0 ||
+      PyType_Ready(&PyEnvServerType) < 0)
     return nullptr;
 
   PyObject* module = PyModule_Create(&module_def);
@@ -694,6 +971,9 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
                      reinterpret_cast<PyObject*>(&PyDynamicBatcherType));
   PyModule_AddObject(module, "ActorPool",
                      reinterpret_cast<PyObject*>(&PyActorPoolType));
+  Py_INCREF(&PyEnvServerType);
+  PyModule_AddObject(module, "EnvServer",
+                     reinterpret_cast<PyObject*>(&PyEnvServerType));
   PyModule_AddObject(module, "ClosedBatchingQueue", ClosedBatchingQueueError);
   PyModule_AddObject(module, "AsyncError", AsyncErrorError);
   return module;

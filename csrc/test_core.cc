@@ -12,6 +12,8 @@
 #include <vector>
 
 #include "array.h"
+#include "client.h"
+#include "env_server.h"
 #include "nest.h"
 #include "queues.h"
 #include "wire.h"
@@ -360,6 +362,83 @@ static void test_dynamic_batcher() {
   std::printf("dynamic batcher ok\n");
 }
 
+
+void test_env_server() {
+  // Counting "env" implemented as hooks: initial -> step 0; each action
+  // increments by the action value. A throwing step produces an error
+  // frame. stop() severs live streams mid-recv.
+  std::string address = "unix:/tmp/tbt_test_env_server";
+  auto factory = [] {
+    auto count = std::make_shared<int64_t>(0);
+    StreamHooks hooks;
+    hooks.initial = [count] {
+      wire::ValueNest::Dict d;
+      d.emplace("type", wire::ValueNest(wire::Value::of_string("step")));
+      d.emplace("count", wire::ValueNest(wire::Value::of_int(*count)));
+      return wire::ValueNest(std::move(d));
+    };
+    hooks.step = [count](const wire::ValueNest& msg) {
+      const auto& dict = msg.dict();
+      int64_t action = dict.at("action").leaf().i;
+      if (action < 0) throw std::runtime_error("negative action");
+      *count += action;
+      wire::ValueNest::Dict d;
+      d.emplace("type", wire::ValueNest(wire::Value::of_string("step")));
+      d.emplace("count", wire::ValueNest(wire::Value::of_int(*count)));
+      return wire::ValueNest(std::move(d));
+    };
+    hooks.close = [] {};
+    return hooks;
+  };
+  EnvServer server(address, factory);
+  std::thread server_thread([&server] { server.run(); });
+
+  auto send_action = [](FramedSocket& sock, int64_t a) {
+    wire::ValueNest::Dict d;
+    d.emplace("type", wire::ValueNest(wire::Value::of_string("action")));
+    d.emplace("action", wire::ValueNest(wire::Value::of_int(a)));
+    sock.send(wire::ValueNest(std::move(d)));
+  };
+
+  {
+    FramedSocket sock;
+    sock.connect(address, 10.0);
+    wire::ValueNest initial = sock.recv();
+    CHECK(initial.dict().at("count").leaf().i == 0);
+    send_action(sock, 5);
+    CHECK(sock.recv().dict().at("count").leaf().i == 5);
+    send_action(sock, 2);
+    CHECK(sock.recv().dict().at("count").leaf().i == 7);
+  }
+  {
+    // Fresh stream gets a fresh env (count resets).
+    FramedSocket sock;
+    sock.connect(address, 10.0);
+    CHECK(sock.recv().dict().at("count").leaf().i == 0);
+    // Error path: hook throws -> error frame.
+    send_action(sock, -1);
+    wire::ValueNest err = sock.recv();
+    CHECK(err.dict().at("type").leaf().s == "error");
+    CHECK(err.dict().at("message").leaf().s.find("negative action") !=
+          std::string::npos);
+  }
+  {
+    // stop() severs a live stream blocked in recv.
+    FramedSocket sock;
+    sock.connect(address, 10.0);
+    CHECK(sock.recv().dict().at("count").leaf().i == 0);
+    std::thread stopper([&server] {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      server.stop();
+    });
+    CHECK_THROWS(sock.recv(), SocketError);
+    stopper.join();
+  }
+  server_thread.join();
+  server.join_all();
+  std::printf("env server ok\n");
+}
+
 int main() {
   test_array_concat_slice();
   test_nest_ops();
@@ -369,6 +448,7 @@ int main() {
   test_batching_queue_timeout_zero();
   test_queue_stress();
   test_dynamic_batcher();
+  test_env_server();
   std::printf("ALL NATIVE CORE TESTS PASSED\n");
   return 0;
 }

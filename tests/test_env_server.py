@@ -1,7 +1,12 @@
 """Env server + actor pool integration over real sockets (reference
 strategy: tests/core_agent_state_test.py — real transport, deterministic
 counting env, inference/learn loops driven inline; asserts the on-policy
-invariants across the full async stack)."""
+invariants across the full async stack).
+
+Parametrized over BOTH server implementations — the Python EnvServer and
+the C++ one (_tbt_core.EnvServer, csrc/env_server.h) — which must speak
+an identical protocol (same spec advertisement, step schema, error
+frames, stop() semantics)."""
 
 import os
 import tempfile
@@ -15,18 +20,49 @@ from torchbeast_tpu.runtime import wire
 from torchbeast_tpu.runtime.actor_pool import ActorPool
 from torchbeast_tpu.runtime.env_server import EnvServer, parse_address
 from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.native import import_native
 from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
 
 EPISODE_LEN = 5
 T = 3
 
+SERVER_IMPLS = ["python"]
+if import_native() is not None:
+    SERVER_IMPLS.append("native")
 
-def start_counting_server(path):
+
+class _NativeServerHandle:
+    """Python-EnvServer-compatible start()/stop() around the C++ server
+    (whose run() blocks, like the reference's Server.run)."""
+
+    def __init__(self, env_init, address):
+        self._server = import_native().EnvServer(env_init, address)
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.run, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.stop()
+        if self._thread is not None:
+            self._thread.join(5)
+
+
+def make_server(env_init, address, impl):
+    if impl == "native":
+        return _NativeServerHandle(env_init, address)
+    return EnvServer(env_init, address)
+
+
+def start_counting_server(path, impl="python"):
     """Start an EnvServer on unix:{path} and wait for it to bind."""
     import time
 
-    server = EnvServer(
-        lambda: CountingEnv(episode_length=EPISODE_LEN), f"unix:{path}"
+    server = make_server(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), f"unix:{path}", impl
     )
     server.start()
     deadline = time.monotonic() + 5
@@ -37,10 +73,10 @@ def start_counting_server(path):
     return server
 
 
-@pytest.fixture
-def server_address():
+@pytest.fixture(params=SERVER_IMPLS)
+def server_address(request):
     path = os.path.join(tempfile.mkdtemp(), "env_server")
-    server = start_counting_server(path)
+    server = start_counting_server(path, request.param)
     yield f"unix:{path}"
     server.stop()
 
@@ -188,12 +224,13 @@ def test_actor_pool_invariants(server_address):
         prev = batch
 
 
-def test_actor_reconnects_after_server_restart():
+@pytest.mark.parametrize("impl", SERVER_IMPLS)
+def test_actor_reconnects_after_server_restart(impl):
     """Elastic actors: killing the env server mid-stream and restarting it
     must not kill the pool when max_reconnects > 0."""
     path = os.path.join(tempfile.mkdtemp(), "elastic_env")
     address = f"unix:{path}"
-    server = start_counting_server(path)
+    server = start_counting_server(path, impl)
     learner_queue = BatchingQueue(
         batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
     )
@@ -220,7 +257,7 @@ def test_actor_reconnects_after_server_restart():
     next(it)  # at least one rollout through the first connection
 
     server.stop()  # cut the stream mid-training
-    server = start_counting_server(path)
+    server = start_counting_server(path, impl)
 
     # The actor must reconnect and keep producing rollouts.
     for _ in range(3):
@@ -234,7 +271,8 @@ def test_actor_reconnects_after_server_restart():
     server.stop()
 
 
-def test_env_exception_surfaces():
+@pytest.mark.parametrize("impl", SERVER_IMPLS)
+def test_env_exception_surfaces(impl):
     class ExplodingEnv:
         num_actions = 2
 
@@ -246,7 +284,7 @@ def test_env_exception_surfaces():
 
     path = os.path.join(tempfile.mkdtemp(), "exploding")
     address = f"unix:{path}"
-    server = EnvServer(ExplodingEnv, address)
+    server = make_server(ExplodingEnv, address, impl)
     server.start()
     import socket
     import time

@@ -155,7 +155,10 @@ def test_resnet_feature_size():
     assert fc_kernel.shape == (3872, 256)
 
 
-@pytest.mark.parametrize("remat", [False, True, (True, False, False)])
+@pytest.mark.parametrize(
+    "remat",
+    [False, True, (True, False, False), "front", ("front", True, False)],
+)
 def test_resnet_remat_variants_identical(remat):
     # Rematerialization is a scheduling choice, not a numerical one: every
     # remat setting must produce the same params tree, outputs, and

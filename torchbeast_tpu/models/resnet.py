@@ -22,35 +22,46 @@ class ResNetBase(nn.Module):
 
     channels: Sequence[int] = (16, 32, 32)
     dtype: Any = jnp.float32
-    # Per-stage rematerialization: True/False for all stages, or a tuple of
-    # per-stage booleans. Stage 0's activations are the memory hog (~1.1 GB
-    # each at T=80 B=32 vs ~0.14-0.54 GB for later stages); (True, False,
-    # False) trades ~2 GB of saved activations for skipping ~60% of the
-    # recompute FLOPs. Default: remat everything — the configuration whose
-    # fit on a 15.75 GB v5e is measured.
+    # Per-stage rematerialization: one value for all stages or a tuple of
+    # per-stage values, each False (save everything), True (remat the whole
+    # stage), or "front" (remat only the conv+pool front — drops the
+    # stage's pre-pool activation, the memory hog at ~1.1 GB for stage 0
+    # at T=80 B=32, while the cheap post-pool res-block activations stay
+    # saved; recompute is just one conv+pool instead of the whole stage).
+    # Default: remat everything — the configuration whose fit on a
+    # 15.75 GB v5e is measured.
     remat: Any = True
 
-    def _stage(self, x, i):
-        conv3 = lambda feat, name: nn.Conv(  # noqa: E731
+    def _conv3(self, feat, name):
+        return nn.Conv(
             feat, (3, 3), strides=(1, 1), padding="SAME", dtype=self.dtype,
             name=name,
         )
-        num_ch = self.channels[i]
-        x = conv3(num_ch, f"feat_conv_{i}")(x)
+
+    def _stage_front(self, x, i):
+        """conv + pool: produces (and under 'front' remat, re-produces)
+        the stage's only pre-pool-resolution activation — the memory hog."""
+        x = self._conv3(self.channels[i], f"feat_conv_{i}")(x)
         # ops.pool.max_pool2d: forward-identical to nn.max_pool, but
         # its custom VJP avoids SelectAndScatter (10x the forward's
         # cost on XLA:CPU, slow on some TPU gens) in the backward.
-        x = max_pool2d(
+        return max_pool2d(
             x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
         )
+
+    def _stage_rest(self, x, i):
+        num_ch = self.channels[i]
         for j in range(2):
             res_input = x
             x = nn.relu(x)
-            x = conv3(num_ch, f"res_{i}_{j}_conv1")(x)
+            x = self._conv3(num_ch, f"res_{i}_{j}_conv1")(x)
             x = nn.relu(x)
-            x = conv3(num_ch, f"res_{i}_{j}_conv2")(x)
+            x = self._conv3(num_ch, f"res_{i}_{j}_conv2")(x)
             x = x + res_input
         return x
+
+    def _stage(self, x, i):
+        return self._stage_rest(self._stage_front(x, i), i)
 
     @nn.compact
     def __call__(self, frame):
@@ -75,10 +86,20 @@ class ResNetBase(nn.Module):
                 f"remat={self.remat!r} must have one flag per stage "
                 f"({len(self.channels)})"
             )
-        rematted = nn.remat(ResNetBase._stage, static_argnums=(2,))
-        for i in range(len(self.channels)):
-            fn = rematted if flags[i] else ResNetBase._stage
-            x = fn(self, x, i)
+        for f in flags:
+            if f not in (False, True, "front"):
+                raise ValueError(
+                    f"remat flag {f!r} must be False, True, or 'front'"
+                )
+        whole = nn.remat(ResNetBase._stage, static_argnums=(2,))
+        front = nn.remat(ResNetBase._stage_front, static_argnums=(2,))
+        for i, flag in enumerate(flags):
+            if flag == "front":
+                x = self._stage_rest(front(self, x, i), i)
+            elif flag:
+                x = whole(self, x, i)
+            else:
+                x = ResNetBase._stage(self, x, i)
 
         x = nn.relu(x)
         x = x.reshape((T * B, -1))  # 11*11*32 = 3872 for 84x84 input

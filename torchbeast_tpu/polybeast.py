@@ -87,6 +87,10 @@ def make_parser():
                         help="Use the C++ queues/batcher/actor-pool "
                              "(_tbt_core; build with "
                              "scripts/build_native.sh).")
+    parser.add_argument("--native_server", action="store_true",
+                        help="Serve environments with the C++ EnvServer "
+                             "(GIL-free socket I/O; combined-launcher "
+                             "mode only).")
     parser.add_argument("--sequence_parallel", type=int, default=0,
                         help="Shard the transformer's unroll (time) axis "
                              "over N devices (ring attention over a `seq` "

@@ -30,6 +30,11 @@ def make_parser():
     parser.add_argument("--num_servers", type=int, default=4)
     parser.add_argument("--env", type=str, default="PongNoFrameskip-v4",
                         help="Gym environment (or Mock / Counting).")
+    parser.add_argument("--native_server", action="store_true",
+                        help="Serve with the C++ EnvServer (_tbt_core): "
+                             "socket I/O and wire codec run GIL-free, the "
+                             "GIL is taken only around env calls (the "
+                             "reference's rpcenv.cc embedding).")
     return parser
 
 
@@ -55,22 +60,36 @@ def host_scoped_basename(pipes_basename: str, process_id: int,
     return f"{host}:{int(port) + process_id * num_servers}"
 
 
-def _serve(env_name: str, address: str):
+def _serve(env_name: str, address: str, native: bool = False):
     # Child process body. Import here: workers must never inherit JAX state.
     from torchbeast_tpu.envs import create_env
+
+    env_init = functools.partial(create_env, env_name)
+    if native:
+        from torchbeast_tpu.runtime.native import import_native
+
+        core = import_native()
+        if core is None:
+            raise RuntimeError(
+                "--native_server requested but _tbt_core is not built; "
+                "run scripts/build_native.sh"
+            )
+        core.EnvServer(env_init, address).run()
+        return
     from torchbeast_tpu.runtime.env_server import EnvServer
 
-    EnvServer(functools.partial(create_env, env_name), address).run()
+    EnvServer(env_init, address).run()
 
 
 def start_servers(flags, ctx_name: str = "spawn", pipes_basename=None):
     basename = pipes_basename or flags.pipes_basename
+    native = getattr(flags, "native_server", False)
     ctx = mp.get_context(ctx_name)
     processes = []
     for i in range(flags.num_servers):
         address = server_address(basename, i)
         p = ctx.Process(
-            target=_serve, args=(flags.env, address), daemon=True
+            target=_serve, args=(flags.env, address, native), daemon=True
         )
         p.start()
         processes.append(p)
