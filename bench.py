@@ -30,10 +30,8 @@ keyed per host CPU so an AOT result built on one machine is never loaded on
 another (SIGILL risk).
 """
 
-import hashlib
 import json
 import os
-import platform as platform_mod
 import subprocess
 import sys
 import time
@@ -110,20 +108,11 @@ def _acquire_backend():
 
 
 def _cache_dir() -> str:
-    """Per-host-CPU compile cache: XLA:CPU AOT results encode machine
-    features, so a cache shared across hosts can SIGILL."""
-    try:
-        with open("/proc/cpuinfo") as f:
-            fingerprint = next(
-                (line for line in f if line.startswith("flags")), ""
-            )
-    except OSError:
-        fingerprint = ""
-    # ISA flags only — hostname would bust the cache on pod churn without
-    # adding any SIGILL protection.
-    fingerprint += platform_mod.machine()
-    key = hashlib.sha1(fingerprint.encode()).hexdigest()[:10]
-    return os.path.expanduser(f"~/.cache/torchbeast_tpu_xla_{key}")
+    """Per-host-CPU compile cache (shared helper; a cache shared across
+    hosts can load foreign AOT results and SIGILL)."""
+    from torchbeast_tpu.utils.xla_cache import host_keyed_cache_dir
+
+    return host_keyed_cache_dir()
 
 
 def _cost_analysis_flops(jitted, *args):

@@ -20,10 +20,11 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+from torchbeast_tpu.utils.xla_cache import host_keyed_cache_dir  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: repeat suite runs skip XLA recompiles.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.expanduser("~/.cache/torchbeast_tpu_xla"),
-)
+# Host-keyed — a shared dir would load AOT results compiled on another
+# machine's ISA (SIGILL risk when the container image moves hosts).
+jax.config.update("jax_compilation_cache_dir", host_keyed_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,174 @@
+"""MoE layer (models/moe.py) + expert parallelism (parallel/ep.py).
+
+Oracles: with identical expert weights and ample capacity the mixture
+must equal a single dense FFN (renormalized gates sum to 1); the
+expert-sharded run must match the unsharded run bitwise-close; capacity
+overflow must drop, not corrupt."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu.models import create_model
+from torchbeast_tpu.models.moe import MoEFFN
+from torchbeast_tpu.parallel.ep import (
+    expert_param_shardings,
+    place_expert_params,
+)
+
+D, FF, E = 8, 16, 4
+
+
+def _init(key, moe, tokens=16):
+    x = jax.random.normal(jax.random.PRNGKey(9), (tokens, D))
+    params = moe.init(key, x)
+    return params, x
+
+
+def test_identical_experts_equal_dense_ffn():
+    moe = MoEFFN(
+        d_model=D, d_ff=FF, num_experts=E, top_k=2, capacity_factor=16.0
+    )
+    params, x = _init(jax.random.PRNGKey(0), moe)
+    p = params["params"]
+    # Collapse every expert onto expert 0's weights.
+    p = dict(
+        p,
+        w_in=jnp.broadcast_to(p["w_in"][:1], p["w_in"].shape),
+        b_in=jnp.broadcast_to(p["b_in"][:1], p["b_in"].shape),
+        w_out=jnp.broadcast_to(p["w_out"][:1], p["w_out"].shape),
+        b_out=jnp.broadcast_to(p["b_out"][:1], p["b_out"].shape),
+    )
+    y = moe.apply({"params": p}, x)
+    dense = (
+        nn.gelu(x @ p["w_in"][0] + p["b_in"][0]) @ p["w_out"][0]
+        + p["b_out"][0]
+    )
+    np.testing.assert_allclose(y, dense, rtol=1e-5, atol=1e-5)
+
+
+def test_capacity_overflow_drops_tokens():
+    """Router forced onto one expert with capacity 2: exactly 2 tokens
+    get expert output, the rest fall back to zero (the residual around
+    the layer carries them)."""
+    tokens = 8
+    moe = MoEFFN(
+        d_model=D, d_ff=FF, num_experts=E, top_k=1, capacity_factor=1.0
+    )
+    params, x = _init(jax.random.PRNGKey(1), moe, tokens=tokens)
+    p = dict(params["params"])
+    router = np.zeros((D, E), np.float32)
+    router[:, 0] = 0.0  # uniform logits -> top_k ties resolve to expert 0
+    p["router"] = {"kernel": jnp.asarray(router)}
+    # capacity = ceil(1 * 8 / 4 * 1.0) = 2
+    y = moe.apply({"params": p}, x)
+    nonzero_rows = np.flatnonzero(np.abs(np.asarray(y)).sum(axis=1) > 1e-9)
+    assert len(nonzero_rows) == 2, nonzero_rows
+    np.testing.assert_array_equal(nonzero_rows, [0, 1])  # token order wins
+
+
+def test_expert_parallel_matches_unsharded():
+    n_dev = 8
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("expert",))
+    moe_plain = MoEFFN(d_model=D, d_ff=FF, num_experts=n_dev, top_k=2)
+    moe_ep = MoEFFN(
+        d_model=D, d_ff=FF, num_experts=n_dev, top_k=2, mesh=mesh
+    )
+    params, x = _init(jax.random.PRNGKey(2), moe_plain, tokens=32)
+    y_plain = moe_plain.apply(params, x)
+
+    placed = {
+        "params": place_expert_params(mesh, params["params"])
+    }
+    shardings = expert_param_shardings(mesh, params["params"])
+    assert not shardings["w_in"].is_fully_replicated
+    assert shardings["router"]["kernel"].is_fully_replicated
+    y_ep = jax.jit(moe_ep.apply)(placed, x)
+    np.testing.assert_allclose(y_ep, y_plain, rtol=1e-5, atol=1e-5)
+
+
+def test_aux_loss_sown_and_balanced_floor():
+    moe = MoEFFN(
+        d_model=D, d_ff=FF, num_experts=E, top_k=2, aux_loss_weight=1.0
+    )
+    params, x = _init(jax.random.PRNGKey(3), moe, tokens=64)
+    _, variables = moe.apply(params, x, mutable=["losses"])
+    assert "losses" not in params  # init() must not materialize it
+    aux = variables["losses"]["moe_load_balance"]
+    # E * sum(f_e * p_e) >= 1 with equality iff perfectly uniform.
+    assert float(aux) >= 0.99
+
+
+def test_transformer_moe_trains_and_aux_flows():
+    T, B, A = 4, 4, 5
+    model = create_model(
+        "transformer", num_actions=A, num_layers=1, d_model=16,
+        num_heads=2, memory_len=4, num_experts=4,
+    )
+    rng = np.random.default_rng(4)
+    batch = {
+        "frame": rng.integers(0, 256, (T + 1, B, 4, 4, 1), dtype=np.uint8),
+        "reward": rng.standard_normal((T + 1, B)).astype(np.float32),
+        "done": rng.random((T + 1, B)) < 0.2,
+        "episode_return": rng.standard_normal((T + 1, B)).astype(
+            np.float32
+        ),
+        "episode_step": rng.integers(0, 9, (T + 1, B)).astype(np.int32),
+        "last_action": rng.integers(0, A, (T + 1, B)).astype(np.int32),
+        "action": rng.integers(0, A, (T + 1, B)).astype(np.int32),
+        "policy_logits": rng.standard_normal((T + 1, B, A)).astype(
+            np.float32
+        ),
+        "baseline": rng.standard_normal((T + 1, B)).astype(np.float32),
+    }
+    state = model.initial_state(B)
+    params = model.init(
+        {"params": jax.random.PRNGKey(5), "action": jax.random.PRNGKey(6)},
+        batch,
+        state,
+    )
+    hp = learner_lib.HParams(batch_size=B, unroll_length=T)
+    optimizer = learner_lib.make_optimizer(hp)
+    step = learner_lib.make_update_step(model, optimizer, hp, donate=False)
+    new_params, _, stats = step(params, optimizer.init(params), batch, state)
+    assert np.isfinite(float(stats["total_loss"]))
+    assert float(stats["aux_loss"]) > 0.0
+    # The aux loss must reach the router: its kernel has to move.
+    r_old = params["params"]["block_0"]["moe"]["router"]["kernel"]
+    r_new = new_params["params"]["block_0"]["moe"]["router"]["kernel"]
+    assert float(jnp.abs(r_new - r_old).max()) > 0.0
+
+
+def test_acting_path_unaffected_by_sow():
+    """model.apply WITHOUT mutable (the act path) still works — sow is a
+    no-op when the collection isn't mutable."""
+    A = 5
+    model = create_model(
+        "transformer", num_actions=A, num_layers=1, d_model=16,
+        num_heads=2, memory_len=4, num_experts=4,
+    )
+    B = 2
+    rng = np.random.default_rng(7)
+    inputs = {
+        "frame": rng.integers(0, 256, (1, B, 4, 4, 1), dtype=np.uint8),
+        "reward": np.zeros((1, B), np.float32),
+        "done": np.zeros((1, B), bool),
+        "last_action": np.zeros((1, B), np.int32),
+    }
+    state = model.initial_state(B)
+    params = model.init(
+        {"params": jax.random.PRNGKey(7), "action": jax.random.PRNGKey(8)},
+        dict(inputs, episode_return=np.zeros((1, B), np.float32),
+             episode_step=np.zeros((1, B), np.int32),
+             action=np.zeros((1, B), np.int32),
+             policy_logits=np.zeros((1, B, A), np.float32),
+             baseline=np.zeros((1, B), np.float32)),
+        state,
+    )
+    out, new_state = model.apply(
+        params, inputs, state, rngs={"action": jax.random.PRNGKey(9)}
+    )
+    assert out.action.shape == (1, B)

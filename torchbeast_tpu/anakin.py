@@ -76,7 +76,7 @@ def make_parser():
                         help="Parallel on-device environments.")
     parser.add_argument("--unroll_length", type=int, default=16)
     parser.add_argument("--model", default="mlp",
-                        choices=["mlp", "shallow", "deep", "transformer"])
+                        choices=["mlp", "shallow", "deep", "pipelined_mlp", "transformer"])
     parser.add_argument("--use_lstm", action="store_true")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--num_devices", type=int, default=1,

@@ -76,12 +76,22 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
 def compute_loss(
     model, params, batch: Dict[str, jnp.ndarray], initial_agent_state, hp: HParams
 ):
-    """Forward the full [T+1, B] batch and build the IMPALA loss."""
-    learner_outputs, _ = model.apply(
+    """Forward the full [T+1, B] batch and build the IMPALA loss.
+
+    Models may `sow` regularization terms into the `losses` collection
+    (e.g. the MoE load-balance loss, models/moe.py); every sown value is
+    added to the objective. Models that sow nothing pay nothing.
+    """
+    (learner_outputs, _), variables = model.apply(
         params,
         batch,
         initial_agent_state,
         sample_action=False,
+        mutable=["losses"],
+    )
+    aux_loss = sum(
+        jnp.sum(leaf)
+        for leaf in jax.tree_util.tree_leaves(variables.get("losses", {}))
     )
 
     bootstrap_value = learner_outputs.baseline[-1]
@@ -116,7 +126,7 @@ def compute_loss(
         vtrace_returns.vs - values
     )
     entropy_loss = hp.entropy_cost * compute_entropy_loss(target_logits)
-    total_loss = pg_loss + baseline_loss + entropy_loss
+    total_loss = pg_loss + baseline_loss + entropy_loss + aux_loss
 
     # Episode stats: fixed-shape aggregates (a boolean-mask gather would be
     # dynamic-shaped and unjittable); the host divides sum by count.
@@ -130,6 +140,7 @@ def compute_loss(
         "pg_loss": pg_loss,
         "baseline_loss": baseline_loss,
         "entropy_loss": entropy_loss,
+        "aux_loss": jnp.asarray(aux_loss, jnp.float32),
         "episode_returns_sum": episode_returns_sum,
         "episode_count": episode_count,
     }

@@ -8,6 +8,7 @@ MonoBeast's AtariNet (monobeast.py:545) and PolyBeast's deep ResNet
 from torchbeast_tpu.models.atari_net import AtariNet  # noqa: F401
 from torchbeast_tpu.models.cores import LSTMCore  # noqa: F401
 from torchbeast_tpu.models.mlp import MLPNet  # noqa: F401
+from torchbeast_tpu.models.pipelined import PipelinedMLPNet  # noqa: F401
 from torchbeast_tpu.models.resnet import ResNet  # noqa: F401
 from torchbeast_tpu.models.transformer import TransformerNet  # noqa: F401
 
@@ -17,6 +18,7 @@ _REGISTRY = {
     "deep": ResNet,
     "resnet": ResNet,
     "mlp": MLPNet,
+    "pipelined_mlp": PipelinedMLPNet,
     "transformer": TransformerNet,
 }
 

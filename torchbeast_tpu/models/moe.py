@@ -1,0 +1,149 @@
+"""Mixture-of-experts feed-forward layer with expert parallelism.
+
+Beyond the reference (its nets are small dense conv+LSTM, SURVEY.md §2.3):
+this is the layer that gives the framework an `expert` sharding axis. The
+design is TPU-first throughout:
+
+- Routing is TOP-K with a fixed CAPACITY per expert, and dispatch/combine
+  are dense one-hot einsums — static shapes, pure matmuls on the MXU; no
+  gather/scatter, no dynamic shapes, nothing XLA can't tile.
+- With a mesh carrying an `expert` axis, the expert-stacked tensors
+  (`w_in [E, d, ff]`, the `[E, C, d]` dispatched activations) are
+  sharding-constrained over that axis; XLA inserts the dispatch/combine
+  all-to-alls on ICI. No hand-written collectives.
+- The load-balance auxiliary loss is sown into the `losses` collection;
+  the learner adds every sown loss to the objective (a no-op for models
+  that sow nothing — and `sow` itself is a no-op outside mutable apply,
+  so the acting path is untouched).
+
+Routing semantics (fresh implementation of the standard top-k/capacity
+scheme): each token picks its top-k experts by router probability; the
+selected gates are renormalized to sum to 1; experts take at most
+`capacity` assignments, earlier-rank selections win capacity first and
+ties break by token order; over-capacity assignments are dropped (the
+token's output loses that expert's contribution — with the residual
+connection around the layer this degrades gracefully).
+"""
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _constrain(x, mesh, spec):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec)
+    )
+
+
+class MoEFFN(nn.Module):
+    """[tokens, d_model] -> [tokens, d_model] mixture of expert MLPs."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    mesh: Optional[Any] = None  # mesh with an `expert` axis -> EP
+    expert_axis: str = "expert"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tokens, d = x.shape
+        E, K = self.num_experts, self.top_k
+        if K > E:
+            raise ValueError(f"top_k={K} exceeds num_experts={E}")
+        capacity = max(
+            1, int(math.ceil(K * tokens / E * self.capacity_factor))
+        )
+        espec = P(self.expert_axis)
+
+        # --- Routing (f32 for a stable softmax regardless of self.dtype).
+        router_logits = nn.Dense(
+            E, use_bias=False, name="router"
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [t, E]
+        gate, idx = jax.lax.top_k(probs, K)  # [t, K]
+        gate = gate / (gate.sum(axis=-1, keepdims=True) + 1e-9)
+
+        # --- Capacity assignment. Rank-major flattening gives rank-0
+        # selections strict priority over rank-1, then token order.
+        sel = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [t, K, E]
+        sel_flat = sel.transpose(1, 0, 2).reshape(K * tokens, E)
+        pos_flat = jnp.cumsum(sel_flat, axis=0) - sel_flat
+        pos = pos_flat.reshape(K, tokens, E).transpose(1, 0, 2)  # [t, K, E]
+        kept = sel * (pos < capacity)
+
+        # slot[t, k, e, c]: one-hot over the capacity slot this (token,
+        # rank) pair occupies in expert e, zero if dropped.
+        slot = jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        ) * kept[..., None]
+        dispatch = slot.sum(axis=1)  # [t, E, C] (0/1)
+        combine = (gate[:, :, None, None] * slot).sum(axis=1)  # [t, E, C]
+
+        # --- Expert computation: batched matmuls over the expert axis.
+        kernel_init = nn.initializers.lecun_normal()
+        w_in = self.param(
+            "w_in", kernel_init, (E, d, self.d_ff)
+        ).astype(self.dtype)
+        b_in = self.param("b_in", nn.initializers.zeros, (E, self.d_ff))
+        w_out = self.param(
+            "w_out", kernel_init, (E, self.d_ff, d)
+        ).astype(self.dtype)
+        b_out = self.param("b_out", nn.initializers.zeros, (E, d))
+
+        w_in = _constrain(w_in, self.mesh, P(self.expert_axis, None, None))
+        w_out = _constrain(w_out, self.mesh, P(self.expert_axis, None, None))
+
+        # Dispatch all-to-all: [t, E, C] x [t, d] -> [E, C, d] sharded
+        # over `expert`.
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), x.astype(self.dtype)
+        )
+        expert_in = _constrain(expert_in, self.mesh, P(self.expert_axis))
+        h = nn.gelu(
+            jnp.einsum("ecd,edf->ecf", expert_in, w_in)
+            + b_in[:, None, :].astype(self.dtype)
+        )
+        h = _constrain(h, self.mesh, P(self.expert_axis))
+        expert_out = (
+            jnp.einsum("ecf,efd->ecd", h, w_out)
+            + b_out[:, None, :].astype(self.dtype)
+        )
+        expert_out = _constrain(expert_out, self.mesh, P(self.expert_axis))
+        # Combine all-to-all back to token order.
+        y = jnp.einsum(
+            "ecd,tec->td",
+            expert_out.astype(jnp.float32),
+            combine.astype(jnp.float32),
+        )
+
+        # --- Load-balance loss (top-1 dispatch fraction x mean router
+        # prob, scaled so a perfectly uniform router scores 1.0 before
+        # weighting).
+        top1 = jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32)
+        frac_dispatched = top1.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        aux = E * jnp.sum(frac_dispatched * mean_prob)
+        # Guarded so init() never materializes a `losses` collection in
+        # the variables dict (it would end up inside checkpoints and the
+        # optimizer state); overwrite-reduce so re-application can never
+        # double-count.
+        if not self.is_initializing():
+            self.sow(
+                "losses",
+                "moe_load_balance",
+                self.aux_loss_weight * aux,
+                reduce_fn=lambda prev, new: new,
+            )
+
+        return y.astype(jnp.float32)

@@ -58,6 +58,9 @@ class _Block(nn.Module):
     seq_axis: str = "seq"
     ring_schedule: str = "contiguous"  # or "zigzag" (balanced causal work)
     attention_impl: str = "dense"  # or "pallas": fused single-chip kernel
+    num_experts: int = 0  # >0 -> MoE FFN (models/moe.py)
+    moe_top_k: int = 2
+    moe_mesh: Any = None  # mesh with an `expert` axis -> expert parallel
 
     @nn.compact
     def __call__(self, x, cache, mask, offsets, cache_mask=None, seg=None,
@@ -142,11 +145,26 @@ class _Block(nn.Module):
         )(attended).astype(jnp.float32)
 
         h = nn.LayerNorm()(x)
-        h = nn.Dense(4 * self.d_model, dtype=self.dtype)(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(self.d_model, dtype=self.dtype)(h).astype(
-            jnp.float32
-        )
+        if self.num_experts > 0:
+            from torchbeast_tpu.models.moe import MoEFFN
+
+            Bq, Tq, d = h.shape
+            y = MoEFFN(
+                d_model=d,
+                d_ff=4 * d,
+                num_experts=self.num_experts,
+                top_k=self.moe_top_k,
+                mesh=self.moe_mesh,
+                dtype=self.dtype,
+                name="moe",
+            )(h.reshape(Bq * Tq, d))
+            x = x + y.reshape(Bq, Tq, d)
+        else:
+            h = nn.Dense(4 * self.d_model, dtype=self.dtype)(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.d_model, dtype=self.dtype)(h).astype(
+                jnp.float32
+            )
         return x, k.astype(jnp.float32), v.astype(jnp.float32)
 
 
@@ -162,6 +180,9 @@ class TransformerNet(nn.Module):
     seq_axis: str = "seq"
     ring_schedule: str = "contiguous"  # "contiguous" | "zigzag"
     attention_impl: str = "dense"  # "dense" | "pallas" (fused kernel)
+    num_experts: int = 0  # >0 -> MoE FFN in every block
+    moe_top_k: int = 2
+    moe_mesh: Optional[Any] = None  # mesh with `expert` axis -> EP
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -225,6 +246,9 @@ class TransformerNet(nn.Module):
                 mesh=self.mesh, seq_axis=self.seq_axis,
                 ring_schedule=self.ring_schedule,
                 attention_impl=self.attention_impl,
+                num_experts=self.num_experts,
+                moe_top_k=self.moe_top_k,
+                moe_mesh=self.moe_mesh,
                 name=f"block_{layer}",
             )(
                 x, (k_cache_b, v_cache_b), mask, offsets,

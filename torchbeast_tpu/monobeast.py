@@ -64,7 +64,7 @@ def make_parser():
     parser.add_argument("--unroll_length", type=int, default=80,
                         help="The unroll length (time dimension).")
     parser.add_argument("--model", default="shallow",
-                        choices=["shallow", "deep", "mlp", "transformer"],
+                        choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer"],
                         help="Model family (Mono used shallow; Poly deep; "
                              "mlp for tiny frames).")
     parser.add_argument("--use_lstm", action="store_true",
@@ -89,6 +89,21 @@ def make_parser():
                              "so T+1 is divisible by N — short/acting "
                              "forwards fall back to dense with the same "
                              "params).")
+    parser.add_argument("--pipeline_parallel", type=int, default=0,
+                        help="Run the pipelined_mlp tower as a GPipe "
+                             "pipeline over N devices (a `pipe` mesh "
+                             "axis; stage params one-per-chip, "
+                             "activations rotate via ppermute). Sets "
+                             "num_stages=N.")
+    parser.add_argument("--num_experts", type=int, default=0,
+                        help="Replace the transformer's FFN with a top-2 "
+                             "mixture of N experts (model=transformer "
+                             "only; adds a sown load-balance loss).")
+    parser.add_argument("--expert_parallel", type=int, default=0,
+                        help="Shard the MoE experts over N devices (an "
+                             "`expert` mesh axis; dispatch/combine become "
+                             "XLA all-to-alls). Needs --num_experts "
+                             "divisible by N.")
     parser.add_argument("--ring_schedule", default="contiguous",
                         choices=["contiguous", "zigzag"],
                         help="Ring attention block schedule: zigzag "
@@ -223,6 +238,70 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             np.asarray(devices[:seq_par]), ("seq",)
         )
         extra["ring_schedule"] = ring_schedule
+    num_experts = getattr(flags, "num_experts", 0)
+    expert_par = getattr(flags, "expert_parallel", 0)
+    pipe_par = getattr(flags, "pipeline_parallel", 0)
+    if expert_par and not num_experts:
+        raise ValueError("--expert_parallel needs --num_experts")
+    n_parallel_axes = sum(
+        1 for n in (seq_par, expert_par, pipe_par) if n and n > 1
+    )
+    if n_parallel_axes > 1:
+        # Each flag builds its own 1-D mesh; two different meshes inside
+        # one jitted program is an XLA "incompatible devices" compile
+        # error — reject with a clear message instead. Combining axes
+        # needs a single multi-axis mesh (parallel/mesh.py is the place
+        # to grow one).
+        raise ValueError(
+            "--sequence_parallel, --expert_parallel and "
+            "--pipeline_parallel are mutually exclusive (each builds its "
+            "own device mesh; a combined run needs one multi-axis mesh)"
+        )
+    if pipe_par and pipe_par > 1:
+        if flags.model != "pipelined_mlp":
+            raise ValueError(
+                "--pipeline_parallel needs --model pipelined_mlp (the "
+                "other families have no stage-uniform tower to pipeline)"
+            )
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) < pipe_par:
+            raise ValueError(
+                f"--pipeline_parallel {pipe_par} but only "
+                f"{len(devices)} devices are visible"
+            )
+        extra["mesh"] = Mesh(np.asarray(devices[:pipe_par]), ("pipe",))
+        extra["num_stages"] = pipe_par
+    elif flags.model == "pipelined_mlp":
+        logging.getLogger(__name__).info(
+            "--model pipelined_mlp without --pipeline_parallel: the "
+            "stage tower runs sequentially on one device"
+        )
+    if num_experts:
+        if flags.model != "transformer":
+            raise ValueError(
+                "--num_experts applies to --model transformer only (the "
+                "conv/MLP families have no MoE formulation)"
+            )
+        extra["num_experts"] = num_experts
+        if expert_par and expert_par > 1:
+            from jax.sharding import Mesh
+
+            devices = jax.devices()
+            if len(devices) < expert_par:
+                raise ValueError(
+                    f"--expert_parallel {expert_par} but only "
+                    f"{len(devices)} devices are visible"
+                )
+            if num_experts % expert_par != 0:
+                raise ValueError(
+                    f"--num_experts {num_experts} not divisible by "
+                    f"--expert_parallel {expert_par}"
+                )
+            extra["moe_mesh"] = Mesh(
+                np.asarray(devices[:expert_par]), ("expert",)
+            )
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
         dtype=dtype, **extra,

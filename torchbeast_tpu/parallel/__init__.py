@@ -10,6 +10,15 @@ from torchbeast_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     state_sharding,
 )
+from torchbeast_tpu.parallel.ep import (  # noqa: F401
+    expert_param_shardings,
+    place_expert_params,
+)
+from torchbeast_tpu.parallel.pp import (  # noqa: F401
+    pipeline_apply,
+    stack_stages,
+    stage_param_shardings,
+)
 from torchbeast_tpu.parallel.tp import (  # noqa: F401
     dense_kernel_shardings,
     place_params,

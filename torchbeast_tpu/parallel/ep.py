@@ -1,0 +1,55 @@
+"""Expert-parallel shardings over an `expert` mesh axis.
+
+Companion to models/moe.py: the MoE layer keeps every expert-stacked
+tensor (`w_in [E, d, ff]`, dispatched activations `[E, C, d]`) leading-axis
+`E`; sharding that axis over `expert` places one slice of the experts per
+chip and XLA lowers the dispatch/combine einsums into all-to-alls over ICI
+— the canonical GShard layout, with zero hand-written collectives.
+
+This module derives the param-pytree shardings (by the `[E, ...]` leading-
+dim convention) so drivers and the multichip dryrun can place params
+without knowing the model's internals.
+"""
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def expert_param_shardings(
+    mesh: Mesh, params: Any, axis: str = "expert"
+) -> Any:
+    """params-pytree of NamedShardings: the MoE expert kernels — leaves
+    NAMED `w_in`/`w_out` (models/moe.py's convention) with a leading dim
+    equal to the `expert` axis size — shard that dim; everything else
+    replicated.
+
+    Shape heuristics alone are deliberately not trusted: a `[d, E]`
+    router kernel, an `[E, ff]` expert bias, or a `[H, hd, d]` attention
+    out-projection with H == E would all false-positive. The biases stay
+    replicated (tiny — replication is free; the activation sharding
+    constraints in models/moe.py keep the expert compute sharded
+    regardless).
+    """
+    E = mesh.shape[axis]
+    expert_kernel_names = {"w_in", "w_out"}
+
+    def rule(path, leaf):
+        name = path[-1].key if path and hasattr(path[-1], "key") else None
+        if (
+            E > 1
+            and name in expert_kernel_names
+            and hasattr(leaf, "ndim")
+            and leaf.ndim >= 3
+            and leaf.shape[0] == E
+        ):
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def place_expert_params(mesh: Mesh, params: Any, axis: str = "expert"):
+    shardings = expert_param_shardings(mesh, params, axis)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)

@@ -1,0 +1,169 @@
+"""Pipeline parallelism over a `pipe` mesh axis.
+
+The reference has no pipeline parallelism (its nets are 3-block convs,
+SURVEY.md §2.3) and the IMPALA trunks here don't need it either — but a
+framework that scales deep uniform towers (transformer stacks) across
+chips needs the schedule, so it is built first-class and validated in the
+full-training-step multichip dryrun.
+
+Design (TPU-idiomatic, compare Praxis/scaling-book pipelining rather than
+torch RPC): every device holds ONE stage's parameters (a pytree whose
+leaves carry a leading stage axis sharded over `pipe`); the batch is cut
+into microbatches; a `lax.scan` runs the GPipe schedule — at tick t, stage
+s processes microbatch t-s and hands its activations to stage s+1 via
+`lax.ppermute` over ICI. Fill/drain bubbles compute on zeros and their
+outputs are masked out, so autodiff through the scan yields exactly the
+sequential gradients. The whole schedule lives inside one `shard_map`, so
+XLA sees static shapes and a fixed collective ring.
+
+Constraints (asserted): stage output shape == stage input shape (uniform
+tower), batch divisible by the microbatch count, and a 1-D stage axis.
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax>=0.4.35 exposes shard_map at the top level
+    from jax import shard_map  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def stack_stages(per_stage_trees):
+    """Stack a list of per-stage pytrees along a new leading stage axis
+    (the layout pipeline_apply expects for `stage_params`)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_trees
+    )
+
+
+def stage_param_shardings(mesh: Mesh, stage_params: Any, axis: str = "pipe"):
+    """params-pytree of NamedShardings: leading stage axis over `axis`."""
+    return jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(axis)), stage_params
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "pipe",
+    n_microbatches: Optional[int] = None,
+    stage_carry: Any = None,
+    shared: Any = None,
+):
+    """Run a uniform tower of S stages as a pipeline over `axis`.
+
+    Args:
+      stage_fn: `(params, x_mb, carry_mb, shared_mb) -> (y_mb, new_carry_mb)`
+        applied per microbatch. `y_mb.shape == x_mb.shape` (activations
+        rotate between stages, so the width is uniform).
+      stage_params: pytree, every leaf `[S, ...]` — stage s's params at
+        index s. Shard with `stage_param_shardings` (or leave unplaced;
+        shard_map partitions logically either way).
+      x: `[B, ...]` activations entering stage 0.
+      n_microbatches: M; default S. `B % M == 0`.
+      stage_carry: optional pytree, leaves `[S, B, ...]` — per-stage,
+        per-example state (e.g. a KV cache per layer). Stays resident on
+        its stage; never rotates.
+      shared: optional pytree, leaves `[B, ...]` — inputs every stage
+        reads for the microbatch it is processing (masks, segment ids).
+
+    Returns:
+      `(y, new_stage_carry)`: y `[B, ...]` from the last stage (replicated
+      over `axis`), new_stage_carry with the same `[S, B, ...]` layout.
+    """
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    M = S if n_microbatches is None else n_microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+    mb = B // M
+
+    def to_mb(leaf):  # [B, ...] -> [M, mb, ...]
+        return leaf.reshape((M, mb) + leaf.shape[1:])
+
+    def from_mb(leaf):  # [M, mb, ...] -> [B, ...]
+        return leaf.reshape((M * mb,) + leaf.shape[2:])
+
+    xs = to_mb(x)
+    shared_mb = jax.tree_util.tree_map(to_mb, shared)
+    # stage_carry [S, B, ...] -> [S, M, mb, ...]
+    carry_mb = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((S, M, mb) + leaf.shape[2:]), stage_carry
+    )
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    cspec = jax.tree_util.tree_map(lambda _: P(axis), carry_mb)
+    rspec = jax.tree_util.tree_map(lambda _: P(), (xs, shared_mb))
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(pspec, cspec, rspec[0], rspec[1]),
+        out_specs=(P(), cspec),
+        check_vma=False,
+    )
+    def run(params, carry, xs, shared_mb):
+        # Local leaves keep a leading stage axis of size 1 — drop it.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        carry = jax.tree_util.tree_map(lambda c: c[0], carry)
+        idx = lax.axis_index(axis)
+
+        state = jnp.zeros_like(xs[0])
+        out_acc = jnp.zeros_like(xs)
+
+        def body(scan_carry, t):
+            state, out_acc, carry = scan_carry
+            # Stage `idx` processes microbatch j = t - idx at tick t.
+            j = t - idx
+            active = (j >= 0) & (j < M)
+            jc = jnp.clip(j, 0, M - 1)
+            inp = jnp.where(idx == 0, xs[jc], state)
+            carry_in = jax.tree_util.tree_map(lambda c: c[jc], carry)
+            shared_in = jax.tree_util.tree_map(
+                lambda s: s[jc], shared_mb
+            )
+            out, carry_out = stage_fn(params, inp, carry_in, shared_in)
+            # Persist this stage's new per-microbatch state (bubble ticks
+            # write nothing — `where` keeps the old row).
+            carry = jax.tree_util.tree_map(
+                lambda c, new: c.at[jc].set(
+                    jnp.where(
+                        active.reshape((1,) * new.ndim), new, c[jc]
+                    )
+                ),
+                carry,
+                carry_out,
+            )
+            # The last stage's active outputs are the pipeline's outputs.
+            take = active & (idx == S - 1)
+            out_acc = out_acc.at[jc].set(
+                jnp.where(take.reshape((1,) * out.ndim), out, out_acc[jc])
+            )
+            # Rotate activations one stage forward over the ICI ring.
+            state = lax.ppermute(out, axis, ring)
+            return (state, out_acc, carry), None
+
+        (state, out_acc, carry), _ = lax.scan(
+            body, (state, out_acc, carry), jnp.arange(S + M - 1)
+        )
+        # out_acc is non-zero only on the last stage; psum replicates it.
+        y = lax.psum(out_acc, axis)
+        carry = jax.tree_util.tree_map(lambda c: c[None], carry)
+        return y, carry
+
+    y, new_carry = run(stage_params, carry_mb, xs, shared_mb)
+    new_carry = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((S, M * mb) + leaf.shape[3:]), new_carry
+    )
+    return from_mb(y), new_carry

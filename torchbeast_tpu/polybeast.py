@@ -75,7 +75,7 @@ def make_parser():
     parser.add_argument("--batch_size", type=int, default=8)
     parser.add_argument("--unroll_length", type=int, default=80)
     parser.add_argument("--model", default="deep",
-                        choices=["shallow", "deep", "mlp", "transformer"])
+                        choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer"])
     parser.add_argument("--use_lstm", action="store_true")
     parser.add_argument("--model_dtype", default="float32",
                         choices=["float32", "bfloat16"],
@@ -96,6 +96,18 @@ def make_parser():
                              "over N devices (ring attention over a `seq` "
                              "mesh; model=transformer only, unroll_length+1 "
                              "divisible by N; acting falls back to dense).")
+    parser.add_argument("--pipeline_parallel", type=int, default=0,
+                        help="Run the pipelined_mlp tower as a GPipe "
+                             "pipeline over N devices (a `pipe` mesh "
+                             "axis). Sets num_stages=N.")
+    parser.add_argument("--num_experts", type=int, default=0,
+                        help="Replace the transformer's FFN with a top-2 "
+                             "mixture of N experts (model=transformer "
+                             "only; adds a sown load-balance loss).")
+    parser.add_argument("--expert_parallel", type=int, default=0,
+                        help="Shard the MoE experts over N devices "
+                             "(an `expert` mesh axis; dispatch/combine "
+                             "become XLA all-to-alls).")
     parser.add_argument("--num_learner_devices", type=int, default=1,
                         help="Data-parallel learner over this many chips "
                              "(params replicated, batch sharded over the "
@@ -166,11 +178,16 @@ def train(flags):
                 f"--batch_size {flags.batch_size} (global) must be "
                 f"divisible by the {proc_count} processes"
             )
-    if flags.sequence_parallel > 1 and flags.num_learner_devices > 1:
+    if flags.num_learner_devices > 1 and (
+        flags.sequence_parallel > 1
+        or getattr(flags, "expert_parallel", 0) > 1
+        or getattr(flags, "pipeline_parallel", 0) > 1
+    ):
         raise ValueError(
-            "--sequence_parallel and --num_learner_devices are mutually "
-            "exclusive: the update step runs over ONE mesh, and the "
-            "model's seq mesh would conflict with the data-parallel mesh"
+            "--sequence_parallel/--expert_parallel/--pipeline_parallel "
+            "and --num_learner_devices are mutually exclusive: the "
+            "update step runs over ONE mesh, and the model's mesh would "
+            "conflict with the data-parallel mesh"
         )
     local_rows = flags.batch_size // proc_count
     if flags.xpid is None:

@@ -1,0 +1,27 @@
+"""Per-host-keyed XLA compile-cache location.
+
+XLA:CPU AOT cache entries encode the compiling machine's ISA features; a
+cache directory shared across heterogeneous hosts (container images move)
+makes XLA load foreign AOT results and risk SIGILL. Key the directory by
+the host's CPU flags so each machine population gets its own cache while
+repeat runs on the same host still skip recompiles.
+"""
+
+import hashlib
+import os
+import platform as platform_mod
+
+
+def host_keyed_cache_dir(prefix: str = "torchbeast_tpu_xla") -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            fingerprint = next(
+                (line for line in f if line.startswith("flags")), ""
+            )
+    except OSError:
+        fingerprint = ""
+    # ISA flags only — hostname would bust the cache on pod churn without
+    # adding any SIGILL protection.
+    fingerprint += platform_mod.machine()
+    key = hashlib.sha1(fingerprint.encode()).hexdigest()[:10]
+    return os.path.expanduser(f"~/.cache/{prefix}_{key}")
