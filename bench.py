@@ -62,6 +62,22 @@ PEAK_BF16_TFLOPS = {
     "v6e": 918.0,
 }
 
+# Peak HBM bandwidth GB/s per chip (public figures). The IMPALA trunk's
+# 16/32-channel convs are ~28 FLOP/byte — far below the ~240 FLOP/byte a
+# v5e needs to saturate the MXU from HBM — so the step is bandwidth-bound
+# and HBM roofline utilization, not MFU, is the number that says whether
+# the program is near the hardware ceiling.
+PEAK_HBM_GBPS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4": 1228.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+}
+
 
 def _probe_backend(timeout_s: int):
     """Ask a watchdog subprocess what the ambient backend is.
@@ -115,16 +131,20 @@ def _cache_dir() -> str:
     return host_keyed_cache_dir()
 
 
-def _cost_analysis_flops(jitted, *args):
-    """Model FLOPs per call from XLA's own cost analysis (best-effort)."""
+def _cost_analysis(jitted, *args):
+    """(flops, bytes_accessed) per call from XLA's own cost analysis of
+    the optimized HLO (best-effort; bytes are a post-fusion proxy for
+    HBM traffic)."""
     try:
         analysis = jitted.lower(*args).compile().cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
         flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
+        nbytes = float(analysis.get("bytes accessed", 0.0))
+        return (flops if flops > 0 else None,
+                nbytes if nbytes > 0 else None)
     except Exception:
-        return None
+        return None, None
 
 
 def run_bench():
@@ -167,7 +187,7 @@ def run_bench():
         batch_d = jax.device_put(batch)
         state_d = jax.device_put(state)
 
-        flops = _cost_analysis_flops(
+        flops, hbm_bytes = _cost_analysis(
             update_step, params, opt_state, batch_d, state_d
         )
 
@@ -186,12 +206,13 @@ def run_bench():
                 float(stats["total_loss"])
         float(stats["total_loss"])
         elapsed = time.perf_counter() - t0
-        return T * B * steps / elapsed, 1000 * elapsed / steps, flops
+        return (T * B * steps / elapsed, 1000 * elapsed / steps, flops,
+                hbm_bytes)
 
     def measure_plausible(dtype):
         """measure(), re-run with per-step sync if the implied TFLOP/s
         exceeds this chip's physical peak (i.e. the async timing lied)."""
-        fps, ms, flops = measure(dtype)
+        fps, ms, flops, hbm_bytes = measure(dtype)
         kind = device.device_kind.lower()
         peak = next(
             (p for name, p in PEAK_BF16_TFLOPS.items() if name in kind),
@@ -204,16 +225,17 @@ def run_bench():
                 f"bench: implausible {ms:.2f} ms/step (> {peak} TFLOP/s); "
                 "re-measuring with per-step host sync\n"
             )
-            fps, ms, flops = measure(dtype, sync_each=True)
-        return fps, ms, flops
+            fps, ms, flops, hbm_bytes = measure(dtype, sync_each=True)
+        return fps, ms, flops, hbm_bytes
 
-    frames_per_sec, step_ms, flops = measure_plausible(jnp.float32)
+    frames_per_sec, step_ms, flops, hbm_bytes = measure_plausible(
+        jnp.float32
+    )
     # bf16 trunk variant: only worth the extra compile on an accelerator.
-    bf16_frames_per_sec = bf16_step_ms = bf16_flops = None
+    bf16_frames_per_sec = bf16_step_ms = bf16_flops = bf16_hbm_bytes = None
     if on_accel:
-        bf16_frames_per_sec, bf16_step_ms, bf16_flops = measure_plausible(
-            jnp.bfloat16
-        )
+        (bf16_frames_per_sec, bf16_step_ms, bf16_flops,
+         bf16_hbm_bytes) = measure_plausible(jnp.bfloat16)
 
     # Per-dtype achieved TFLOP/s; MFU only for the bf16 run against the
     # chip's bf16 peak (comparing an f32 run to a bf16 peak would
@@ -229,6 +251,23 @@ def run_bench():
         for name, peak in PEAK_BF16_TFLOPS.items():
             if name in kind:
                 mfu = bf16_tflops / peak
+                break
+
+    # HBM roofline: the trunk's arithmetic intensity (~28 FLOP/byte) is
+    # far under the chip's balance point, so bandwidth utilization is the
+    # meaningful ceiling metric for this model — MFU cannot approach 1
+    # no matter how good the program is.
+    def hbm_gbps(ms, nbytes):
+        return nbytes / (ms / 1000) / 1e9 if ms and nbytes else None
+
+    f32_hbm_gbps = hbm_gbps(step_ms, hbm_bytes)
+    bf16_hbm_gbps = hbm_gbps(bf16_step_ms, bf16_hbm_bytes)
+    hbm_util = None
+    if bf16_hbm_gbps:
+        kind = device.device_kind.lower()
+        for name, peak in PEAK_HBM_GBPS.items():
+            if name in kind:
+                hbm_util = bf16_hbm_gbps / peak
                 break
 
     # Inference throughput at the largest bucket (the actor-side hot path).
@@ -327,6 +366,11 @@ def run_bench():
             round(bf16_tflops, 2) if bf16_tflops else None
         ),
         "mfu": round(mfu, 4) if mfu else None,
+        "f32_hbm_gbps": round(f32_hbm_gbps, 1) if f32_hbm_gbps else None,
+        "bf16_hbm_gbps": (
+            round(bf16_hbm_gbps, 1) if bf16_hbm_gbps else None
+        ),
+        "hbm_roofline_util": round(hbm_util, 4) if hbm_util else None,
         "inference_steps_per_sec": round(inference_sps, 1),
         "anakin_sps": round(anakin_sps, 1) if anakin_sps else None,
     }

@@ -88,12 +88,13 @@ def test_pipelined_model_update_step_matches_sequential():
     shardings = stage_param_shardings(
         mesh, params["params"], axis="pipe"
     )
+    from torchbeast_tpu.models import PipelinedMLPNet
+
     placed = {
         "params": {
             k: (
                 jax.device_put(v, shardings[k])
-                if k in ("ln_scale", "ln_bias", "w_in", "b_in", "w_out",
-                         "b_out")
+                if k in PipelinedMLPNet.STAGE_PARAM_NAMES
                 else v
             )
             for k, v in params["params"].items()

@@ -43,6 +43,13 @@ class PipelinedMLPNet(nn.Module):
     """Standard model interface (inputs dict -> (AgentOutput, state)) with
     a pipeline-parallel torso of `num_stages` residual blocks."""
 
+    # The stage-stacked param names ([S, ...] leaves that shard over the
+    # `pipe` axis) — the single source of truth for placement code
+    # (__graft_entry__ dryrun, tests) deciding what to pipe-shard.
+    STAGE_PARAM_NAMES = (
+        "ln_scale", "ln_bias", "w_in", "b_in", "w_out", "b_out",
+    )
+
     num_actions: int
     use_lstm: bool = False
     num_stages: int = 4

@@ -43,9 +43,10 @@ import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead
 from torchbeast_tpu.ops.attention import (
-    BIG_NEG,
+    dense_transformer_attend,
     ring_transformer_attention,
     segment_ids_from_done,
+    ulysses_transformer_attention,
 )
 
 
@@ -58,6 +59,7 @@ class _Block(nn.Module):
     seq_axis: str = "seq"
     ring_schedule: str = "contiguous"  # or "zigzag" (balanced causal work)
     attention_impl: str = "dense"  # or "pallas": fused single-chip kernel
+    sp_strategy: str = "ring"  # or "ulysses": all-to-all head sharding
     num_experts: int = 0  # >0 -> MoE FFN (models/moe.py)
     moe_top_k: int = 2
     moe_mesh: Any = None  # mesh with an `expert` axis -> expert parallel
@@ -92,9 +94,35 @@ class _Block(nn.Module):
         blocks = (
             self.mesh.shape[self.seq_axis] if self.mesh is not None else 0
         )
-        divisor = 2 * blocks if self.ring_schedule == "zigzag" else blocks
-        use_ring = self.mesh is not None and T % divisor == 0
-        if use_ring:
+        if self.sp_strategy == "ulysses":
+            # Heads are the sharded resource after the all-to-all; the
+            # acting path (T=1) falls back to dense like the ring does.
+            use_ulysses = (
+                self.mesh is not None
+                and T % blocks == 0
+                and H % blocks == 0
+            )
+            use_ring = False
+        elif self.sp_strategy == "ring":
+            divisor = (
+                2 * blocks if self.ring_schedule == "zigzag" else blocks
+            )
+            use_ulysses = False
+            use_ring = self.mesh is not None and T % divisor == 0
+        else:
+            raise ValueError(
+                f"Unknown sp_strategy {self.sp_strategy!r} "
+                "(expected 'ring' or 'ulysses')"
+            )
+        if use_ulysses:
+            attended = ulysses_transformer_attention(
+                q, k, v,
+                cache[0].astype(k.dtype),
+                cache[1].astype(v.dtype),
+                mask, offsets, rel_bias,
+                self.mesh, self.seq_axis,
+            ).astype(v.dtype)
+        elif use_ring:
             # Softmax runs in f32 on both paths; ring also keeps the
             # einsums f32 (scores never materialize globally, so the
             # bf16-MXU win matters less than exact online-merge numerics).
@@ -132,14 +160,11 @@ class _Block(nn.Module):
         else:
             k_all = jnp.concatenate([cache[0].astype(k.dtype), k], axis=1)
             v_all = jnp.concatenate([cache[1].astype(v.dtype), v], axis=1)
-
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k_all
-            ).astype(jnp.float32) * hd ** -0.5
-            scores = scores + rel_bias[:, offsets][None]
-            scores = jnp.where(mask[:, None], scores, BIG_NEG)
-            weights = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
-            attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
+            # Shared body with the Ulysses path (ops/attention.py) so the
+            # dense==ulysses parity invariant cannot drift.
+            attended = dense_transformer_attend(
+                q, k_all, v_all, mask, offsets, rel_bias
+            )
         x = x + nn.DenseGeneral(
             self.d_model, axis=(-2, -1), name="out", dtype=self.dtype
         )(attended).astype(jnp.float32)
@@ -180,6 +205,7 @@ class TransformerNet(nn.Module):
     seq_axis: str = "seq"
     ring_schedule: str = "contiguous"  # "contiguous" | "zigzag"
     attention_impl: str = "dense"  # "dense" | "pallas" (fused kernel)
+    sp_strategy: str = "ring"  # "ring" | "ulysses" (all-to-all heads)
     num_experts: int = 0  # >0 -> MoE FFN in every block
     moe_top_k: int = 2
     moe_mesh: Optional[Any] = None  # mesh with `expert` axis -> EP
@@ -246,6 +272,7 @@ class TransformerNet(nn.Module):
                 mesh=self.mesh, seq_axis=self.seq_axis,
                 ring_schedule=self.ring_schedule,
                 attention_impl=self.attention_impl,
+                sp_strategy=self.sp_strategy,
                 num_experts=self.num_experts,
                 moe_top_k=self.moe_top_k,
                 moe_mesh=self.moe_mesh,

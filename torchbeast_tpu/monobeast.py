@@ -104,6 +104,13 @@ def make_parser():
                              "`expert` mesh axis; dispatch/combine become "
                              "XLA all-to-alls). Needs --num_experts "
                              "divisible by N.")
+    parser.add_argument("--sp_strategy", default="ring",
+                        choices=["ring", "ulysses"],
+                        help="Sequence-parallel strategy: ring rotates "
+                             "K/V blocks via ppermute (best for huge T); "
+                             "ulysses re-shards to full-sequence x "
+                             "heads/N via two all-to-alls (needs "
+                             "num_heads divisible by N).")
     parser.add_argument("--ring_schedule", default="contiguous",
                         choices=["contiguous", "zigzag"],
                         help="Ring attention block schedule: zigzag "
@@ -174,6 +181,20 @@ def _probe_env(flags):
     return int(n), frame.shape, frame.dtype
 
 
+def _make_1d_mesh(n: int, axis: str, flag_name: str):
+    """A 1-D device mesh over the first n devices, with the consistent
+    too-few-devices error every parallelism flag shares."""
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"--{flag_name} {n} but only {len(devices)} devices are "
+            "visible"
+        )
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
 def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                            frame_dtype=np.uint8):
     import jax.numpy as jnp
@@ -200,6 +221,14 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             "--ring_schedule only takes effect with --sequence_parallel "
             "> 1 (no ring attention runs without a seq mesh)"
         )
+    if (
+        getattr(flags, "sp_strategy", "ring") != "ring"
+        and not (seq_par and seq_par > 1)
+    ):
+        raise ValueError(
+            "--sp_strategy only takes effect with --sequence_parallel "
+            "> 1 (no sequence-parallel attention runs without a seq mesh)"
+        )
     if seq_par and seq_par > 1:
         if flags.model != "transformer":
             raise ValueError(
@@ -215,16 +244,26 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 "mutually exclusive (the ring path replaces the fused "
                 "kernel on the learner forward)"
             )
-        from jax.sharding import Mesh
-
-        devices = jax.devices()
-        if len(devices) < seq_par:
-            raise ValueError(
-                f"--sequence_parallel {seq_par} but only "
-                f"{len(devices)} devices are visible"
-            )
         ring_schedule = getattr(flags, "ring_schedule", "contiguous")
-        divisor = 2 * seq_par if ring_schedule == "zigzag" else seq_par
+        sp_strategy = getattr(flags, "sp_strategy", "ring")
+        if sp_strategy == "ulysses":
+            from torchbeast_tpu.models import TransformerNet
+
+            if ring_schedule != "contiguous":
+                raise ValueError(
+                    "--ring_schedule applies to --sp_strategy ring only"
+                )
+            num_heads = TransformerNet.num_heads  # driver uses defaults
+            if num_heads % seq_par != 0:
+                # The model would silently fall back to dense attention.
+                raise ValueError(
+                    f"--sp_strategy ulysses requires num_heads "
+                    f"({num_heads}) divisible by --sequence_parallel "
+                    f"{seq_par} (heads are the sharded resource)"
+                )
+            divisor = seq_par
+        else:
+            divisor = 2 * seq_par if ring_schedule == "zigzag" else seq_par
         if (flags.unroll_length + 1) % divisor != 0:
             # The learner forward sees T = unroll_length + 1 steps; if the
             # mesh doesn't divide it, the model would silently fall back
@@ -234,10 +273,9 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 f"({ring_schedule}) requires unroll_length+1 divisible "
                 f"by {divisor} (got {flags.unroll_length + 1})"
             )
-        extra["mesh"] = Mesh(
-            np.asarray(devices[:seq_par]), ("seq",)
-        )
+        extra["mesh"] = _make_1d_mesh(seq_par, "seq", "sequence_parallel")
         extra["ring_schedule"] = ring_schedule
+        extra["sp_strategy"] = sp_strategy
     num_experts = getattr(flags, "num_experts", 0)
     expert_par = getattr(flags, "expert_parallel", 0)
     pipe_par = getattr(flags, "pipeline_parallel", 0)
@@ -263,15 +301,7 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 "--pipeline_parallel needs --model pipelined_mlp (the "
                 "other families have no stage-uniform tower to pipeline)"
             )
-        from jax.sharding import Mesh
-
-        devices = jax.devices()
-        if len(devices) < pipe_par:
-            raise ValueError(
-                f"--pipeline_parallel {pipe_par} but only "
-                f"{len(devices)} devices are visible"
-            )
-        extra["mesh"] = Mesh(np.asarray(devices[:pipe_par]), ("pipe",))
+        extra["mesh"] = _make_1d_mesh(pipe_par, "pipe", "pipeline_parallel")
         extra["num_stages"] = pipe_par
     elif flags.model == "pipelined_mlp":
         logging.getLogger(__name__).info(
@@ -286,21 +316,13 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             )
         extra["num_experts"] = num_experts
         if expert_par and expert_par > 1:
-            from jax.sharding import Mesh
-
-            devices = jax.devices()
-            if len(devices) < expert_par:
-                raise ValueError(
-                    f"--expert_parallel {expert_par} but only "
-                    f"{len(devices)} devices are visible"
-                )
             if num_experts % expert_par != 0:
                 raise ValueError(
                     f"--num_experts {num_experts} not divisible by "
                     f"--expert_parallel {expert_par}"
                 )
-            extra["moe_mesh"] = Mesh(
-                np.asarray(devices[:expert_par]), ("expert",)
+            extra["moe_mesh"] = _make_1d_mesh(
+                expert_par, "expert", "expert_parallel"
             )
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
@@ -450,11 +472,17 @@ def train(flags):
                 last_log_time, last_log_step = now, step
                 means = timings.means()
                 log.info(
-                    "Steps %d @ %.1f SPS. Loss %.4f. "
+                    "Steps %d @ %.1f SPS. Loss %s. "
                     "[collect %.0fms learn %.0fms] %s",
                     step,
                     sps,
-                    stats.get("total_loss", float("nan")),
+                    # First log can precede the first (delayed) stats
+                    # fetch — print a placeholder, not a scary nan.
+                    (
+                        f"{stats['total_loss']:.4f}"
+                        if "total_loss" in stats
+                        else "--"
+                    ),
                     1000 * means.get("collect", 0.0),
                     1000 * means.get("learn", 0.0),
                     f"Return {stats['mean_episode_return']:.1f}."

@@ -541,3 +541,154 @@ def _zigzag_transformer_ring(q, k, v, cache_k, cache_v, cache_mask,
     )
     out_z = fn(qz, kz, vz, segz, cmz, cache_k, cache_v, rel_bias)
     return constrain(jnp.take(out_z, inv_perm, axis=1), seq_sh)
+
+
+def dense_transformer_attend(q, k_all, v_all, mask, offsets, rel_bias):
+    """The transformer policy's dense attention body — ONE implementation
+    shared by the model's dense branch (models/transformer.py _Block) and
+    the Ulysses path below (which is exactly this on a head slice), so
+    the two can never drift apart numerically.
+
+    q: [B, T, H, D]; k_all/v_all: [B, M+T, H, D] (cache prepended);
+    mask: [B, T, M+T] bool; offsets: [T, M+T] int in [0, M];
+    rel_bias: [H, M+1]. Scores and softmax run in f32; the combine runs
+    in v's dtype.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32)
+        * scale
+    )
+    scores = scores + rel_bias[:, offsets][None]
+    scores = jnp.where(mask[:, None], scores, BIG_NEG)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, axis: str = "seq", segment_ids=None
+):
+    """All-to-all (DeepSpeed-Ulysses style) sequence-parallel causal
+    attention — the second canonical long-context strategy next to
+    `ring_attention`, with a different communication shape: instead of
+    rotating K/V blocks P times around the ring, TWO all-to-alls per call
+    re-shard the tensors from sequence-sharded to HEAD-sharded and back.
+    Each device then holds the FULL sequence for H/P heads and runs plain
+    dense attention locally — exact numerics, no online-softmax merging.
+
+    Trade-off vs ring: all-to-all moves the same O(T·H·D/P) bytes but in
+    one collective (latency-bound on small T, bandwidth-friendly on large
+    T), and peak memory holds the full [T, T] score matrix for H/P heads
+    — so ring wins when T is huge, Ulysses when H is plentiful and T
+    moderate. Requires H divisible by the axis size (heads are the
+    sharded resource); T divisible by it as well (the input layout).
+
+    q, k, v: [B, T, H, D] global, sharded along T. segment_ids: [B, T].
+    Returns [B, T, H, D], sharded along T.
+    """
+    from jax import shard_map
+
+    num_blocks = mesh.shape[axis]
+    B, T, H, D = q.shape
+    if T % num_blocks != 0:
+        raise ValueError(
+            f"ulysses needs T ({T}) divisible by the axis size "
+            f"({num_blocks})"
+        )
+    if H % num_blocks != 0:
+        raise ValueError(
+            f"ulysses needs H ({H}) divisible by the axis size "
+            f"({num_blocks}) — heads are the sharded resource"
+        )
+
+    def local_fn(q_blk, k_blk, v_blk, seg):
+        # [B, T/P, H, D] -> [B, T, H/P, D]: split heads, gather sequence.
+        a2a = functools.partial(
+            jax.lax.all_to_all, axis_name=axis, split_axis=2,
+            concat_axis=1, tiled=True,
+        )
+        qh, kh, vh = a2a(q_blk), a2a(k_blk), a2a(v_blk)
+        out = causal_attention(qh, kh, vh, seg)
+        # [B, T, H/P, D] -> [B, T/P, H, D]: split sequence, gather heads.
+        return jax.lax.all_to_all(
+            out, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    seq = P(None, axis, None, None)
+    if segment_ids is None:
+        fn = shard_map(
+            lambda q_, k_, v_: local_fn(q_, k_, v_, None),
+            mesh=mesh,
+            in_specs=(seq, seq, seq),
+            out_specs=seq,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, P(None, None)),
+        out_specs=seq,
+    )
+    return fn(q, k, v, segment_ids)
+
+
+def ulysses_transformer_attention(
+    q, k, v, cache_k, cache_v, mask, offsets, rel_bias,
+    mesh: Mesh, axis: str = "seq",
+):
+    """Ulysses-style sequence parallelism for the transformer policy's
+    in-unroll attention: all-to-all to head sharding, then EXACTLY the
+    dense path's computation (band mask, segment mask, relative bias,
+    KV-cache leg) on the full sequence for H/P local heads, then
+    all-to-all back. Numerics match the dense branch by construction —
+    it IS the dense branch on a head slice.
+
+    q, k, v:   [B, T, H, D] global, sharded along T.
+    cache_k/v: [B, M, H, D] replicated (every head set needs its slice).
+    mask:      [B, T, M+T] bool — the dense path's combined cache+unroll
+               mask, replicated.
+    offsets:   [T, M+T] int relative distances (dense path's table).
+    rel_bias:  [H, M+1] learned bias.
+    Returns [B, T, H, D], sharded along T.
+    """
+    from jax import shard_map
+
+    num_blocks = mesh.shape[axis]
+    B, T, H, D = q.shape
+    if H % num_blocks != 0:
+        raise ValueError(
+            f"ulysses needs H ({H}) divisible by the axis size "
+            f"({num_blocks})"
+        )
+    hs = H // num_blocks
+
+    def local_fn(q_blk, k_blk, v_blk, c_k, c_v, mask_f, off, bias_tbl):
+        i = jax.lax.axis_index(axis)
+        a2a = functools.partial(
+            jax.lax.all_to_all, axis_name=axis, split_axis=2,
+            concat_axis=1, tiled=True,
+        )
+        qh, kh, vh = a2a(q_blk), a2a(k_blk), a2a(v_blk)  # [B, T, hs, D]
+        c_k_h = jax.lax.dynamic_slice_in_dim(c_k, i * hs, hs, axis=2)
+        c_v_h = jax.lax.dynamic_slice_in_dim(c_v, i * hs, hs, axis=2)
+        bias_h = jax.lax.dynamic_slice_in_dim(bias_tbl, i * hs, hs, axis=0)
+
+        k_all = jnp.concatenate([c_k_h, kh], axis=1)  # [B, M+T, hs, D]
+        v_all = jnp.concatenate([c_v_h, vh], axis=1)
+        out = dense_transformer_attend(
+            qh, k_all, v_all, mask_f, off, bias_h
+        )
+        return jax.lax.all_to_all(
+            out, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    seq = P(None, axis, None, None)
+    repl4 = P(None, None, None, None)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, repl4, repl4, P(None, None, None),
+                  P(None, None), P(None, None)),
+        out_specs=seq,
+    )
+    return fn(q, k, v, cache_k, cache_v, mask, offsets, rel_bias)

@@ -86,6 +86,18 @@ def pipeline_apply(
     M = S if n_microbatches is None else n_microbatches
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+    for tree, what in ((stage_params, "stage_params"),
+                       (stage_carry, "stage_carry")):
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+            if leaf.shape[0] != S:
+                # shard_map would hand each device leading_dim/S stages
+                # and the local `[0]` would silently drop all but the
+                # first — wrong results, no error. Reject instead.
+                raise ValueError(
+                    f"{what} leaf {jax.tree_util.keystr(path)} has "
+                    f"leading dim {leaf.shape[0]}; the pipeline needs "
+                    f"exactly one stage per device on `{axis}` (= {S})"
+                )
     mb = B // M
 
     def to_mb(leaf):  # [B, ...] -> [M, mb, ...]

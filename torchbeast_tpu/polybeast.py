@@ -96,6 +96,11 @@ def make_parser():
                              "over N devices (ring attention over a `seq` "
                              "mesh; model=transformer only, unroll_length+1 "
                              "divisible by N; acting falls back to dense).")
+    parser.add_argument("--sp_strategy", default="ring",
+                        choices=["ring", "ulysses"],
+                        help="Sequence-parallel strategy: ppermute ring "
+                             "or all-to-all head sharding (ulysses; "
+                             "needs num_heads divisible by N).")
     parser.add_argument("--pipeline_parallel", type=int, default=0,
                         help="Run the pipelined_mlp tower as a GPipe "
                              "pipeline over N devices (a `pipe` mesh "
