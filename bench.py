@@ -131,6 +131,11 @@ def _cache_dir() -> str:
     return host_keyed_cache_dir()
 
 
+def _peak_for(kind: str, table) -> float:
+    """Chip-kind -> peak figure by substring match; None if unknown."""
+    return next((p for name, p in table.items() if name in kind), None)
+
+
 def _cost_analysis(jitted, *args):
     """(flops, bytes_accessed) per call from XLA's own cost analysis of
     the optimized HLO (best-effort; bytes are a post-fusion proxy for
@@ -214,9 +219,9 @@ def run_bench():
         exceeds this chip's physical peak (i.e. the async timing lied)."""
         fps, ms, flops, hbm_bytes = measure(dtype)
         kind = device.device_kind.lower()
-        peak = next(
-            (p for name, p in PEAK_BF16_TFLOPS.items() if name in kind),
-            max(PEAK_BF16_TFLOPS.values()),
+        peak = (
+            _peak_for(kind, PEAK_BF16_TFLOPS)
+            or max(PEAK_BF16_TFLOPS.values())
         )
         if dtype == jnp.float32:
             peak /= 2  # TPU f32 peak is ~half the bf16 figure
@@ -247,11 +252,9 @@ def run_bench():
     bf16_tflops = tflops(bf16_step_ms, bf16_flops)
     mfu = None
     if bf16_tflops:
-        kind = device.device_kind.lower()
-        for name, peak in PEAK_BF16_TFLOPS.items():
-            if name in kind:
-                mfu = bf16_tflops / peak
-                break
+        peak = _peak_for(device.device_kind.lower(), PEAK_BF16_TFLOPS)
+        if peak:
+            mfu = bf16_tflops / peak
 
     # HBM roofline: the trunk's arithmetic intensity (~28 FLOP/byte) is
     # far under the chip's balance point, so bandwidth utilization is the
@@ -264,11 +267,9 @@ def run_bench():
     bf16_hbm_gbps = hbm_gbps(bf16_step_ms, bf16_hbm_bytes)
     hbm_util = None
     if bf16_hbm_gbps:
-        kind = device.device_kind.lower()
-        for name, peak in PEAK_HBM_GBPS.items():
-            if name in kind:
-                hbm_util = bf16_hbm_gbps / peak
-                break
+        peak = _peak_for(device.device_kind.lower(), PEAK_HBM_GBPS)
+        if peak:
+            hbm_util = bf16_hbm_gbps / peak
 
     # Inference throughput at the largest bucket (the actor-side hot path).
     def measure_inference(batch_size=64, n=20):

@@ -196,7 +196,15 @@ def _make_1d_mesh(n: int, axis: str, flag_name: str):
 
 
 def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
-                           frame_dtype=np.uint8):
+                           frame_dtype=np.uint8, moe_mesh=None):
+    """Build the model + initial params from flags.
+
+    moe_mesh: optional externally-built mesh with an `expert` axis — the
+    async driver passes its composite (data x expert) learner mesh here
+    so the MoE layer's sharding constraints reference the SAME mesh the
+    update step is jitted over (two different meshes in one program is an
+    XLA error). When None, --expert_parallel builds a 1-D expert mesh.
+    """
     import jax.numpy as jnp
 
     dtype = (
@@ -321,8 +329,8 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                     f"--num_experts {num_experts} not divisible by "
                     f"--expert_parallel {expert_par}"
                 )
-            extra["moe_mesh"] = _make_1d_mesh(
-                expert_par, "expert", "expert_parallel"
+            extra["moe_mesh"] = moe_mesh if moe_mesh is not None else (
+                _make_1d_mesh(expert_par, "expert", "expert_parallel")
             )
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,

@@ -60,6 +60,7 @@ def initialize_distributed(
 def make_parallel_update_step(
     model, optimizer, hp: learner_lib.HParams, mesh, donate=True,
     param_shardings: Optional[Any] = None,
+    opt_shardings: Optional[Any] = None,
 ):
     """Data/tensor-parallel version of learner.make_update_step.
 
@@ -94,9 +95,15 @@ def make_parallel_update_step(
 
     # A single NamedSharding acts as a pytree prefix: it applies to every
     # leaf of the batch dict (all leaves are [T+1, B, ...]). Optimizer
-    # state shardings are left to the compiler (jax.jit infers them from
-    # the params shardings when params are sharded).
-    opt_sh = repl if param_shardings is None else None
+    # state shardings: explicit when the caller derives them (donation
+    # requires input placement == output placement, so donating drivers
+    # must pin them — optax state mirrors the params leaf-wise, so
+    # expert_param_shardings works on it directly); otherwise left to the
+    # compiler when params are sharded.
+    if opt_shardings is not None:
+        opt_sh = opt_shardings
+    else:
+        opt_sh = repl if param_shardings is None else None
     return jax.jit(
         update_step,
         in_shardings=(psh, opt_sh, bsh, ssh),

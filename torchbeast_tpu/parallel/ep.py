@@ -21,9 +21,9 @@ def expert_param_shardings(
     mesh: Mesh, params: Any, axis: str = "expert"
 ) -> Any:
     """params-pytree of NamedShardings: the MoE expert kernels — leaves
-    NAMED `w_in`/`w_out` (models/moe.py's convention) with a leading dim
-    equal to the `expert` axis size — shard that dim; everything else
-    replicated.
+    NAMED `w_in`/`w_out` (models/moe.py's convention) whose leading dim
+    divides evenly over the `expert` axis — shard that dim; everything
+    else replicated.
 
     Shape heuristics alone are deliberately not trusted: a `[d, E]`
     router kernel, an `[E, ff]` expert bias, or a `[H, hd, d]` attention
@@ -42,7 +42,7 @@ def expert_param_shardings(
             and name in expert_kernel_names
             and hasattr(leaf, "ndim")
             and leaf.ndim >= 3
-            and leaf.shape[0] == E
+            and leaf.shape[0] % E == 0
         ):
             return NamedSharding(mesh, P(axis))
         return NamedSharding(mesh, P())

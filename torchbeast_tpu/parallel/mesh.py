@@ -27,7 +27,17 @@ def create_mesh(
     n_devices: Optional[int] = None,
     model_parallelism: int = 1,
     devices: Optional[Sequence] = None,
+    expert_parallelism: int = 1,
 ) -> Mesh:
+    """(data[, model][, expert]) mesh over the first n devices.
+
+    `n_devices` is the TOTAL device count; the data axis gets
+    n / (model_parallelism * expert_parallelism). The `expert` axis only
+    exists when expert_parallelism > 1 (so non-MoE meshes keep their
+    two-axis shape), letting ONE mesh carry a data-parallel learner with
+    expert-sharded MoE layers — XLA lays the gradient all-reduce on
+    `data` and the MoE dispatch/combine all-to-alls on `expert`.
+    """
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
@@ -38,12 +48,18 @@ def create_mesh(
             )
         devices = devices[:n_devices]
     n = len(devices)
-    if n % model_parallelism != 0:
+    inner = model_parallelism * expert_parallelism
+    if n % inner != 0:
         raise ValueError(
             f"{n} devices not divisible by model_parallelism="
-            f"{model_parallelism}"
+            f"{model_parallelism} x expert_parallelism={expert_parallelism}"
         )
-    grid = np.asarray(devices).reshape(n // model_parallelism, model_parallelism)
+    if expert_parallelism > 1:
+        grid = np.asarray(devices).reshape(
+            n // inner, model_parallelism, expert_parallelism
+        )
+        return Mesh(grid, ("data", "model", "expert"))
+    grid = np.asarray(devices).reshape(n // inner, model_parallelism)
     return Mesh(grid, ("data", "model"))
 
 

@@ -114,10 +114,12 @@ def make_parser():
                              "(an `expert` mesh axis; dispatch/combine "
                              "become XLA all-to-alls).")
     parser.add_argument("--num_learner_devices", type=int, default=1,
-                        help="Data-parallel learner over this many chips "
-                             "(params replicated, batch sharded over the "
-                             "mesh's data axis, ICI all-reduce for grads). "
-                             "batch_size must be divisible by it.")
+                        help="Width of the DATA-parallel axis: params "
+                             "replicated, batch sharded over it, ICI "
+                             "all-reduce for grads; batch_size must be "
+                             "divisible by it. With --expert_parallel K "
+                             "the learner consumes N x K chips total "
+                             "(one (data x expert) mesh).")
     parser.add_argument("--coordinator_address", default=None,
                         help="Multi-host: jax.distributed coordinator "
                              "(host:port); also reads "
@@ -185,14 +187,14 @@ def train(flags):
             )
     if flags.num_learner_devices > 1 and (
         flags.sequence_parallel > 1
-        or getattr(flags, "expert_parallel", 0) > 1
         or getattr(flags, "pipeline_parallel", 0) > 1
     ):
         raise ValueError(
-            "--sequence_parallel/--expert_parallel/--pipeline_parallel "
-            "and --num_learner_devices are mutually exclusive: the "
-            "update step runs over ONE mesh, and the model's mesh would "
-            "conflict with the data-parallel mesh"
+            "--sequence_parallel/--pipeline_parallel and "
+            "--num_learner_devices are mutually exclusive: their "
+            "shard_map meshes would conflict with the data-parallel "
+            "mesh. (--expert_parallel DOES compose with DP — the MoE "
+            "uses sharding constraints on one composite mesh.)"
         )
     local_rows = flags.batch_size // proc_count
     if flags.xpid is None:
@@ -228,8 +230,23 @@ def train(flags):
         flags, addresses[0]
     )
 
+    # Composite (data x expert) mesh: built BEFORE the model so the MoE
+    # layer's sharding constraints and the jitted update step reference
+    # the SAME mesh. The `expert` axis is innermost — its all-to-alls
+    # stay within a data-parallel replica group.
+    expert_par = getattr(flags, "expert_parallel", 0)
+    learner_mesh = None
+    if flags.num_learner_devices > 1:
+        from torchbeast_tpu.parallel import create_mesh
+
+        learner_mesh = create_mesh(
+            flags.num_learner_devices * max(1, expert_par),
+            expert_parallelism=max(1, expert_par),
+        )
+
     model, params = _init_model_and_params(
-        flags, num_actions, flags.batch_size, frame_shape, frame_dtype
+        flags, num_actions, flags.batch_size, frame_shape, frame_dtype,
+        moe_mesh=learner_mesh if expert_par > 1 else None,
     )
     optimizer = learner_lib.make_optimizer(hp)
     opt_state = optimizer.init(params)
@@ -272,10 +289,9 @@ def train(flags):
     # place — donation's HBM savings on the optimizer without invalidating
     # an in-flight act dispatch. Requires update dispatch and checkpoint
     # reads of opt_state to be serialized (donation_lock, below).
-    mesh = None
+    mesh = learner_mesh
     if flags.num_learner_devices > 1:
         from torchbeast_tpu.parallel import (
-            create_mesh,
             make_parallel_update_step,
             replicate,
             shard_batch,
@@ -286,15 +302,40 @@ def train(flags):
                 f"batch_size {flags.batch_size} not divisible by "
                 f"num_learner_devices {flags.num_learner_devices}"
             )
-        mesh = create_mesh(flags.num_learner_devices)
+        param_shardings = opt_shardings = None
+        if expert_par > 1:
+            from torchbeast_tpu.parallel import expert_param_shardings
+
+            param_shardings = expert_param_shardings(mesh, params)
+            # optax state mirrors the params leaf-wise (same key paths at
+            # the leaves), so the name-based expert rule applies to it
+            # unchanged. Explicit placement is REQUIRED here: opt_state
+            # is donated, and donation needs input placement == output
+            # sharding.
+            opt_shardings = expert_param_shardings(mesh, opt_state)
         update_step = make_parallel_update_step(
-            model, optimizer, hp, mesh, donate="opt_only"
+            model, optimizer, hp, mesh, donate="opt_only",
+            param_shardings=param_shardings,
+            opt_shardings=opt_shardings,
         )
-        params = replicate(mesh, params)
-        opt_state = replicate(mesh, opt_state)
+        if param_shardings is None:
+            params = replicate(mesh, params)
+            opt_state = replicate(mesh, opt_state)
+        else:
+            params = jax.tree_util.tree_map(
+                jax.device_put, params, param_shardings
+            )
+            opt_state = jax.tree_util.tree_map(
+                jax.device_put, opt_state, opt_shardings
+            )
         shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
-        log.info("Data-parallel learner over %d devices (%d processes)",
-                 flags.num_learner_devices, proc_count)
+        total_chips = flags.num_learner_devices * max(1, expert_par)
+        log.info(
+            "Parallel learner: data=%d%s (%d chips total, %d processes)",
+            flags.num_learner_devices,
+            f" x expert={expert_par}" if expert_par > 1 else "",
+            total_chips, proc_count,
+        )
     else:
         update_step = learner_lib.make_update_step(
             model, optimizer, hp, donate="opt_only"
