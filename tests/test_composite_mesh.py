@@ -18,22 +18,22 @@ from torchbeast_tpu.parallel import (
 T, B, A = 4, 8, 5
 
 
-def _batch(seed=0):
+def _batch(seed=0, t=T):
     rng = np.random.default_rng(seed)
     return {
-        "frame": rng.integers(0, 256, (T + 1, B, 6, 6, 1), dtype=np.uint8),
-        "reward": rng.standard_normal((T + 1, B)).astype(np.float32),
-        "done": rng.random((T + 1, B)) < 0.15,
-        "episode_return": rng.standard_normal((T + 1, B)).astype(
+        "frame": rng.integers(0, 256, (t + 1, B, 6, 6, 1), dtype=np.uint8),
+        "reward": rng.standard_normal((t + 1, B)).astype(np.float32),
+        "done": rng.random((t + 1, B)) < 0.15,
+        "episode_return": rng.standard_normal((t + 1, B)).astype(
             np.float32
         ),
-        "episode_step": rng.integers(0, 9, (T + 1, B)).astype(np.int32),
-        "last_action": rng.integers(0, A, (T + 1, B)).astype(np.int32),
-        "action": rng.integers(0, A, (T + 1, B)).astype(np.int32),
-        "policy_logits": rng.standard_normal((T + 1, B, A)).astype(
+        "episode_step": rng.integers(0, 9, (t + 1, B)).astype(np.int32),
+        "last_action": rng.integers(0, A, (t + 1, B)).astype(np.int32),
+        "action": rng.integers(0, A, (t + 1, B)).astype(np.int32),
+        "policy_logits": rng.standard_normal((t + 1, B, A)).astype(
             np.float32
         ),
-        "baseline": rng.standard_normal((T + 1, B)).astype(np.float32),
+        "baseline": rng.standard_normal((t + 1, B)).astype(np.float32),
     }
 
 
@@ -105,3 +105,69 @@ def test_dp_x_ep_update_matches_single_device():
         p_comp,
         p_ref,
     )
+
+
+def test_dp_x_sp_update_matches_single_device():
+    """Composite (data x seq) mesh: data-parallel learner with the
+    transformer's in-unroll attention sequence-sharded — both the
+    zig-zag ring and the Ulysses strategy — must match the single-device
+    update numerically."""
+    mesh = create_mesh(8, seq_parallelism=2)
+    assert mesh.shape == {"data": 4, "model": 1, "seq": 2}
+    T_ = 7  # model sees T+1 = 8 steps: zigzag chunks of 2, ulysses 4
+    kwargs = dict(
+        num_actions=A, num_layers=1, d_model=16, num_heads=2,
+        memory_len=4,
+    )
+    single = create_model("transformer", **kwargs)
+
+    batch = _batch(seed=1, t=T_)
+    state = single.initial_state(B)
+    params = single.init(
+        {"params": jax.random.PRNGKey(2), "action": jax.random.PRNGKey(3)},
+        batch,
+        state,
+    )
+    hp = learner_lib.HParams(batch_size=B, unroll_length=T_)
+    optimizer = learner_lib.make_optimizer(hp)
+    step_single = learner_lib.make_update_step(
+        single, optimizer, hp, donate=False
+    )
+    p_ref, _, stats_ref = step_single(
+        params, optimizer.init(params), batch, state
+    )
+
+    for strategy, extra in (
+        ("ring", {"ring_schedule": "zigzag"}),
+        ("ulysses", {}),
+    ):
+        comp = create_model(
+            "transformer", mesh=mesh, sp_strategy=strategy,
+            batch_axis="data", **extra, **kwargs
+        )
+        step_comp = make_parallel_update_step(
+            comp, optimizer, hp, mesh, donate=False
+        )
+        batch_p, state_p = shard_batch(mesh, batch, state)
+        params_p = jax.device_put(
+            params, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            )
+        )
+        p_comp, _, stats_comp = step_comp(
+            params_p, optimizer.init(params_p), batch_p, state_p
+        )
+        np.testing.assert_allclose(
+            float(stats_comp["total_loss"]),
+            float(stats_ref["total_loss"]),
+            rtol=1e-5,
+            err_msg=strategy,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                err_msg=strategy,
+            ),
+            p_comp,
+            p_ref,
+        )

@@ -60,6 +60,7 @@ class _Block(nn.Module):
     ring_schedule: str = "contiguous"  # or "zigzag" (balanced causal work)
     attention_impl: str = "dense"  # or "pallas": fused single-chip kernel
     sp_strategy: str = "ring"  # or "ulysses": all-to-all head sharding
+    batch_axis: Any = None  # composite mesh: batch dim's data axis name
     num_experts: int = 0  # >0 -> MoE FFN (models/moe.py)
     moe_top_k: int = 2
     moe_mesh: Any = None  # mesh with an `expert` axis -> expert parallel
@@ -121,6 +122,7 @@ class _Block(nn.Module):
                 cache[1].astype(v.dtype),
                 mask, offsets, rel_bias,
                 self.mesh, self.seq_axis,
+                batch_axis=self.batch_axis,
             ).astype(v.dtype)
         elif use_ring:
             # Softmax runs in f32 on both paths; ring also keeps the
@@ -139,6 +141,7 @@ class _Block(nn.Module):
                 self.mesh,
                 self.seq_axis,
                 schedule=self.ring_schedule,
+                batch_axis=self.batch_axis,
             ).astype(v.dtype)
         elif self.attention_impl == "pallas":
             from torchbeast_tpu.ops.pallas_attention import (
@@ -206,6 +209,8 @@ class TransformerNet(nn.Module):
     ring_schedule: str = "contiguous"  # "contiguous" | "zigzag"
     attention_impl: str = "dense"  # "dense" | "pallas" (fused kernel)
     sp_strategy: str = "ring"  # "ring" | "ulysses" (all-to-all heads)
+    batch_axis: Optional[str] = None  # composite (data x seq) mesh: the
+    # name of the axis the batch dim shards over (usually "data")
     num_experts: int = 0  # >0 -> MoE FFN in every block
     moe_top_k: int = 2
     moe_mesh: Optional[Any] = None  # mesh with `expert` axis -> EP
@@ -273,6 +278,7 @@ class TransformerNet(nn.Module):
                 ring_schedule=self.ring_schedule,
                 attention_impl=self.attention_impl,
                 sp_strategy=self.sp_strategy,
+                batch_axis=self.batch_axis,
                 num_experts=self.num_experts,
                 moe_top_k=self.moe_top_k,
                 moe_mesh=self.moe_mesh,

@@ -196,14 +196,17 @@ def _make_1d_mesh(n: int, axis: str, flag_name: str):
 
 
 def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
-                           frame_dtype=np.uint8, moe_mesh=None):
+                           frame_dtype=np.uint8, moe_mesh=None,
+                           seq_mesh=None):
     """Build the model + initial params from flags.
 
-    moe_mesh: optional externally-built mesh with an `expert` axis — the
-    async driver passes its composite (data x expert) learner mesh here
-    so the MoE layer's sharding constraints reference the SAME mesh the
-    update step is jitted over (two different meshes in one program is an
-    XLA error). When None, --expert_parallel builds a 1-D expert mesh.
+    moe_mesh / seq_mesh: optional externally-built meshes with an
+    `expert` / `seq` axis — the async driver passes its composite
+    (data x expert|seq) learner mesh here so the model's sharding
+    constraints/shard_maps reference the SAME mesh the update step is
+    jitted over (two different meshes in one program is an XLA error).
+    A composite seq_mesh also sets the model's batch_axis to "data".
+    When None, the flags build 1-D meshes.
     """
     import jax.numpy as jnp
 
@@ -281,7 +284,13 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 f"({ring_schedule}) requires unroll_length+1 divisible "
                 f"by {divisor} (got {flags.unroll_length + 1})"
             )
-        extra["mesh"] = _make_1d_mesh(seq_par, "seq", "sequence_parallel")
+        if seq_mesh is not None:
+            extra["mesh"] = seq_mesh
+            extra["batch_axis"] = "data"
+        else:
+            extra["mesh"] = _make_1d_mesh(
+                seq_par, "seq", "sequence_parallel"
+            )
         extra["ring_schedule"] = ring_schedule
         extra["sp_strategy"] = sp_strategy
     num_experts = getattr(flags, "num_experts", 0)

@@ -386,7 +386,7 @@ def _zigzag_ring_attention(q, k, v, mesh, axis, segment_ids):
 def ring_transformer_attention(
     q, k, v, cache_k, cache_v, cache_mask, rel_bias, memory_len: int,
     segment_ids, mesh: Mesh, axis: str = "seq",
-    schedule: str = "contiguous",
+    schedule: str = "contiguous", batch_axis: Optional[str] = None,
 ):
     """Sequence-parallel version of the transformer policy's in-unroll
     attention (models/transformer.py _Block): band-causal windowing to the
@@ -416,7 +416,7 @@ def ring_transformer_attention(
     if schedule == "zigzag":
         return _zigzag_transformer_ring(
             q, k, v, cache_k, cache_v, cache_mask, rel_bias, M,
-            segment_ids, mesh, axis,
+            segment_ids, mesh, axis, batch_axis,
         )
     if schedule != "contiguous":
         raise ValueError(f"Unknown ring schedule {schedule!r}")
@@ -449,14 +449,18 @@ def ring_transformer_attention(
 
     from jax import shard_map
 
-    seq = P(None, axis, None, None)
-    repl4 = P(None, None, None, None)
+    # batch_axis: on a composite (data x seq) mesh, the batch dim shards
+    # over `data` — each data row runs its own independent seq ring (the
+    # per-device math only indexes the seq axis, so it is unchanged).
+    ba = batch_axis
+    seq = P(ba, axis, None, None)
+    cache4 = P(ba, None, None, None)
     fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(
-            seq, seq, seq, P(None, axis), repl4, repl4,
-            P(None, axis, None), P(None, None),
+            seq, seq, seq, P(ba, axis), cache4, cache4,
+            P(ba, axis, None), P(None, None),
         ),
         out_specs=seq,
     )
@@ -464,7 +468,8 @@ def ring_transformer_attention(
 
 
 def _zigzag_transformer_ring(q, k, v, cache_k, cache_v, cache_mask,
-                             rel_bias, memory_len, segment_ids, mesh, axis):
+                             rel_bias, memory_len, segment_ids, mesh, axis,
+                             batch_axis=None):
     """Zig-zag-scheduled transformer ring attention.
 
     Same chunk-pair layout and structural skipping as
@@ -489,9 +494,10 @@ def _zigzag_transformer_ring(q, k, v, cache_k, cache_v, cache_mask,
     perm = zigzag_permutation(T, num_blocks)
     inv_perm = np.argsort(perm)
 
-    seq_sh = NamedSharding(mesh, P(None, axis, None, None))
-    seg_sh = NamedSharding(mesh, P(None, axis))
-    cm_sh = NamedSharding(mesh, P(None, axis, None))
+    ba = batch_axis
+    seq_sh = NamedSharding(mesh, P(ba, axis, None, None))
+    seg_sh = NamedSharding(mesh, P(ba, axis))
+    cm_sh = NamedSharding(mesh, P(ba, axis, None))
     constrain = jax.lax.with_sharding_constraint
     qz = constrain(jnp.take(q, perm, axis=1), seq_sh)
     kz = constrain(jnp.take(k, perm, axis=1), seq_sh)
@@ -528,14 +534,14 @@ def _zigzag_transformer_ring(q, k, v, cache_k, cache_v, cache_mask,
 
     from jax import shard_map
 
-    seq = P(None, axis, None, None)
-    repl4 = P(None, None, None, None)
+    seq = P(ba, axis, None, None)
+    cache4 = P(ba, None, None, None)
     fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(
-            seq, seq, seq, P(None, axis), P(None, axis, None),
-            repl4, repl4, P(None, None),
+            seq, seq, seq, P(ba, axis), P(ba, axis, None),
+            cache4, cache4, P(None, None),
         ),
         out_specs=seq,
     )
@@ -634,7 +640,7 @@ def ulysses_attention(
 
 def ulysses_transformer_attention(
     q, k, v, cache_k, cache_v, mask, offsets, rel_bias,
-    mesh: Mesh, axis: str = "seq",
+    mesh: Mesh, axis: str = "seq", batch_axis: Optional[str] = None,
 ):
     """Ulysses-style sequence parallelism for the transformer policy's
     in-unroll attention: all-to-all to head sharding, then EXACTLY the
@@ -682,12 +688,13 @@ def ulysses_transformer_attention(
             out, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
         )
 
-    seq = P(None, axis, None, None)
-    repl4 = P(None, None, None, None)
+    ba = batch_axis
+    seq = P(ba, axis, None, None)
+    cache4 = P(ba, None, None, None)
     fn = shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(seq, seq, seq, repl4, repl4, P(None, None, None),
+        in_specs=(seq, seq, seq, cache4, cache4, P(ba, None, None),
                   P(None, None), P(None, None)),
         out_specs=seq,
     )

@@ -28,16 +28,26 @@ def create_mesh(
     model_parallelism: int = 1,
     devices: Optional[Sequence] = None,
     expert_parallelism: int = 1,
+    seq_parallelism: int = 1,
 ) -> Mesh:
-    """(data[, model][, expert]) mesh over the first n devices.
+    """(data[, model][, expert | seq]) mesh over the first n devices.
 
     `n_devices` is the TOTAL device count; the data axis gets
-    n / (model_parallelism * expert_parallelism). The `expert` axis only
-    exists when expert_parallelism > 1 (so non-MoE meshes keep their
-    two-axis shape), letting ONE mesh carry a data-parallel learner with
-    expert-sharded MoE layers — XLA lays the gradient all-reduce on
-    `data` and the MoE dispatch/combine all-to-alls on `expert`.
+    n / (model_parallelism * expert_parallelism * seq_parallelism). The
+    `expert`/`seq` axes only exist when their parallelism is > 1 (so
+    plain meshes keep their two-axis shape), letting ONE mesh carry a
+    data-parallel learner with expert-sharded MoE layers (all-to-alls on
+    `expert`) or sequence-sharded attention (ppermute ring / all-to-alls
+    on `seq`) — gradients all-reduce over `data` either way. The inner
+    axes are innermost so their collectives stay within a data replica
+    group on neighboring chips.
     """
+    if expert_parallelism > 1 and seq_parallelism > 1:
+        raise ValueError(
+            "expert_parallelism and seq_parallelism cannot combine yet "
+            "(the MoE constraints and the attention shard_map would need "
+            "a shared 3-inner-axis layout)"
+        )
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
@@ -48,17 +58,23 @@ def create_mesh(
             )
         devices = devices[:n_devices]
     n = len(devices)
-    inner = model_parallelism * expert_parallelism
+    inner = model_parallelism * expert_parallelism * seq_parallelism
     if n % inner != 0:
         raise ValueError(
             f"{n} devices not divisible by model_parallelism="
-            f"{model_parallelism} x expert_parallelism={expert_parallelism}"
+            f"{model_parallelism} x expert_parallelism="
+            f"{expert_parallelism} x seq_parallelism={seq_parallelism}"
         )
     if expert_parallelism > 1:
         grid = np.asarray(devices).reshape(
             n // inner, model_parallelism, expert_parallelism
         )
         return Mesh(grid, ("data", "model", "expert"))
+    if seq_parallelism > 1:
+        grid = np.asarray(devices).reshape(
+            n // inner, model_parallelism, seq_parallelism
+        )
+        return Mesh(grid, ("data", "model", "seq"))
     grid = np.asarray(devices).reshape(n // inner, model_parallelism)
     return Mesh(grid, ("data", "model"))
 

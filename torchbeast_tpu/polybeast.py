@@ -101,6 +101,11 @@ def make_parser():
                         help="Sequence-parallel strategy: ppermute ring "
                              "or all-to-all head sharding (ulysses; "
                              "needs num_heads divisible by N).")
+    parser.add_argument("--ring_schedule", default="contiguous",
+                        choices=["contiguous", "zigzag"],
+                        help="Ring attention block schedule (zigzag "
+                             "balances causal work; unroll_length+1 "
+                             "divisible by 2N).")
     parser.add_argument("--pipeline_parallel", type=int, default=0,
                         help="Run the pipelined_mlp tower as a GPipe "
                              "pipeline over N devices (a `pipe` mesh "
@@ -186,15 +191,14 @@ def train(flags):
                 f"divisible by the {proc_count} processes"
             )
     if flags.num_learner_devices > 1 and (
-        flags.sequence_parallel > 1
-        or getattr(flags, "pipeline_parallel", 0) > 1
+        getattr(flags, "pipeline_parallel", 0) > 1
     ):
         raise ValueError(
-            "--sequence_parallel/--pipeline_parallel and "
-            "--num_learner_devices are mutually exclusive: their "
-            "shard_map meshes would conflict with the data-parallel "
-            "mesh. (--expert_parallel DOES compose with DP — the MoE "
-            "uses sharding constraints on one composite mesh.)"
+            "--pipeline_parallel and --num_learner_devices are mutually "
+            "exclusive: the GPipe shard_map mesh would conflict with the "
+            "data-parallel mesh. (--expert_parallel and "
+            "--sequence_parallel DO compose with DP on one composite "
+            "mesh.)"
         )
     local_rows = flags.batch_size // proc_count
     if flags.xpid is None:
@@ -230,23 +234,27 @@ def train(flags):
         flags, addresses[0]
     )
 
-    # Composite (data x expert) mesh: built BEFORE the model so the MoE
-    # layer's sharding constraints and the jitted update step reference
-    # the SAME mesh. The `expert` axis is innermost — its all-to-alls
-    # stay within a data-parallel replica group.
+    # Composite (data x expert|seq) mesh: built BEFORE the model so the
+    # MoE sharding constraints / attention shard_maps and the jitted
+    # update step reference the SAME mesh. The inner axis is innermost —
+    # its collectives stay within a data-parallel replica group.
     expert_par = getattr(flags, "expert_parallel", 0)
+    seq_par = flags.sequence_parallel
     learner_mesh = None
     if flags.num_learner_devices > 1:
         from torchbeast_tpu.parallel import create_mesh
 
+        inner = max(1, expert_par) * max(1, seq_par)
         learner_mesh = create_mesh(
-            flags.num_learner_devices * max(1, expert_par),
+            flags.num_learner_devices * inner,
             expert_parallelism=max(1, expert_par),
+            seq_parallelism=max(1, seq_par),
         )
 
     model, params = _init_model_and_params(
         flags, num_actions, flags.batch_size, frame_shape, frame_dtype,
         moe_mesh=learner_mesh if expert_par > 1 else None,
+        seq_mesh=learner_mesh if seq_par > 1 else None,
     )
     optimizer = learner_lib.make_optimizer(hp)
     opt_state = optimizer.init(params)
@@ -329,12 +337,13 @@ def train(flags):
                 jax.device_put, opt_state, opt_shardings
             )
         shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
-        total_chips = flags.num_learner_devices * max(1, expert_par)
+        inner_desc = (
+            f" x expert={expert_par}" if expert_par > 1 else ""
+        ) + (f" x seq={seq_par}" if seq_par > 1 else "")
         log.info(
             "Parallel learner: data=%d%s (%d chips total, %d processes)",
-            flags.num_learner_devices,
-            f" x expert={expert_par}" if expert_par > 1 else "",
-            total_chips, proc_count,
+            flags.num_learner_devices, inner_desc,
+            flags.num_learner_devices * inner, proc_count,
         )
     else:
         update_step = learner_lib.make_update_step(
