@@ -167,3 +167,24 @@ def test_pipelined_model_microbatch_count():
     np.testing.assert_allclose(
         out_pipe.policy_logits, out_seq.policy_logits, rtol=1e-5, atol=1e-5
     )
+
+
+def test_pipelined_model_more_stages_than_devices():
+    """num_stages = 2x the pipe axis: the looped schedule must match the
+    sequential 8-stage tower."""
+    n_dev, n_stages = 4, 8
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("pipe",))
+    kwargs = dict(num_actions=A, num_stages=n_stages, d_model=32)
+    seq = create_model("pipelined_mlp", **kwargs)
+    pipe = create_model("pipelined_mlp", mesh=mesh, **kwargs)
+    batch = _batch(seed=9)
+    params = seq.init(
+        {"params": jax.random.PRNGKey(30), "action": jax.random.PRNGKey(31)},
+        batch,
+        (),
+    )
+    out_seq, _ = seq.apply(params, batch, (), sample_action=False)
+    out_pipe, _ = pipe.apply(params, batch, (), sample_action=False)
+    np.testing.assert_allclose(
+        out_pipe.policy_logits, out_seq.policy_logits, rtol=1e-5, atol=1e-5
+    )

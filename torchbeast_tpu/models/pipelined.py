@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
-from torchbeast_tpu.parallel.pp import pipeline_apply
+from torchbeast_tpu.parallel.pp import pipeline_apply_multi
 
 
 def _layer_norm(x, scale, bias, eps=1e-6):
@@ -64,10 +64,15 @@ class PipelinedMLPNet(nn.Module):
         frame = inputs["frame"]  # [T, B, ...]
         T, B = frame.shape[:2]
         S, d = self.num_stages, self.d_model
-        if self.mesh is not None and self.mesh.shape[self.pipe_axis] != S:
+        if (
+            self.mesh is not None
+            and S % self.mesh.shape[self.pipe_axis] != 0
+        ):
             raise ValueError(
-                f"num_stages={S} must equal the `{self.pipe_axis}` axis "
-                f"size {self.mesh.shape[self.pipe_axis]}"
+                f"num_stages={S} must be a multiple of the "
+                f"`{self.pipe_axis}` axis size "
+                f"{self.mesh.shape[self.pipe_axis]} (k stages per device "
+                "run as k pipeline passes)"
             )
 
         x = frame.reshape((T * B, -1)).astype(jnp.float32) / 255.0
@@ -94,7 +99,7 @@ class PipelinedMLPNet(nn.Module):
         }
 
         if self.mesh is not None:
-            x, _ = pipeline_apply(
+            x, _ = pipeline_apply_multi(
                 _stage_fn,
                 stage_params,
                 x,

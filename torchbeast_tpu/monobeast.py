@@ -93,8 +93,11 @@ def make_parser():
                         help="Run the pipelined_mlp tower as a GPipe "
                              "pipeline over N devices (a `pipe` mesh "
                              "axis; stage params one-per-chip, "
-                             "activations rotate via ppermute). Sets "
-                             "num_stages=N.")
+                             "activations rotate via ppermute).")
+    parser.add_argument("--pipeline_stages", type=int, default=0,
+                        help="Total tower depth for pipelined_mlp "
+                             "(default: one stage per pipeline device; "
+                             "a multiple k*N runs k looped passes).")
     parser.add_argument("--num_experts", type=int, default=0,
                         help="Replace the transformer's FFN with a top-2 "
                              "mixture of N experts (model=transformer "
@@ -319,8 +322,20 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 "other families have no stage-uniform tower to pipeline)"
             )
         extra["mesh"] = _make_1d_mesh(pipe_par, "pipe", "pipeline_parallel")
-        extra["num_stages"] = pipe_par
+        n_stages = getattr(flags, "pipeline_stages", 0) or pipe_par
+        if n_stages % pipe_par != 0:
+            raise ValueError(
+                f"--pipeline_stages {n_stages} must be a multiple of "
+                f"--pipeline_parallel {pipe_par}"
+            )
+        extra["num_stages"] = n_stages
     elif flags.model == "pipelined_mlp":
+        # No mesh, but the requested tower depth still applies — a
+        # silently different num_stages would make checkpoints
+        # shape-incompatible with a later pipelined run.
+        n_stages = getattr(flags, "pipeline_stages", 0)
+        if n_stages:
+            extra["num_stages"] = n_stages
         logging.getLogger(__name__).info(
             "--model pipelined_mlp without --pipeline_parallel: the "
             "stage tower runs sequentially on one device"

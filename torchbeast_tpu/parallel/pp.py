@@ -179,3 +179,69 @@ def pipeline_apply(
         lambda leaf: leaf.reshape((S, M * mb) + leaf.shape[3:]), new_carry
     )
     return from_mb(y), new_carry
+
+
+def pipeline_apply_multi(
+    stage_fn: Callable,
+    stage_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "pipe",
+    n_microbatches: Optional[int] = None,
+    stage_carry: Any = None,
+    shared: Any = None,
+):
+    """Pipeline S = k*P stages over P devices as k sequential passes of
+    the P-stage GPipe schedule (a looped pipeline: device d runs global
+    stages j*P + d for j in 0..k-1).
+
+    Accepts the same `[S, ...]`-leading stage_params/stage_carry layout
+    as `pipeline_apply` and reduces to it when S == P. Each pass pays its
+    own fill/drain bubble — the simple schedule; an interleaved 1F1B
+    would trade that for a much hairier program. Bubble cost is
+    (P-1)/(M+P-1) per pass, so raise n_microbatches to amortize.
+    """
+    S_total = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    P_devices = mesh.shape[axis]
+    if S_total == P_devices:
+        return pipeline_apply(
+            stage_fn, stage_params, x, mesh=mesh, axis=axis,
+            n_microbatches=n_microbatches, stage_carry=stage_carry,
+            shared=shared,
+        )
+    if S_total % P_devices != 0:
+        raise ValueError(
+            f"{S_total} stages not divisible by the `{axis}` axis size "
+            f"{P_devices}"
+        )
+    k = S_total // P_devices
+
+    def pass_slice(tree, j):
+        return jax.tree_util.tree_map(
+            lambda leaf: leaf.reshape(
+                (k, P_devices) + leaf.shape[1:]
+            )[j],
+            tree,
+        )
+
+    new_carries = []
+    for j in range(k):
+        carry_j = None if stage_carry is None else pass_slice(
+            stage_carry, j
+        )
+        x, new_c = pipeline_apply(
+            stage_fn, pass_slice(stage_params, j), x, mesh=mesh,
+            axis=axis, n_microbatches=n_microbatches,
+            stage_carry=carry_j, shared=shared,
+        )
+        new_carries.append(new_c)
+    if stage_carry is None:
+        return x, None
+    new_carry = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves).reshape(
+            (S_total,) + leaves[0].shape[1:]
+        ),
+        *new_carries,
+    )
+    return x, new_carry
