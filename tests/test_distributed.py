@@ -47,7 +47,11 @@ def test_two_process_dp_update_matches_single_device():
         outputs.append(out)
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
-        assert "matches single-device OK" in out
+        assert "distributed update matches single-device OK" in out
+        assert (
+            "composite data x expert update matches single-device OK"
+            in out
+        )
 
 
 def _run_poly_workers(tmp_path, total_steps, timeout=420):

@@ -78,6 +78,9 @@ def make_parser():
     parser.add_argument("--model", default="mlp",
                         choices=["mlp", "shallow", "deep", "pipelined_mlp", "transformer"])
     parser.add_argument("--use_lstm", action="store_true")
+    parser.add_argument("--num_experts", type=int, default=0,
+                        help="Transformer-only: top-2 MoE FFN with N "
+                             "experts (load-balance loss in objective).")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--num_devices", type=int, default=1,
                         help="Data-parallel devices (envs sharded, params "
@@ -248,8 +251,16 @@ def train(flags):
         unroll_length=flags.unroll_length,
         batch_size=flags.batch_size,
     )
+    extra = {}
+    if getattr(flags, "num_experts", 0):
+        if flags.model != "transformer":
+            raise ValueError(
+                "--num_experts applies to --model transformer only"
+            )
+        extra["num_experts"] = flags.num_experts
     model = create_model(
-        flags.model, num_actions=env.num_actions, use_lstm=flags.use_lstm
+        flags.model, num_actions=env.num_actions, use_lstm=flags.use_lstm,
+        **extra,
     )
     optimizer = learner_lib.make_optimizer(hp)
 
