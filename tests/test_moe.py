@@ -172,3 +172,95 @@ def test_acting_path_unaffected_by_sow():
         params, inputs, state, rngs={"action": jax.random.PRNGKey(9)}
     )
     assert out.action.shape == (1, B)
+
+
+def _init_model_params(model, A, frame_shape=(4, 4, 1), B=2):
+    rng = np.random.default_rng(11)
+    dummy = {
+        "frame": rng.integers(0, 256, (1, B) + frame_shape, dtype=np.uint8),
+        "reward": np.zeros((1, B), np.float32),
+        "done": np.zeros((1, B), bool),
+        "last_action": np.zeros((1, B), np.int32),
+    }
+    state = model.initial_state(B)
+    return model.init(
+        {"params": jax.random.PRNGKey(11), "action": jax.random.PRNGKey(12)},
+        dummy,
+        state,
+    )
+
+
+def test_expert_sharding_contract_on_real_transformer_tree():
+    """The EP sharding rule must fire on exactly the expert kernels of
+    the REAL transformer-MoE param tree — by name and count — so a
+    rename in models/moe.py fails loudly here instead of silently
+    degrading to fully-replicated experts (parallel/ep.py)."""
+    num_layers, E = 2, 4
+    mesh = Mesh(np.asarray(jax.devices()[:E]), ("expert",))
+    model = create_model(
+        "transformer", num_actions=5, num_layers=num_layers, d_model=16,
+        num_heads=2, memory_len=4, num_experts=E,
+    )
+    params = _init_model_params(model, A=5)
+    shardings = expert_param_shardings(mesh, params["params"])
+    flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    sharded = sorted(
+        jax.tree_util.keystr(path)
+        for path, s in flat
+        if not s.is_fully_replicated
+    )
+    expected = sorted(
+        f"['block_{i}']['moe']['{k}']"
+        for i in range(num_layers)
+        for k in ("w_in", "w_out")
+    )
+    assert sharded == expected, (
+        f"EP rule fired on {sharded}, expected exactly {expected} — "
+        "did models/moe.py rename its expert kernels?"
+    )
+
+
+def test_pipelined_stage_params_not_expert_sharded():
+    """PipelinedMLPNet reuses the leaf names w_in/w_out for its stage
+    stack [S, d, ff]; the EP rule must NOT shard those over the expert
+    axis (no router sibling = not a MoE scope)."""
+    S = 4
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("expert",))
+    model = create_model(
+        "pipelined_mlp", num_actions=5, num_stages=S, d_model=16,
+    )
+    params = _init_model_params(model, A=5)
+    shardings = expert_param_shardings(mesh, params["params"])
+    assert all(
+        s.is_fully_replicated
+        for s in jax.tree_util.tree_leaves(shardings)
+    )
+
+
+def test_expert_sharding_contract_covers_opt_state():
+    """polybeast shards the donated optax state with the SAME rule
+    (polybeast.py `opt_shardings`); the MoE structural signature must be
+    found inside optax's tuple/namedtuple wrappers too, or the [E, d, ff]
+    RMSProp moments silently replicate and EP's memory scaling is lost."""
+    num_layers, E = 1, 4
+    mesh = Mesh(np.asarray(jax.devices()[:E]), ("expert",))
+    model = create_model(
+        "transformer", num_actions=5, num_layers=num_layers, d_model=16,
+        num_heads=2, memory_len=4, num_experts=E,
+    )
+    params = _init_model_params(model, A=5)
+    hp = learner_lib.HParams(batch_size=2, unroll_length=4)
+    opt_state = learner_lib.make_optimizer(hp).init(params)
+    shardings = expert_param_shardings(mesh, opt_state)
+    flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    sharded = [
+        jax.tree_util.keystr(path)
+        for path, s in flat
+        if not s.is_fully_replicated
+    ]
+    # Every occurrence of an expert kernel inside the optimizer moments
+    # must be sharded (rmsprop: one `nu` accumulator tree; momentum off).
+    assert sharded, "no opt_state leaves expert-sharded"
+    assert all("['moe']" in p for p in sharded)
+    n_kernels_in_params = 2 * num_layers
+    assert len(sharded) % n_kernels_in_params == 0

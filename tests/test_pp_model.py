@@ -188,3 +188,159 @@ def test_pipelined_model_more_stages_than_devices():
     np.testing.assert_allclose(
         out_pipe.policy_logits, out_seq.policy_logits, rtol=1e-5, atol=1e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# PipelinedTransformerNet: the long-context family under the same schedule.
+# ---------------------------------------------------------------------------
+
+def _tf_models(n_dev=4, num_layers=4, n_microbatches=None):
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("pipe",))
+    kwargs = dict(
+        num_actions=A, num_layers=num_layers, d_model=32, num_heads=2,
+        memory_len=8,
+    )
+    seq = create_model("pipelined_transformer", **kwargs)
+    pipe = create_model(
+        "pipelined_transformer", mesh=mesh,
+        n_microbatches=n_microbatches, **kwargs
+    )
+    return seq, pipe, mesh
+
+
+def test_pipelined_transformer_matches_sequential_with_cache():
+    """Two chained unrolls: outputs AND the rolled KV-cache state must
+    match the sequential stack bitwise-close (the cache rides the
+    pipeline as resident stage carry)."""
+    seq, pipe, _ = _tf_models()
+    b1, b2 = _batch(seed=4), _batch(seed=5)
+    state0 = seq.initial_state(B)
+    params = seq.init(
+        {"params": jax.random.PRNGKey(8), "action": jax.random.PRNGKey(9)},
+        b1,
+        state0,
+    )
+    out_s1, st_s = seq.apply(params, b1, state0, sample_action=False)
+    out_p1, st_p = pipe.apply(params, b1, state0, sample_action=False)
+    np.testing.assert_allclose(
+        out_p1.policy_logits, out_s1.policy_logits, rtol=1e-5, atol=1e-5
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        ),
+        st_p,
+        st_s,
+    )
+    # Second unroll from the carried (non-zero) cache.
+    out_s2, _ = seq.apply(params, b2, st_s, sample_action=False)
+    out_p2, _ = pipe.apply(params, b2, st_p, sample_action=False)
+    np.testing.assert_allclose(
+        out_p2.policy_logits, out_s2.policy_logits, rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        out_p2.baseline, out_s2.baseline, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pipelined_transformer_update_step_matches_sequential():
+    """Full V-trace/RMSProp update: pipelined gradients == sequential
+    gradients, with stage params placed sharded over the pipe axis."""
+    from torchbeast_tpu.models import PipelinedTransformerNet
+
+    seq, pipe, mesh = _tf_models()
+    batch = _batch(seed=6)
+    state = seq.initial_state(B)
+    params = seq.init(
+        {"params": jax.random.PRNGKey(10), "action": jax.random.PRNGKey(11)},
+        batch,
+        state,
+    )
+    hp = learner_lib.HParams(batch_size=B, unroll_length=T)
+    optimizer = learner_lib.make_optimizer(hp)
+    step_seq = learner_lib.make_update_step(seq, optimizer, hp, donate=False)
+    step_pipe = learner_lib.make_update_step(
+        pipe, optimizer, hp, donate=False
+    )
+    p_seq, _, stats_seq = step_seq(
+        params, optimizer.init(params), batch, state
+    )
+    shardings = stage_param_shardings(mesh, params["params"], axis="pipe")
+    placed = {
+        "params": {
+            k: (
+                jax.device_put(v, shardings[k])
+                if k in PipelinedTransformerNet.STAGE_PARAM_NAMES
+                else v
+            )
+            for k, v in params["params"].items()
+        }
+    }
+    p_pipe, _, stats_pipe = step_pipe(
+        placed, optimizer.init(placed), batch, state
+    )
+    np.testing.assert_allclose(
+        float(stats_pipe["total_loss"]), float(stats_seq["total_loss"]),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(stats_pipe["grad_norm"]), float(stats_seq["grad_norm"]),
+        rtol=1e-4,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        p_pipe,
+        p_seq,
+    )
+
+
+def test_pipelined_transformer_looped_and_microbatched():
+    """8 layers on 4 devices (looped schedule) with M=8 microbatches."""
+    seq, pipe, _ = _tf_models(n_dev=4, num_layers=8, n_microbatches=8)
+    batch = _batch(seed=7)
+    state = seq.initial_state(B)
+    params = seq.init(
+        {"params": jax.random.PRNGKey(12), "action": jax.random.PRNGKey(13)},
+        batch,
+        state,
+    )
+    out_seq, _ = seq.apply(params, batch, state, sample_action=False)
+    out_pipe, _ = pipe.apply(params, batch, state, sample_action=False)
+    np.testing.assert_allclose(
+        out_pipe.policy_logits, out_seq.policy_logits, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pipelined_transformer_acting_fallback():
+    """T=1, B=1 acting batch (indivisible by microbatches): the mesh
+    model must fall back to the sequential loop, not crash, and agree
+    with the no-mesh model."""
+    seq, pipe, _ = _tf_models()
+    rng = np.random.default_rng(8)
+    inputs = {
+        "frame": rng.integers(0, 256, (1, 1, 6, 6, 1), dtype=np.uint8),
+        "reward": np.zeros((1, 1), np.float32),
+        "done": np.zeros((1, 1), bool),
+        "last_action": np.zeros((1, 1), np.int32),
+    }
+    state = seq.initial_state(1)
+    batch = _batch(seed=9)
+    params = seq.init(
+        {"params": jax.random.PRNGKey(14), "action": jax.random.PRNGKey(15)},
+        batch,
+        seq.initial_state(B),
+    )
+    out_s, st_s = seq.apply(params, inputs, state, sample_action=False)
+    out_p, st_p = pipe.apply(params, inputs, state, sample_action=False)
+    np.testing.assert_allclose(
+        out_p.policy_logits, out_s.policy_logits, rtol=1e-5, atol=1e-5
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        ),
+        st_p,
+        st_s,
+    )

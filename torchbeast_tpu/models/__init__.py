@@ -11,6 +11,9 @@ from torchbeast_tpu.models.mlp import MLPNet  # noqa: F401
 from torchbeast_tpu.models.pipelined import PipelinedMLPNet  # noqa: F401
 from torchbeast_tpu.models.resnet import ResNet  # noqa: F401
 from torchbeast_tpu.models.transformer import TransformerNet  # noqa: F401
+from torchbeast_tpu.models.transformer_pp import (  # noqa: F401
+    PipelinedTransformerNet,
+)
 
 _REGISTRY = {
     "shallow": AtariNet,
@@ -20,6 +23,7 @@ _REGISTRY = {
     "mlp": MLPNet,
     "pipelined_mlp": PipelinedMLPNet,
     "transformer": TransformerNet,
+    "pipelined_transformer": PipelinedTransformerNet,
 }
 
 
@@ -30,7 +34,7 @@ def create_model(name: str, num_actions: int, use_lstm: bool = False, **kwargs):
         raise ValueError(
             f"Unknown model {name!r}; available: {sorted(_REGISTRY)}"
         ) from None
-    if cls is TransformerNet and use_lstm:
+    if cls in (TransformerNet, PipelinedTransformerNet) and use_lstm:
         raise ValueError(
             "--use_lstm does not apply to the transformer family (its "
             "memory is the KV cache); drop the flag"

@@ -20,7 +20,10 @@ import jax
 import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
-from torchbeast_tpu.parallel.pp import pipeline_apply_multi
+from torchbeast_tpu.parallel.pp import (
+    default_n_microbatches,
+    pipeline_apply_multi,
+)
 
 
 def _layer_norm(x, scale, bias, eps=1e-6):
@@ -98,7 +101,16 @@ class PipelinedMLPNet(nn.Module):
             "b_out": self.param("b_out", nn.initializers.zeros, (S, d)),
         }
 
-        if self.mesh is not None:
+        # Acting/eval batches (B=1 test mode, small inference buckets)
+        # need not divide into microbatches; they fall back to the
+        # sequential stage loop below — same params, same math — exactly
+        # like the transformer's T=1 dense-attention fallback. Pipelining
+        # only ever pays off on the big learner batches, and the drivers
+        # validate learner-batch divisibility up front so training can
+        # never land here silently (monobeast.py).
+        if self.mesh is not None and (T * B) % default_n_microbatches(
+            self.mesh, self.pipe_axis, self.n_microbatches
+        ) == 0:
             x, _ = pipeline_apply_multi(
                 _stage_fn,
                 stage_params,

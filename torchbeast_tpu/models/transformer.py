@@ -43,8 +43,10 @@ import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead
 from torchbeast_tpu.ops.attention import (
+    band_relative_offsets,
     dense_transformer_attend,
     ring_transformer_attention,
+    roll_kv_cache,
     segment_ids_from_done,
     ulysses_transformer_attention,
 )
@@ -242,14 +244,9 @@ class TransformerNet(nn.Module):
         # with rolling eviction) are exactly "query t sees times in
         # [t - M, t]" — encoding that as a band mask makes the batch
         # (learner) forward identical to the actor's stepwise forward for
-        # ANY T and cache fill level.
-        q_time = jnp.arange(T)
-        key_time = jnp.concatenate(
-            [jnp.arange(M) - M, jnp.arange(T)]
-        )  # [M + T]
-        offsets = q_time[:, None] - key_time[None, :]  # [T, M+T]
-        band = (offsets >= 0) & (offsets <= M)
-        offsets = jnp.clip(offsets, 0, M)
+        # ANY T and cache fill level. (Shared with the pipelined family,
+        # ops/attention.py.)
+        band, offsets = band_relative_offsets(T, M)
 
         # In-unroll mask: band-causal + same segment.
         same = seg[:, :, None] == seg[:, None, :]
@@ -290,17 +287,16 @@ class TransformerNet(nn.Module):
             )
 
             # Roll the cache: last M of [old cache; this unroll], validity
-            # restricted to the final segment.
-            final_seg = seg[:, -1:]
-            seq_valid = (seg == final_seg)  # [B, T]
-            old_valid = valid_b.astype(bool) & no_done_yet[:, -1:]
-            k_all = jnp.concatenate([k_cache_b, k_new], axis=1)
-            v_all = jnp.concatenate([v_cache_b, v_new], axis=1)
-            valid_all = jnp.concatenate([old_valid, seq_valid], axis=1)
+            # restricted to the final segment (shared helper,
+            # ops/attention.py).
+            k_roll, v_roll, valid_roll = roll_kv_cache(
+                k_cache_b, v_cache_b, valid_b, k_new, v_new,
+                seg, no_done_yet,
+            )
             new_state.append((
-                k_all[:, -M:].transpose(1, 0, 2, 3),
-                v_all[:, -M:].transpose(1, 0, 2, 3),
-                valid_all[:, -M:].astype(jnp.float32).T,
+                k_roll.transpose(1, 0, 2, 3),
+                v_roll.transpose(1, 0, 2, 3),
+                valid_roll.T,
             ))
 
         x = nn.LayerNorm()(x)

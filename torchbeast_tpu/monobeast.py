@@ -64,7 +64,7 @@ def make_parser():
     parser.add_argument("--unroll_length", type=int, default=80,
                         help="The unroll length (time dimension).")
     parser.add_argument("--model", default="shallow",
-                        choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer"],
+                        choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer", "pipelined_transformer"],
                         help="Model family (Mono used shallow; Poly deep; "
                              "mlp for tiny frames).")
     parser.add_argument("--use_lstm", action="store_true",
@@ -90,14 +90,18 @@ def make_parser():
                              "forwards fall back to dense with the same "
                              "params).")
     parser.add_argument("--pipeline_parallel", type=int, default=0,
-                        help="Run the pipelined_mlp tower as a GPipe "
+                        help="Run the pipelined_mlp / "
+                             "pipelined_transformer tower as a GPipe "
                              "pipeline over N devices (a `pipe` mesh "
                              "axis; stage params one-per-chip, "
                              "activations rotate via ppermute).")
     parser.add_argument("--pipeline_stages", type=int, default=0,
-                        help="Total tower depth for pipelined_mlp "
-                             "(default: one stage per pipeline device; "
-                             "a multiple k*N runs k looped passes).")
+                        help="Total tower depth (pipelined_mlp stages / "
+                             "pipelined_transformer layers). Default: "
+                             "one stage per pipeline device for the MLP; "
+                             "the model's own num_layers for the "
+                             "transformer. A multiple k*N runs k looped "
+                             "passes.")
     parser.add_argument("--num_experts", type=int, default=0,
                         help="Replace the transformer's FFN with a top-2 "
                              "mixture of N experts (model=transformer "
@@ -261,20 +265,12 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         ring_schedule = getattr(flags, "ring_schedule", "contiguous")
         sp_strategy = getattr(flags, "sp_strategy", "ring")
         if sp_strategy == "ulysses":
-            from torchbeast_tpu.models import TransformerNet
-
             if ring_schedule != "contiguous":
                 raise ValueError(
                     "--ring_schedule applies to --sp_strategy ring only"
                 )
-            num_heads = TransformerNet.num_heads  # driver uses defaults
-            if num_heads % seq_par != 0:
-                # The model would silently fall back to dense attention.
-                raise ValueError(
-                    f"--sp_strategy ulysses requires num_heads "
-                    f"({num_heads}) divisible by --sequence_parallel "
-                    f"{seq_par} (heads are the sharded resource)"
-                )
+            # num_heads divisibility is validated AFTER create_model below,
+            # against the heads the model is actually constructed with.
             divisor = seq_par
         else:
             divisor = 2 * seq_par if ring_schedule == "zigzag" else seq_par
@@ -315,30 +311,69 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             "--pipeline_parallel are mutually exclusive (each builds its "
             "own device mesh; a combined run needs one multi-axis mesh)"
         )
+    pipelined_models = ("pipelined_mlp", "pipelined_transformer")
+    # The stage-count kwarg differs by family: the MLP's tower depth is
+    # num_stages, the transformer's is its layer count.
+    stage_kwarg = (
+        "num_layers" if flags.model == "pipelined_transformer"
+        else "num_stages"
+    )
     if pipe_par and pipe_par > 1:
-        if flags.model != "pipelined_mlp":
+        if flags.model not in pipelined_models:
             raise ValueError(
-                "--pipeline_parallel needs --model pipelined_mlp (the "
-                "other families have no stage-uniform tower to pipeline)"
+                "--pipeline_parallel needs --model pipelined_mlp or "
+                "pipelined_transformer (the other families have no "
+                "stage-uniform tower to pipeline)"
             )
         extra["mesh"] = _make_1d_mesh(pipe_par, "pipe", "pipeline_parallel")
-        n_stages = getattr(flags, "pipeline_stages", 0) or pipe_par
+        # Stage-count default differs by family: the MLP tower's depth is
+        # a pipeline artifact (one stage per device, as documented); the
+        # transformer's depth is an ARCHITECTURE choice, so it defaults
+        # to the model's own num_layers — deriving it from the device
+        # count would silently change the net (and break checkpoint
+        # compatibility with non-pipelined runs).
+        if flags.model == "pipelined_transformer":
+            from torchbeast_tpu.models import PipelinedTransformerNet
+
+            default_stages = PipelinedTransformerNet.num_layers
+        else:
+            default_stages = pipe_par
+        n_stages = getattr(flags, "pipeline_stages", 0) or default_stages
         if n_stages % pipe_par != 0:
             raise ValueError(
                 f"--pipeline_stages {n_stages} must be a multiple of "
                 f"--pipeline_parallel {pipe_par}"
             )
-        extra["num_stages"] = n_stages
-    elif flags.model == "pipelined_mlp":
+        extra[stage_kwarg] = n_stages
+        # The learner batch must divide into microbatches (default: one
+        # per pipe device) or every training forward would silently take
+        # the models' sequential fallback — the opposite of what the
+        # flag asks for. (Acting/eval batches fall back by design.)
+        from torchbeast_tpu.parallel.pp import default_n_microbatches
+
+        n_micro = default_n_microbatches(extra["mesh"], "pipe")
+        if flags.model == "pipelined_transformer":
+            pipelined_quantity, what = flags.batch_size, "batch_size"
+        else:  # pipelined_mlp microbatches over flattened T*B tokens
+            pipelined_quantity = (flags.unroll_length + 1) * flags.batch_size
+            what = "(unroll_length+1)*batch_size"
+        if pipelined_quantity % n_micro != 0:
+            raise ValueError(
+                f"--pipeline_parallel {pipe_par} requires {what} "
+                f"(= {pipelined_quantity}) divisible by the microbatch "
+                "count (one per pipeline device) — otherwise the learner "
+                "step would silently run the sequential fallback"
+            )
+    elif flags.model in pipelined_models:
         # No mesh, but the requested tower depth still applies — a
-        # silently different num_stages would make checkpoints
+        # silently different stage count would make checkpoints
         # shape-incompatible with a later pipelined run.
         n_stages = getattr(flags, "pipeline_stages", 0)
         if n_stages:
-            extra["num_stages"] = n_stages
+            extra[stage_kwarg] = n_stages
         logging.getLogger(__name__).info(
-            "--model pipelined_mlp without --pipeline_parallel: the "
-            "stage tower runs sequentially on one device"
+            "--model %s without --pipeline_parallel: the stage tower "
+            "runs sequentially on one device", flags.model,
         )
     if num_experts:
         if flags.model != "transformer":
@@ -360,6 +395,21 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
         dtype=dtype, **extra,
     )
+    if (
+        seq_par
+        and seq_par > 1
+        and extra.get("sp_strategy") == "ulysses"
+        and model.num_heads % seq_par != 0
+    ):
+        # Validated against the CONSTRUCTED model (not the class default,
+        # which would silently diverge if a num_heads flag/kwarg is ever
+        # added): an indivisible head count makes the model fall back to
+        # dense attention — the opposite of what the flag asks for.
+        raise ValueError(
+            f"--sp_strategy ulysses requires num_heads "
+            f"({model.num_heads}) divisible by --sequence_parallel "
+            f"{seq_par} (heads are the sharded resource)"
+        )
     dummy = {
         "frame": np.zeros((1, batch_size) + tuple(frame_shape), frame_dtype),
         "reward": np.zeros((1, batch_size), np.float32),

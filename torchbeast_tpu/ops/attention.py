@@ -549,6 +549,49 @@ def _zigzag_transformer_ring(q, k, v, cache_k, cache_v, cache_mask,
     return constrain(jnp.take(out_z, inv_perm, axis=1), seq_sh)
 
 
+def band_relative_offsets(T: int, M: int):
+    """(band, offsets) over the combined [cache; unroll] key axis —
+    the ONE implementation of the transformer families' windowed-causal
+    time geometry (models/transformer.py and models/transformer_pp.py
+    both consume it, so the semantics cannot drift apart).
+
+    Cache slot m (of M, oldest-first) has time m - M; in-unroll step j
+    has time j; query t may attend to times in [t - M, t]. Returns
+    band [T, M+T] bool and offsets [T, M+T] int clipped to [0, M]
+    (indices into the learned relative bias).
+    """
+    q_time = jnp.arange(T)
+    key_time = jnp.concatenate([jnp.arange(M) - M, jnp.arange(T)])
+    offsets = q_time[:, None] - key_time[None, :]  # [T, M+T]
+    band = (offsets >= 0) & (offsets <= M)
+    return band, jnp.clip(offsets, 0, M)
+
+
+def roll_kv_cache(k_cache, v_cache, valid, k_new, v_new, seg, no_done):
+    """Roll a per-layer KV cache across an unroll (batch-first layout):
+    keep the last M of [old cache; this unroll], with validity restricted
+    to the FINAL segment (an episode boundary inside the unroll evicts
+    everything before it). Shared by both transformer families — see
+    band_relative_offsets.
+
+    k_cache/v_cache: [B, M, H, hd]; valid: [B, M] (float or bool);
+    k_new/v_new: [B, T, H, hd]; seg/no_done: [B, T].
+    Returns (k, v, valid_f32) in the same batch-first layout.
+    """
+    M = k_cache.shape[1]
+    final_seg = seg[:, -1:]
+    seq_valid = seg == final_seg  # [B, T]
+    old_valid = valid.astype(bool) & no_done[:, -1:]
+    k_cat = jnp.concatenate([k_cache, k_new], axis=1)
+    v_cat = jnp.concatenate([v_cache, v_new], axis=1)
+    valid_cat = jnp.concatenate([old_valid, seq_valid], axis=1)
+    return (
+        k_cat[:, -M:],
+        v_cat[:, -M:],
+        valid_cat[:, -M:].astype(jnp.float32),
+    )
+
+
 def dense_transformer_attend(q, k_all, v_all, mask, offsets, rel_bias):
     """The transformer policy's dense attention body — ONE implementation
     shared by the model's dense branch (models/transformer.py _Block) and

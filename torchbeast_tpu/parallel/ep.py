@@ -27,19 +27,49 @@ def expert_param_shardings(
 
     Shape heuristics alone are deliberately not trusted: a `[d, E]`
     router kernel, an `[E, ff]` expert bias, or a `[H, hd, d]` attention
-    out-projection with H == E would all false-positive. The biases stay
-    replicated (tiny — replication is free; the activation sharding
-    constraints in models/moe.py keep the expert compute sharded
-    regardless).
+    out-projection with H == E would all false-positive. Leaf names alone
+    are not either: PipelinedMLPNet's stage params reuse `w_in`/`w_out`
+    with a `[S, d, ff]` layout that would silently shard over the wrong
+    axis. So the rule additionally requires the leaf's scope to carry the
+    MoEFFN structural signature — a sibling `router` submodule in the
+    same dict (models/moe.py always pairs the expert kernels with their
+    router; no other model family does). The biases stay replicated
+    (tiny — replication is free; the activation sharding constraints in
+    models/moe.py keep the expert compute sharded regardless).
     """
     E = mesh.shape[axis]
     expert_kernel_names = {"w_in", "w_out"}
 
+    def tok(entry):
+        # One tokenization for dict keys, namedtuple fields (optax
+        # states), and sequence positions — used for BOTH scope
+        # discovery and the rule below, so they cannot disagree.
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                return getattr(entry, attr)
+        return None
+
+    # Scopes (path prefixes) that structurally look like a MoEFFN: they
+    # contain a `router` entry alongside the expert kernels. Derived from
+    # the flattened leaf paths (NOT a hand-rolled container walk) so the
+    # signature is found at any nesting depth — including params-shaped
+    # subtrees inside optax state tuples/namedtuples, which polybeast
+    # places with this same rule for donation-safe opt_state sharding.
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    moe_scopes = set()
+    for path, _leaf in flat:
+        toks = tuple(tok(p) for p in path)
+        for i, t in enumerate(toks):
+            if t == "router":
+                moe_scopes.add(toks[:i])
+
     def rule(path, leaf):
-        name = path[-1].key if path and hasattr(path[-1], "key") else None
+        name = tok(path[-1]) if path else None
+        scope = tuple(tok(p) for p in path[:-1])
         if (
             E > 1
             and name in expert_kernel_names
+            and scope in moe_scopes
             and hasattr(leaf, "ndim")
             and leaf.ndim >= 3
             and leaf.shape[0] % E == 0

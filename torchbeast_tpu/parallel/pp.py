@@ -34,6 +34,17 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
+def default_n_microbatches(
+    mesh: Mesh, axis: str = "pipe", n_microbatches: Optional[int] = None
+) -> int:
+    """The microbatch count pipeline_apply will actually use — the single
+    source of truth for model-side divisibility checks and fallbacks
+    (models/pipelined.py, models/transformer_pp.py)."""
+    return (
+        n_microbatches if n_microbatches is not None else mesh.shape[axis]
+    )
+
+
 def stack_stages(per_stage_trees):
     """Stack a list of per-stage pytrees along a new leading stage axis
     (the layout pipeline_apply expects for `stage_params`)."""
@@ -83,7 +94,7 @@ def pipeline_apply(
     """
     S = mesh.shape[axis]
     B = x.shape[0]
-    M = S if n_microbatches is None else n_microbatches
+    M = default_n_microbatches(mesh, axis, n_microbatches)
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
     for tree, what in ((stage_params, "stage_params"),

@@ -75,7 +75,7 @@ def make_parser():
     parser.add_argument("--batch_size", type=int, default=8)
     parser.add_argument("--unroll_length", type=int, default=80)
     parser.add_argument("--model", default="deep",
-                        choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer"])
+                        choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer", "pipelined_transformer"])
     parser.add_argument("--use_lstm", action="store_true")
     parser.add_argument("--model_dtype", default="float32",
                         choices=["float32", "bfloat16"],
@@ -107,9 +107,11 @@ def make_parser():
                              "balances causal work; unroll_length+1 "
                              "divisible by 2N).")
     parser.add_argument("--pipeline_parallel", type=int, default=0,
-                        help="Run the pipelined_mlp tower as a GPipe "
+                        help="Run the pipelined_mlp / "
+                             "pipelined_transformer tower as a GPipe "
                              "pipeline over N devices (a `pipe` mesh "
-                             "axis). Sets num_stages=N.")
+                             "axis). MLP tower depth = N; the "
+                             "transformer keeps its own num_layers.")
     parser.add_argument("--num_experts", type=int, default=0,
                         help="Replace the transformer's FFN with a top-2 "
                              "mixture of N experts (model=transformer "
