@@ -286,6 +286,20 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         if seq_mesh is not None:
             extra["mesh"] = seq_mesh
             extra["batch_axis"] = "data"
+        elif getattr(flags, "expert_parallel", 0) > 1:
+            # SP x EP on one (data=1, model=1, seq, expert) mesh: the
+            # attention shard_maps use `seq`, the MoE constraints use
+            # `expert` (parallel/mesh.py; parity pinned by
+            # tests/test_composite_mesh.py).
+            from torchbeast_tpu.parallel import create_mesh
+
+            ep = flags.expert_parallel
+            extra["mesh"] = create_mesh(
+                seq_par * ep,
+                expert_parallelism=ep,
+                seq_parallelism=seq_par,
+            )
+            extra["batch_axis"] = "data"
         else:
             extra["mesh"] = _make_1d_mesh(
                 seq_par, "seq", "sequence_parallel"
@@ -297,19 +311,17 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
     pipe_par = getattr(flags, "pipeline_parallel", 0)
     if expert_par and not num_experts:
         raise ValueError("--expert_parallel needs --num_experts")
-    n_parallel_axes = sum(
-        1 for n in (seq_par, expert_par, pipe_par) if n and n > 1
-    )
-    if n_parallel_axes > 1:
-        # Each flag builds its own 1-D mesh; two different meshes inside
-        # one jitted program is an XLA "incompatible devices" compile
-        # error — reject with a clear message instead. Combining axes
-        # needs a single multi-axis mesh (parallel/mesh.py is the place
-        # to grow one).
+    if (pipe_par or 0) > 1 and (
+        (seq_par or 0) > 1 or (expert_par or 0) > 1
+    ):
+        # SP and EP compose on one multi-axis mesh (above); the GPipe
+        # shard_map's own ring schedule does not — its stage rotation
+        # would need interleaving with the attention/MoE collectives.
         raise ValueError(
-            "--sequence_parallel, --expert_parallel and "
-            "--pipeline_parallel are mutually exclusive (each builds its "
-            "own device mesh; a combined run needs one multi-axis mesh)"
+            "--pipeline_parallel cannot combine with "
+            "--sequence_parallel or --expert_parallel (the pipeline "
+            "schedule owns its mesh; SP x EP do compose with each other "
+            "and with data parallelism)"
         )
     pipelined_models = ("pipelined_mlp", "pipelined_transformer")
     # The stage-count kwarg differs by family: the MLP's tower depth is
@@ -388,9 +400,18 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                     f"--num_experts {num_experts} not divisible by "
                     f"--expert_parallel {expert_par}"
                 )
-            extra["moe_mesh"] = moe_mesh if moe_mesh is not None else (
-                _make_1d_mesh(expert_par, "expert", "expert_parallel")
-            )
+            if moe_mesh is not None:
+                extra["moe_mesh"] = moe_mesh
+            elif "expert" in getattr(
+                extra.get("mesh"), "shape", {}
+            ):
+                # The SP x EP composite mesh built above carries the
+                # `expert` axis — MoE constraints use the same mesh.
+                extra["moe_mesh"] = extra["mesh"]
+            else:
+                extra["moe_mesh"] = _make_1d_mesh(
+                    expert_par, "expert", "expert_parallel"
+                )
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
         dtype=dtype, **extra,

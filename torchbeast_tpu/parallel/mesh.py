@@ -30,7 +30,7 @@ def create_mesh(
     expert_parallelism: int = 1,
     seq_parallelism: int = 1,
 ) -> Mesh:
-    """(data[, model][, expert | seq]) mesh over the first n devices.
+    """(data[, model][, seq][, expert]) mesh over the first n devices.
 
     `n_devices` is the TOTAL device count; the data axis gets
     n / (model_parallelism * expert_parallelism * seq_parallelism). The
@@ -38,16 +38,16 @@ def create_mesh(
     plain meshes keep their two-axis shape), letting ONE mesh carry a
     data-parallel learner with expert-sharded MoE layers (all-to-alls on
     `expert`) or sequence-sharded attention (ppermute ring / all-to-alls
-    on `seq`) — gradients all-reduce over `data` either way. The inner
-    axes are innermost so their collectives stay within a data replica
-    group on neighboring chips.
+    on `seq`) — or BOTH at once on a (data, model, seq, expert) mesh:
+    the attention shard_maps partition over (`data`, `seq`) and the MoE
+    constraints over `expert`, each leaving the other's axis unmentioned
+    (= replicated), so gradients still all-reduce over `data` and the
+    two collective families never collide. The compute duplicated across
+    an unmentioned axis (attention x expert, MoE x seq) is the standard
+    cost of not further sharding those dims; correctness is pinned by
+    tests/test_composite_mesh.py. The inner axes are innermost so their
+    collectives stay within a data replica group on neighboring chips.
     """
-    if expert_parallelism > 1 and seq_parallelism > 1:
-        raise ValueError(
-            "expert_parallelism and seq_parallelism cannot combine yet "
-            "(the MoE constraints and the attention shard_map would need "
-            "a shared 3-inner-axis layout)"
-        )
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
@@ -65,6 +65,12 @@ def create_mesh(
             f"{model_parallelism} x expert_parallelism="
             f"{expert_parallelism} x seq_parallelism={seq_parallelism}"
         )
+    if expert_parallelism > 1 and seq_parallelism > 1:
+        grid = np.asarray(devices).reshape(
+            n // inner, model_parallelism, seq_parallelism,
+            expert_parallelism,
+        )
+        return Mesh(grid, ("data", "model", "seq", "expert"))
     if expert_parallelism > 1:
         grid = np.asarray(devices).reshape(
             n // inner, model_parallelism, expert_parallelism
