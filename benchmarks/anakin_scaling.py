@@ -1,0 +1,121 @@
+"""Anakin data-parallel scaling sweep: SPS at 1/2/4/8 devices with a
+FIXED per-device batch (weak scaling — the interesting axis for the
+Podracer design, where envs live on-device and the only cross-device
+traffic is the gradient all-reduce).
+
+Run (CPU mesh): XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python benchmarks/anakin_scaling.py
+On the real chip a single-device run gives the absolute number
+(bench.py's anakin_sps); multi-chip needs a pod, which this container
+does not have — the CPU mesh validates the scaling SHAPE.
+
+Prints one JSON line per device count plus a summary table.
+"""
+
+import json
+import os
+import sys
+import time
+
+if os.environ.get("JAX_PLATFORMS") is None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+
+from torchbeast_tpu import learner as learner_lib  # noqa: E402
+from torchbeast_tpu.anakin import initial_carry, make_train_step  # noqa: E402
+from torchbeast_tpu.envs.jax_env import create_jax_env  # noqa: E402
+from torchbeast_tpu.models import create_model  # noqa: E402
+
+PER_DEVICE_BATCH = 64
+TOTAL_BATCH = 512
+UNROLL = 16
+STEPS = 30
+WARMUP = 3
+
+
+def measure(n_devices: int, batch_size: int) -> float:
+    from torchbeast_tpu.parallel import create_mesh
+    from torchbeast_tpu.parallel.dp import replicate
+
+    env = create_jax_env("Catch")
+    hp = learner_lib.HParams(batch_size=batch_size, unroll_length=UNROLL)
+    model = create_model("mlp", num_actions=env.num_actions, use_lstm=False)
+    optimizer = learner_lib.make_optimizer(hp)
+    params, carry = initial_carry(
+        env, model, batch_size, jax.random.PRNGKey(0)
+    )
+    opt_state = optimizer.init(params)
+    if n_devices > 1:
+        mesh = create_mesh(n_devices)
+        params = replicate(mesh, params)
+        opt_state = replicate(mesh, opt_state)
+        train_step = make_train_step(env, model, optimizer, hp, mesh)
+    else:
+        train_step = make_train_step(env, model, optimizer, hp)
+
+    for _ in range(WARMUP):
+        params, opt_state, carry, stats = train_step(
+            params, opt_state, carry
+        )
+    float(stats["total_loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, carry, stats = train_step(
+            params, opt_state, carry
+        )
+    float(stats["total_loss"])  # host fetch = honest sync
+    elapsed = time.perf_counter() - t0
+    return batch_size * UNROLL * STEPS / elapsed
+
+
+def main():
+    counts = [
+        int(c) for c in (1, 2, 4, 8) if c <= len(jax.devices())
+    ]
+    platform = jax.devices()[0].platform
+    # Weak scaling (fixed per-device batch): the real multi-chip story —
+    # but on a VIRTUAL CPU mesh all devices share one host's cores, so
+    # total compute grows with n while the silicon doesn't; expect SPS
+    # to fall, and read the STRONG sweep for the DP-machinery cost.
+    results = {}
+    for n in counts:
+        sps = measure(n, PER_DEVICE_BATCH * n)
+        results[n] = sps
+        print(json.dumps({
+            "mode": "weak",
+            "devices": n,
+            "per_device_batch": PER_DEVICE_BATCH,
+            "unroll": UNROLL,
+            "sps": round(sps, 1),
+            "efficiency_vs_1dev": round(
+                sps / (results[1] * n), 3
+            ) if 1 in results else None,
+            "platform": platform,
+        }))
+        sys.stdout.flush()
+    # Strong scaling (fixed TOTAL batch): same total work at every n, so
+    # on shared silicon flat SPS == the DP sharding/collective machinery
+    # adds no overhead; falling SPS == the all-reduce/infeed costs bite.
+    results = {}
+    for n in counts:
+        sps = measure(n, TOTAL_BATCH)
+        results[n] = sps
+        print(json.dumps({
+            "mode": "strong",
+            "devices": n,
+            "total_batch": TOTAL_BATCH,
+            "unroll": UNROLL,
+            "sps": round(sps, 1),
+            "vs_1dev": round(sps / results[1], 3) if 1 in results else None,
+            "platform": platform,
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

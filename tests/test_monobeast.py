@@ -123,3 +123,44 @@ def test_train_transformer_zigzag_sequence_parallel(tmp_path):
     stats = monobeast.train(flags)
     assert stats["step"] >= 64
     assert np.isfinite(stats["total_loss"])
+
+
+def test_train_overlap_collect(tmp_path):
+    """--overlap_collect (policy lag 1): trains, checkpoints, resumes."""
+    flags = make_flags(tmp_path, xpid="smoke-ovl", overlap_collect=True)
+    stats = monobeast.train(flags)
+    assert stats["step"] >= 40
+    assert np.isfinite(stats["total_loss"])
+    flags2 = make_flags(
+        tmp_path, xpid="smoke-ovl", overlap_collect=True, total_steps=80
+    )
+    stats2 = monobeast.train(flags2)
+    assert stats2["step"] >= 80
+
+
+def test_overlap_collect_learns_catch(tmp_path):
+    """Lag-1 acting must not break learning: Catch is solved (or close)
+    within the same budget the zero-lag test uses."""
+    flags = make_flags(
+        tmp_path, xpid="ovl-catch", overlap_collect=True, env="Catch",
+        model="mlp", num_actors="16", batch_size="8", unroll_length="20",
+        total_steps="60000", learning_rate="2e-3", entropy_cost="0.01",
+    )
+    stats = monobeast.train(flags)
+    assert stats["mean_episode_return"] > 0.8
+
+
+def test_train_sp_x_ep_composite_flags(tmp_path):
+    """--sequence_parallel + --expert_parallel through the real flag
+    path: one composite (data=1, model=1, seq, expert) mesh shared by
+    the attention shard_maps and the MoE constraints (a regression here
+    is an XLA 'incompatible devices' compile error)."""
+    flags = make_flags(
+        tmp_path, xpid="spep", model="transformer",
+        sequence_parallel="2", num_experts="4", expert_parallel="2",
+        unroll_length="7", total_steps="28",
+    )
+    stats = monobeast.train(flags)
+    assert stats["step"] >= 28
+    assert np.isfinite(stats["total_loss"])
+    assert stats["aux_loss"] > 0.0

@@ -8,8 +8,9 @@ inference would starve the chip, so acting is *centrally batched*: env
 processes only step environments; every env step is one jitted `[1, B]`
 policy call on the TPU, and every unroll ends in one jitted update step. No
 weight copies at all — actor and learner share the same on-device params
-pytree. Policy lag is exactly zero (strictly stronger than the reference's
-queue-backpressure guarantee).
+pytree. Policy lag is exactly zero by default (strictly stronger than the
+reference's queue-backpressure guarantee); `--overlap_collect` trades it
+for lag exactly 1 so the update chain hides behind env stepping.
 
 Run:  python -m torchbeast_tpu.monobeast --env Mock --total_steps 20000
 """
@@ -123,6 +124,16 @@ def make_parser():
                         help="Ring attention block schedule: zigzag "
                              "balances causal work (~2x fewer busiest-"
                              "device FLOPs; needs T+1 divisible by 2N).")
+    parser.add_argument("--overlap_collect", action="store_true",
+                        help="Act on params that are one dispatched "
+                             "unroll-batch behind the learner head, so "
+                             "the update chain always hides behind env "
+                             "stepping and no act blocks on it. Default "
+                             "off = zero policy lag: the first act of "
+                             "each unroll waits for the update chain "
+                             "(the reference's actors lag by queue "
+                             "depth, so either mode is stricter than "
+                             "the reference).")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--checkpoint_interval_s", type=int, default=600,
                         help="Seconds between checkpoints (reference: 10min).")
@@ -488,7 +499,13 @@ def train(flags):
         stats = restored["stats"]
         log.info("Resuming preempted job, current stats:\n%s", stats)
 
-    update_step = learner_lib.make_update_step(model, optimizer, hp)
+    # Zero-lag mode donates params (nothing references the old buffer
+    # once the cell is swapped); overlap mode acts on the old params for
+    # a whole unroll, so only the opt state may be donated.
+    update_step = learner_lib.make_update_step(
+        model, optimizer, hp,
+        donate="opt_only" if flags.overlap_collect else True,
+    )
     act_step = learner_lib.make_act_step(model)
 
     pool = _make_pool(flags, B)
@@ -520,12 +537,22 @@ def train(flags):
         jax.profiler.start_trace(flags.profile_dir)
 
     # One-iteration-delayed stats fetch: updates for unroll k are
-    # DISPATCHED (async) and the host immediately starts collecting unroll
-    # k+1 — env stepping overlaps the update chain on-device, and the
-    # first act of k+1 picks up the new params through XLA's data
-    # dependency, so policy lag stays exactly zero. The blocking
-    # device_get of k's stats happens after k+1's work is underway.
+    # DISPATCHED (async) and the host immediately starts collecting
+    # unroll k+1; the blocking device_get of k's stats happens after
+    # k+1's work is underway. What overlaps beyond that depends on the
+    # policy-lag choice:
+    # - default (zero lag): the first act of unroll k+1 data-depends on
+    #   the updated params, so its device_get blocks until the update
+    #   chain finishes — only the stats fetch is truly overlapped. This
+    #   is a deliberate on-policy guarantee the reference does not have.
+    # - --overlap_collect: acting adopts the chain head only after a
+    #   full collect has passed since its dispatch, so the update chain
+    #   always hides behind env stepping and no act ever blocks on it.
+    #   The acting params trail the learner head by one dispatched
+    #   unroll-batch — still strictly tighter than the reference, whose
+    #   actors lag by queue depth (SURVEY.md, actorpool backpressure).
     pending = None  # (list of device stats, step after those updates)
+    latest_params = params_cell[0]  # head of the update chain
 
     def flush_stats(pending_entry):
         device_stats, at_step = pending_entry
@@ -547,6 +574,14 @@ def train(flags):
             timings.reset()
             batch, initial_agent_state = collector.collect()
             timings.time("collect")
+            if flags.overlap_collect:
+                # Adopt the chain head dispatched BEFORE this collect —
+                # it had the whole collect to materialize, so the next
+                # collect's first act won't block on it; the updates
+                # dispatched below hide behind the NEXT collect the same
+                # way. (Adopting before collect() would re-create the
+                # zero-lag block: the head would be moments old.)
+                params_cell[0] = latest_params
 
             # Split the [T+1, num_actors] unroll into learner batches of
             # batch_size columns; aggregate stats over ALL sub-batches
@@ -559,11 +594,13 @@ def train(flags):
                 sub_state = jax.tree_util.tree_map(
                     lambda s: s[:, i : i + flags.batch_size], initial_agent_state
                 )
-                params_cell[0], opt_state, train_stats = update_step(
-                    params_cell[0], opt_state, sub, sub_state
+                latest_params, opt_state, train_stats = update_step(
+                    latest_params, opt_state, sub, sub_state
                 )
                 device_stats.append(train_stats)
                 step += T * flags.batch_size
+            if not flags.overlap_collect:
+                params_cell[0] = latest_params  # zero policy lag
             if pending is not None:
                 stats = flush_stats(pending)
             pending = (device_stats, step)
@@ -596,7 +633,7 @@ def train(flags):
             if now - last_checkpoint_time > flags.checkpoint_interval_s:
                 save_checkpoint(
                     checkpoint_path,
-                    params=params_cell[0],
+                    params=latest_params,
                     opt_state=opt_state,
                     step=step,
                     flags=vars(flags),
@@ -624,7 +661,7 @@ def train(flags):
             jax.profiler.stop_trace()
         save_checkpoint(
             checkpoint_path,
-            params=params_cell[0],
+            params=latest_params,
             opt_state=opt_state,
             step=step,
             flags=vars(flags),
