@@ -1,0 +1,120 @@
+"""End-to-end async-driver system benchmark on the ambient accelerator.
+
+Runs the FULL polybeast stack — env-server processes, actor loops,
+DynamicBatcher inference (bucket-padded, pipelined dispatch), the
+BatchingQueue learner with prefetch — against the ambient backend (the
+real TPU under the driver) and records the SYSTEM numbers the isolated
+kernel benches can't show: end-to-end SPS, queue depths over time, and
+the Timings breakdown. This is the balanced-pipeline evidence the
+reference's design centers on (its 5-second queue telemetry loop,
+polybeast_learner.py:553-579).
+
+Usage: python benchmarks/tpu_e2e_async.py [--total_steps N] [--mock]
+Writes the captured log to --out (default /tmp/tbt_e2e.log) and prints
+a one-line JSON summary (steady-state SPS over the last half of the
+run, mean queue depths).
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOG_RE = re.compile(
+    r"Step (\d+) @ ([\d.]+) SPS\. Inference batcher size: (\d+)\. "
+    r"Learner queue size: (\d+)\."
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--total_steps", type=int, default=400_000)
+    ap.add_argument("--num_servers", type=int, default=16)
+    ap.add_argument("--num_actors", type=int, default=32)
+    ap.add_argument("--batch_size", type=int, default=8)
+    ap.add_argument("--unroll_length", type=int, default=40)
+    ap.add_argument("--model", default="shallow")
+    ap.add_argument("--env", default="Mock")
+    ap.add_argument("--native", action="store_true",
+                    help="C++ queues/pool + C++ env server")
+    ap.add_argument("--out", default="/tmp/tbt_e2e.log")
+    ap.add_argument("--timeout_s", type=int, default=1500)
+    args = ap.parse_args()
+
+    cmd = [
+        sys.executable, "-m", "torchbeast_tpu.polybeast",
+        "--env", args.env,
+        "--model", args.model,
+        "--num_servers", str(args.num_servers),
+        "--num_actors", str(args.num_actors),
+        "--batch_size", str(args.batch_size),
+        "--unroll_length", str(args.unroll_length),
+        "--total_steps", str(args.total_steps),
+        "--savedir", "/tmp/tbt_e2e_save",
+        "--xpid", f"e2e-{int(time.time())}",
+        "--pipes_basename", "unix:/tmp/tbt_e2e_pipe",
+    ]
+    if args.native:
+        cmd += ["--native_runtime", "--native_server"]
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    timed_out = False
+    rc = None
+    with open(args.out, "w") as logf:
+        try:
+            proc = subprocess.run(
+                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+                timeout=args.timeout_s, cwd=_REPO,
+            )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            # The log up to the kill still holds steady-state telemetry
+            # — summarize it rather than dying without the JSON line.
+            timed_out = True
+    wall = time.time() - t0
+
+    rows = []
+    with open(args.out) as f:
+        for line in f:
+            m = LOG_RE.search(line)
+            if m:
+                rows.append(tuple(float(x) for x in m.groups()))
+    if not rows:
+        print(json.dumps({
+            "error": f"no telemetry rows parsed (rc={rc}, "
+                     f"timed_out={timed_out})",
+            "log": args.out,
+        }))
+        sys.exit(1)
+    steady = rows[len(rows) // 2:]
+    sps = [r[1] for r in steady]
+    inf_q = [r[2] for r in steady]
+    lrn_q = [r[3] for r in steady]
+    print(json.dumps({
+        "config": {
+            k: getattr(args, k)
+            for k in ("env", "model", "num_servers", "num_actors",
+                      "batch_size", "unroll_length", "total_steps",
+                      "native")
+        },
+        "rc": rc,
+        "timed_out": timed_out,
+        "wall_s": round(wall, 1),
+        "steady_sps_mean": round(sum(sps) / len(sps), 1),
+        "steady_sps_max": round(max(sps), 1),
+        "inference_q_mean": round(sum(inf_q) / len(inf_q), 2),
+        "learner_q_mean": round(sum(lrn_q) / len(lrn_q), 2),
+        "n_telemetry_rows": len(rows),
+        "log": args.out,
+    }))
+
+
+if __name__ == "__main__":
+    main()

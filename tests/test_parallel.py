@@ -160,3 +160,99 @@ def test_parallel_update_keeps_params_replicated(setup):
     frame = batch_s["frame"]
     assert not frame.sharding.is_fully_replicated
     assert len(frame.sharding.device_set) == 8
+
+
+def test_transformer_megatron_tp_matches_single_device():
+    """Megatron column/row-paired TP for the transformer on a
+    (data=4 x model=2) mesh: the update must match single-device, and
+    the pairing must shard exactly the projection/FFN leaves (11 per
+    block + their optimizer moments)."""
+    from torchbeast_tpu.parallel import transformer_tp_shardings
+
+    mesh = create_mesh(8, model_parallelism=2)
+    kwargs = dict(
+        num_actions=A, num_layers=1, d_model=16, num_heads=2,
+        memory_len=4,
+    )
+    model = create_model("transformer", **kwargs)
+    batch = make_batch(rng_seed=3)
+    state = model.initial_state(B)
+    params = model.init(
+        {"params": jax.random.PRNGKey(6), "action": jax.random.PRNGKey(7)},
+        batch,
+        state,
+    )
+    hp = learner_lib.HParams(batch_size=B, unroll_length=T)
+    optimizer = learner_lib.make_optimizer(hp)
+
+    step_single = learner_lib.make_update_step(
+        model, optimizer, hp, donate=False
+    )
+    p_ref, _, stats_ref = step_single(
+        params, optimizer.init(params), batch, state
+    )
+
+    shardings = transformer_tp_shardings(mesh, params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    sharded = sorted(
+        jax.tree_util.keystr(path)
+        for path, s in flat
+        if not s.is_fully_replicated
+    )
+    expected = sorted(
+        f"['params']['block_0']{suffix}"
+        for suffix in (
+            "['q']['kernel']", "['q']['bias']",
+            "['k']['kernel']", "['k']['bias']",
+            "['v']['kernel']", "['v']['bias']",
+            "['out']['kernel']", "['rel_bias']",
+            "['Dense_0']['kernel']", "['Dense_0']['bias']",
+            "['Dense_1']['kernel']",
+        )
+    )
+    assert sharded == expected, sharded
+
+    step_tp = make_parallel_update_step(
+        model, optimizer, hp, mesh, donate=False,
+        param_shardings=shardings,
+    )
+    params_p = jax.tree_util.tree_map(jax.device_put, params, shardings)
+    opt_p = optimizer.init(params_p)
+    batch_p, state_p = shard_batch(mesh, batch, state)
+    p_tp, _, stats_tp = step_tp(params_p, opt_p, batch_p, state_p)
+
+    np.testing.assert_allclose(
+        float(stats_tp["total_loss"]), float(stats_ref["total_loss"]),
+        rtol=1e-5,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        ),
+        p_tp,
+        p_ref,
+    )
+    # The new params must keep their TP placement (donation-stable).
+    n_sharded_out = sum(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree_util.tree_leaves(p_tp)
+    )
+    assert n_sharded_out == len(expected)
+
+
+def test_transformer_tp_rejects_indivisible_heads():
+    from torchbeast_tpu.parallel import transformer_tp_shardings
+
+    mesh = create_mesh(8, model_parallelism=4)  # 4 does not divide H=2
+    model = create_model(
+        "transformer", num_actions=A, num_layers=1, d_model=16,
+        num_heads=2, memory_len=4,
+    )
+    batch = make_batch(rng_seed=4)
+    params = model.init(
+        {"params": jax.random.PRNGKey(8), "action": jax.random.PRNGKey(9)},
+        batch,
+        model.initial_state(B),
+    )
+    with pytest.raises(ValueError, match="num_heads"):
+        transformer_tp_shardings(mesh, params)

@@ -22,4 +22,5 @@ from torchbeast_tpu.parallel.pp import (  # noqa: F401
 from torchbeast_tpu.parallel.tp import (  # noqa: F401
     dense_kernel_shardings,
     place_params,
+    transformer_tp_shardings,
 )

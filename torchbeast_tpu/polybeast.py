@@ -120,6 +120,16 @@ def make_parser():
                         help="Shard the MoE experts over N devices "
                              "(an `expert` mesh axis; dispatch/combine "
                              "become XLA all-to-alls).")
+    parser.add_argument("--tensor_parallel", type=int, default=0,
+                        help="Megatron column/row-paired tensor "
+                             "parallelism for the transformer over a "
+                             "`model` mesh axis: q/k/v + FFN-up "
+                             "column-sharded, out-proj + FFN-down "
+                             "row-sharded (one all-reduce per "
+                             "attention/FFN). Composes with "
+                             "--num_learner_devices DP on one "
+                             "(data x model) mesh; model=transformer "
+                             "only.")
     parser.add_argument("--num_learner_devices", type=int, default=1,
                         help="Width of the DATA-parallel axis: params "
                              "replicated, batch sharded over it, ICI "
@@ -187,6 +197,12 @@ def train(flags):
                 f"--num_learner_devices {flags.num_learner_devices} must "
                 f"be divisible by the {proc_count} processes"
             )
+        if getattr(flags, "tensor_parallel", 0) > 1:
+            raise ValueError(
+                "--tensor_parallel is single-host for now: the per-host "
+                "local_view used for inference/checkpointing assumes "
+                "replicated params and would see partial kernel shards"
+            )
         if flags.batch_size % proc_count != 0:
             raise ValueError(
                 f"--batch_size {flags.batch_size} (global) must be "
@@ -242,13 +258,31 @@ def train(flags):
     # its collectives stay within a data-parallel replica group.
     expert_par = getattr(flags, "expert_parallel", 0)
     seq_par = flags.sequence_parallel
+    tensor_par = getattr(flags, "tensor_parallel", 0)
+    if tensor_par > 1:
+        if flags.model != "transformer":
+            raise ValueError(
+                "--tensor_parallel needs --model transformer (the "
+                "Megatron pairing targets its projection/FFN layout)"
+            )
+        if expert_par > 1 or seq_par > 1 or (
+            getattr(flags, "pipeline_parallel", 0) > 1
+        ):
+            raise ValueError(
+                "--tensor_parallel composes with --num_learner_devices "
+                "only (TP x SP/EP/PP needs sharding-rule merging that "
+                "is not wired yet)"
+            )
     learner_mesh = None
-    if flags.num_learner_devices > 1:
+    if flags.num_learner_devices > 1 or tensor_par > 1:
         from torchbeast_tpu.parallel import create_mesh
 
-        inner = max(1, expert_par) * max(1, seq_par)
+        inner = (
+            max(1, expert_par) * max(1, seq_par) * max(1, tensor_par)
+        )
         learner_mesh = create_mesh(
             flags.num_learner_devices * inner,
+            model_parallelism=max(1, tensor_par),
             expert_parallelism=max(1, expert_par),
             seq_parallelism=max(1, seq_par),
         )
@@ -300,7 +334,7 @@ def train(flags):
     # an in-flight act dispatch. Requires update dispatch and checkpoint
     # reads of opt_state to be serialized (donation_lock, below).
     mesh = learner_mesh
-    if flags.num_learner_devices > 1:
+    if learner_mesh is not None:
         from torchbeast_tpu.parallel import (
             make_parallel_update_step,
             replicate,
@@ -323,6 +357,12 @@ def train(flags):
             # is donated, and donation needs input placement == output
             # sharding.
             opt_shardings = expert_param_shardings(mesh, opt_state)
+        elif tensor_par > 1:
+            from torchbeast_tpu.parallel import transformer_tp_shardings
+
+            # Same leaf-wise mirroring argument as the EP rule above.
+            param_shardings = transformer_tp_shardings(mesh, params)
+            opt_shardings = transformer_tp_shardings(mesh, opt_state)
         update_step = make_parallel_update_step(
             model, optimizer, hp, mesh, donate="opt_only",
             param_shardings=param_shardings,
@@ -340,8 +380,10 @@ def train(flags):
             )
         shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
         inner_desc = (
-            f" x expert={expert_par}" if expert_par > 1 else ""
-        ) + (f" x seq={seq_par}" if seq_par > 1 else "")
+            (f" x model={tensor_par}" if tensor_par > 1 else "")
+            + (f" x expert={expert_par}" if expert_par > 1 else "")
+            + (f" x seq={seq_par}" if seq_par > 1 else "")
+        )
         log.info(
             "Parallel learner: data=%d%s (%d chips total, %d processes)",
             flags.num_learner_devices, inner_desc,
@@ -447,7 +489,14 @@ def train(flags):
                 act_fn,
                 flags.max_inference_batch_size,
             ),
-            kwargs={"lock": None},
+            # Pipelined dispatch only with a single consumer thread: its
+            # held-reply optimization is unsafe with several threads
+            # draining one batcher (runtime/inference.py docstring);
+            # with >1 threads the overlap comes from the threads.
+            kwargs={
+                "lock": None,
+                "pipelined": flags.num_inference_threads == 1,
+            },
             daemon=True,
             name=f"inference-{i}",
         )

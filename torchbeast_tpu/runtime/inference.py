@@ -71,6 +71,7 @@ def inference_loop(
     max_batch_size: int,
     batch_dim: int = 1,
     lock: threading.Lock = None,
+    pipelined: bool = True,
 ):
     """Thread body (run num_inference_threads of these).
 
@@ -83,10 +84,42 @@ def inference_loop(
     with lock=None calls run concurrently (safe for pure jitted act_fns —
     the device serializes execution anyway).
 
+    `pipelined` keeps a one-deep dispatch pipeline: when more requests
+    are already waiting, batch k's host fetch (`np.asarray`, a full
+    device round-trip — ~50 ms through a remote-TPU tunnel) happens
+    AFTER batch k+1's act is dispatched, so the device always has a
+    queued program and never idles on the reply path. The reply to k is
+    only ever deferred while k+1 is in hand; when the batcher is empty
+    the fetch happens immediately. SINGLE-CONSUMER ONLY: the "more
+    requests waiting" check is a racy global size() — with several
+    threads draining one batcher, another thread can steal the waiting
+    request and leave this one parked on an empty batcher while holding
+    finished replies, stalling those actors until new traffic arrives.
+    Callers with num_inference_threads > 1 must pass pipelined=False
+    (polybeast wires this automatically; cross-thread overlap already
+    comes from the threads themselves).
+
     A failing act_fn fails only its batch (promises broken with the error
     so producers wake immediately); the loop continues serving.
     """
     buckets = default_buckets(max_batch_size)
+
+    def flush(entry):
+        batch, outputs, new_state, n = entry
+        try:
+            outputs = nest.map(np.asarray, outputs)
+            new_state = nest.map(np.asarray, new_state)
+            batch.set_outputs(
+                {
+                    "outputs": slice_to(outputs, n, batch_dim),
+                    "agent_state": slice_to(new_state, n, batch_dim),
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            log.exception("Inference reply failed; continuing")
+            batch.fail(e)
+
+    pending = None
     for batch in inference_batcher:
         try:
             inputs = batch.get_inputs()
@@ -102,14 +135,20 @@ def inference_loop(
                     )
             else:
                 outputs, new_state = act_fn(env_padded, state_padded, padded)
-            outputs = nest.map(np.asarray, outputs)
-            new_state = nest.map(np.asarray, new_state)
-            batch.set_outputs(
-                {
-                    "outputs": slice_to(outputs, n, batch_dim),
-                    "agent_state": slice_to(new_state, n, batch_dim),
-                }
-            )
         except Exception as e:  # noqa: BLE001
             log.exception("Inference batch failed; continuing")
             batch.fail(e)
+            if pending is not None:
+                flush(pending)
+                pending = None
+            continue
+        # This batch is dispatched (async); NOW reply to the previous one.
+        if pending is not None:
+            flush(pending)
+            pending = None
+        if pipelined and inference_batcher.size() > 0:
+            pending = (batch, outputs, new_state, n)
+        else:
+            flush((batch, outputs, new_state, n))
+    if pending is not None:  # batcher closed with a reply in flight
+        flush(pending)
