@@ -21,6 +21,7 @@ from torchbeast_tpu.parallel.pp import (  # noqa: F401
 )
 from torchbeast_tpu.parallel.tp import (  # noqa: F401
     dense_kernel_shardings,
+    merge_param_shardings,
     place_params,
     transformer_tp_shardings,
 )

@@ -120,5 +120,28 @@ def transformer_tp_shardings(
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+def merge_param_shardings(*sharding_trees: Any) -> Any:
+    """Leaf-wise union of sharding rules over ONE mesh: for each leaf, at
+    most one input tree may be non-replicated (rules are expected to
+    target disjoint leaves — e.g. the transformer TP pairing shards the
+    attention/dense-FFN leaves while the EP rule shards the MoE expert
+    kernels); a genuine conflict raises rather than silently picking.
+    """
+
+    def pick(path, *shardings):
+        non_repl = [s for s in shardings if not s.is_fully_replicated]
+        if len({s.spec for s in non_repl}) > 1:
+            raise ValueError(
+                "merge_param_shardings: conflicting non-replicated "
+                f"shardings at {jax.tree_util.keystr(path)}: "
+                f"{[s.spec for s in non_repl]}"
+            )
+        return non_repl[0] if non_repl else shardings[0]
+
+    return jax.tree_util.tree_map_with_path(
+        pick, sharding_trees[0], *sharding_trees[1:]
+    )
+
+
 def place_params(mesh: Mesh, params: Any, shardings: Any) -> Any:
     return jax.tree_util.tree_map(jax.device_put, params, shardings)

@@ -265,13 +265,13 @@ def train(flags):
                 "--tensor_parallel needs --model transformer (the "
                 "Megatron pairing targets its projection/FFN layout)"
             )
-        if expert_par > 1 or seq_par > 1 or (
-            getattr(flags, "pipeline_parallel", 0) > 1
-        ):
+        if seq_par > 1 or getattr(flags, "pipeline_parallel", 0) > 1:
             raise ValueError(
                 "--tensor_parallel composes with --num_learner_devices "
-                "only (TP x SP/EP/PP needs sharding-rule merging that "
-                "is not wired yet)"
+                "and --expert_parallel, not with --sequence_parallel or "
+                "--pipeline_parallel (their shard_maps leave the "
+                "`model` axis unmentioned, which would force gathers of "
+                "the head-sharded projections every layer)"
             )
     learner_mesh = None
     if flags.num_learner_devices > 1 or tensor_par > 1:
@@ -346,23 +346,31 @@ def train(flags):
                 f"batch_size {flags.batch_size} not divisible by "
                 f"num_learner_devices {flags.num_learner_devices}"
             )
-        param_shardings = opt_shardings = None
+        # Param/opt sharding rules: EP shards the MoE expert kernels, TP
+        # the attention/dense-FFN leaves — disjoint sets, merged onto
+        # one tree when both are active. optax state mirrors the params
+        # leaf-wise (same key paths at the leaves), so each rule applies
+        # to it unchanged. Explicit placement is REQUIRED: opt_state is
+        # donated, and donation needs input placement == output sharding.
+        rules = []
         if expert_par > 1:
             from torchbeast_tpu.parallel import expert_param_shardings
 
-            param_shardings = expert_param_shardings(mesh, params)
-            # optax state mirrors the params leaf-wise (same key paths at
-            # the leaves), so the name-based expert rule applies to it
-            # unchanged. Explicit placement is REQUIRED here: opt_state
-            # is donated, and donation needs input placement == output
-            # sharding.
-            opt_shardings = expert_param_shardings(mesh, opt_state)
-        elif tensor_par > 1:
+            rules.append(expert_param_shardings)
+        if tensor_par > 1:
             from torchbeast_tpu.parallel import transformer_tp_shardings
 
-            # Same leaf-wise mirroring argument as the EP rule above.
-            param_shardings = transformer_tp_shardings(mesh, params)
-            opt_shardings = transformer_tp_shardings(mesh, opt_state)
+            rules.append(transformer_tp_shardings)
+        param_shardings = opt_shardings = None
+        if rules:
+            from torchbeast_tpu.parallel import merge_param_shardings
+
+            param_shardings = merge_param_shardings(
+                *(rule(mesh, params) for rule in rules)
+            )
+            opt_shardings = merge_param_shardings(
+                *(rule(mesh, opt_state) for rule in rules)
+            )
         update_step = make_parallel_update_step(
             model, optimizer, hp, mesh, donate="opt_only",
             param_shardings=param_shardings,
