@@ -22,6 +22,7 @@ def main():
     port = sys.argv[2]
     savedir = sys.argv[3]
     total_steps = int(sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -33,20 +34,42 @@ def main():
 
     from torchbeast_tpu import polybeast
 
-    flags = polybeast.make_parser().parse_args([
+    argv = [
         "--env", "Mock",
-        "--xpid", "poly-dist",
+        "--xpid", f"poly-dist-{mode}" if mode != "dp" else "poly-dist",
         "--coordinator_address", f"127.0.0.1:{port}",
         "--num_servers", "2",
-        "--num_learner_devices", "4",
         "--batch_size", "4",       # global; 2 local rows per host
         "--unroll_length", "5",
         "--total_steps", str(total_steps),
-        "--model", "mlp",
         "--savedir", savedir,
         "--pipes_basename", f"unix:{savedir}/pipes",
         "--checkpoint_interval_s", "100000",
-    ])
+    ]
+    if mode == "dp":
+        argv += ["--model", "mlp", "--num_learner_devices", "4"]
+    elif mode == "dp_ep":
+        # Composite (data=2 x expert=2) global mesh ACROSS the two
+        # processes: collective updates carry both the grad all-reduce
+        # and the MoE dispatch/combine all-to-alls over DCN-style gloo.
+        argv += [
+            "--model", "transformer",
+            "--num_learner_devices", "2",
+            "--num_experts", "4",
+            "--expert_parallel", "2",
+        ]
+    elif mode == "dp_tp":
+        # (data=2 x model=2) across the two processes: Megatron-paired
+        # kernels shard over the process-local model axis; local_view
+        # assembles full kernels for inference/checkpointing.
+        argv += [
+            "--model", "transformer",
+            "--num_learner_devices", "2",
+            "--tensor_parallel", "2",
+        ]
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    flags = polybeast.make_parser().parse_args(argv)
     os.environ["TORCHBEAST_NUM_PROCESSES"] = "2"
     os.environ["TORCHBEAST_PROCESS_ID"] = str(proc_id)
 

@@ -215,8 +215,16 @@ def _make_1d_mesh(n: int, axis: str, flag_name: str):
 
 def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                            frame_dtype=np.uint8, moe_mesh=None,
-                           seq_mesh=None):
+                           seq_mesh=None, unmeshed=False,
+                           init_params=True):
     """Build the model + initial params from flags.
+
+    `unmeshed=True` strips every mesh binding from the constructed model
+    (same flags, same param tree — meshes only select compute paths /
+    add sharding constraints, never parameters). The async driver uses
+    this for its ACTING model on multi-host runs, where the learner
+    model's constraints reference global-mesh devices a host-local
+    inference jit cannot touch.
 
     moe_mesh / seq_mesh: optional externally-built meshes with an
     `expert` / `seq` axis — the async driver passes its composite
@@ -423,10 +431,18 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 extra["moe_mesh"] = _make_1d_mesh(
                     expert_par, "expert", "expert_parallel"
                 )
+    if unmeshed:
+        for key in ("mesh", "moe_mesh", "batch_axis"):
+            extra.pop(key, None)
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
         dtype=dtype, **extra,
     )
+    if not init_params:
+        # Caller only wants the model object (e.g. polybeast's unmeshed
+        # acting twin — its param tree is identical to the meshed
+        # model's, so re-initializing would be pure waste).
+        return model, None
     if (
         seq_par
         and seq_par > 1

@@ -197,12 +197,12 @@ def train(flags):
                 f"--num_learner_devices {flags.num_learner_devices} must "
                 f"be divisible by the {proc_count} processes"
             )
-        if getattr(flags, "tensor_parallel", 0) > 1:
-            raise ValueError(
-                "--tensor_parallel is single-host for now: the per-host "
-                "local_view used for inference/checkpointing assumes "
-                "replicated params and would see partial kernel shards"
-            )
+        # --tensor_parallel composes with multi-host DP: the `model`
+        # axis nests inside the cross-host data axis, so local_view
+        # assembles full kernels from this host's shards for inference
+        # and checkpointing (tests/test_distributed.py dp_tp mode), and
+        # TP binds no mesh into the model, so acting needs no unmeshed
+        # twin.
         if flags.batch_size % proc_count != 0:
             raise ValueError(
                 f"--batch_size {flags.batch_size} (global) must be "
@@ -402,23 +402,73 @@ def train(flags):
             model, optimizer, hp, donate="opt_only"
         )
         shard = None
-    act_step = learner_lib.make_act_step(model)
+    act_model = model
+    if proc_count > 1 and (expert_par > 1 or seq_par > 1):
+        # The learner model's MoE constraints / attention shard_maps
+        # reference the GLOBAL mesh; a host-local inference jit cannot
+        # touch non-addressable devices. Acting uses an unmeshed twin —
+        # identical flags and param tree, no mesh bindings (meshes only
+        # select compute paths, never parameters).
+        act_model, _ = _init_model_and_params(
+            flags, num_actions, flags.batch_size, frame_shape,
+            frame_dtype, unmeshed=True, init_params=False,
+        )
+    act_step = learner_lib.make_act_step(act_model)
 
-    def local_view(tree):
-        """Single-device view of a replicated global pytree. Multi-host
+    infer_device = jax.local_devices()[0]
+
+    def local_view(tree, device=None):
+        """Host-local full-value view of a global pytree. Multi-host
         inference and checkpointing must not hand jit/np a global array
-        spanning non-addressable devices — each host acts on its own
-        replica (zero-copy: addressable_data shares the device buffer)."""
+        spanning non-addressable devices, so:
+
+        - replicated leaves: this host's replica, zero-copy
+          (addressable_data shares the device buffer);
+        - leaves sharded over an INNER mesh axis (expert/model — the
+          mesh nests those inside the cross-host data axis, so every
+          shard index is present on this host's local devices): the
+          full value is assembled from addressable shards, no
+          cross-process communication (this must stay collective-free:
+          checkpointing calls it on the lead host only).
+
+        `device`: placement for assembled leaves — the inference rebind
+        passes the local device (one H2D per rebind instead of one per
+        act call); the checkpoint path leaves them on host (the
+        serializer would only copy them straight back).
+        """
         if proc_count == 1:
             return tree
-        return jax.tree_util.tree_map(
-            lambda a: a.addressable_data(0), tree
-        )
+
+        def view(a):
+            if a.sharding.is_fully_replicated:
+                return a.addressable_data(0)
+            out = np.empty(a.shape, a.dtype)
+            covered = 0
+            seen = set()
+            for sh in a.addressable_shards:
+                key = str(sh.index)
+                if key in seen:  # data-axis replicas repeat the index
+                    continue
+                seen.add(key)
+                piece = np.asarray(sh.data)
+                out[sh.index] = piece
+                covered += piece.size
+            if covered != a.size:
+                raise ValueError(
+                    "local_view: leaf sharded ACROSS processes "
+                    f"(host covers {covered}/{a.size} elements); inner "
+                    "parallel axes must nest inside the data axis "
+                    "(parallel/mesh.py) for host-local inference and "
+                    "checkpointing"
+                )
+            return jax.device_put(out, device) if device is not None else out
+
+        return jax.tree_util.tree_map(view, tree)
 
     # Shared mutable state: the learner rebinds these; inference reads them.
     state = {
         "params": params,
-        "infer_params": local_view(params),
+        "infer_params": local_view(params, device=infer_device),
         "opt_state": opt_state,
         "step": step,
         "stats": dict(stats),
@@ -614,9 +664,14 @@ def train(flags):
                 new_params, new_opt, train_stats = update_step(
                     params_now, opt_now, batch, initial_agent_state
                 )
+                # Build the host view OUTSIDE state_lock: for multi-host
+                # sharded params this blocks on the dispatched compute +
+                # D2H/H2D, and holding the lock for that long would stall
+                # every inference thread's params read.
+                infer_view = local_view(new_params, device=infer_device)
                 with state_lock:
                     state["params"], state["opt_state"] = new_params, new_opt
-                    state["infer_params"] = local_view(new_params)
+                    state["infer_params"] = infer_view
                     # Global frames: every host ran this collective update.
                     state["step"] += flags.unroll_length * flags.batch_size
                     now_step = state["step"]
