@@ -67,6 +67,15 @@ def main():
             "--num_learner_devices", "2",
             "--tensor_parallel", "2",
         ]
+    elif mode == "dp_sp":
+        # (data=2 x seq=2) across the two processes: the learner forward
+        # runs ring attention with its shard_map collectives spanning
+        # hosts; acting (T=1) falls back to dense on the unmeshed twin.
+        argv += [
+            "--model", "transformer",
+            "--num_learner_devices", "2",
+            "--sequence_parallel", "2",
+        ]
     else:
         raise ValueError(f"unknown mode {mode!r}")
     flags = polybeast.make_parser().parse_args(argv)

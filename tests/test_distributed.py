@@ -167,3 +167,15 @@ def test_poly_driver_two_hosts_dp_x_tp(tmp_path):
     wq = params["params"]["block_0"]["q"]["kernel"]
     # Full head dim (128 d_model / 4 heads default): not a model-axis shard.
     assert wq.shape[1] == 4
+
+
+def test_poly_driver_two_hosts_dp_x_sp(tmp_path):
+    """DP x SP across 2 jax.distributed processes: the ring-attention
+    shard_map's ppermute spans hosts over the gloo backend while the
+    data axis shards the batch; acting (T=1) uses the unmeshed twin's
+    dense fallback."""
+    total = 240  # unroll 5 -> T+1=6 divides the seq axis of 2
+    outputs = _run_poly_workers(tmp_path, total, mode="dp_sp")
+    for i, out in enumerate(outputs):
+        assert f"worker {i}: final step" in out
+    assert (tmp_path / "poly-dist-dp_sp" / "model.ckpt").exists()

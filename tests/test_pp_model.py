@@ -344,3 +344,35 @@ def test_pipelined_transformer_acting_fallback():
         st_p,
         st_s,
     )
+
+
+def test_pipelined_transformer_remat_matches():
+    """remat=True on the pipelined transformer: same outputs from both
+    the pipelined and the sequential path (the jax.checkpoint wrapper
+    applies to both, keeping the parity oracle exact)."""
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+    kwargs = dict(
+        num_actions=A, num_layers=4, d_model=32, num_heads=2,
+        memory_len=8,
+    )
+    plain = create_model("pipelined_transformer", **kwargs)
+    remat_seq = create_model("pipelined_transformer", remat=True, **kwargs)
+    remat_pipe = create_model(
+        "pipelined_transformer", remat=True, mesh=mesh, **kwargs
+    )
+    batch = _batch(seed=11)
+    state = plain.initial_state(B)
+    params = plain.init(
+        {"params": jax.random.PRNGKey(42), "action": jax.random.PRNGKey(43)},
+        batch,
+        state,
+    )
+    out_plain, _ = plain.apply(params, batch, state, sample_action=False)
+    out_rs, _ = remat_seq.apply(params, batch, state, sample_action=False)
+    out_rp, _ = remat_pipe.apply(params, batch, state, sample_action=False)
+    np.testing.assert_allclose(
+        out_rs.policy_logits, out_plain.policy_logits, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        out_rp.policy_logits, out_plain.policy_logits, rtol=1e-5, atol=1e-5
+    )

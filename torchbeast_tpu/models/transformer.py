@@ -216,6 +216,10 @@ class TransformerNet(nn.Module):
     num_experts: int = 0  # >0 -> MoE FFN in every block
     moe_top_k: int = 2
     moe_mesh: Optional[Any] = None  # mesh with `expert` axis -> EP
+    remat: bool = False  # rematerialize each block's backward (save the
+    # block input only — trades recompute for activation memory, the
+    # lever that fits deep towers / long unrolls in HBM; same policy as
+    # models/resnet.py's per-stage remat)
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -268,7 +272,8 @@ class TransformerNet(nn.Module):
                 & no_done_yet[:, :, None]
             )  # [B, T, M]
             mask = jnp.concatenate([cache_mask, seq_mask], axis=-1)
-            x, k_new, v_new = _Block(
+            block_cls = nn.remat(_Block) if self.remat else _Block
+            x, k_new, v_new = block_cls(
                 d_model=self.d_model, num_heads=self.num_heads,
                 memory_len=M, dtype=self.dtype,
                 mesh=self.mesh, seq_axis=self.seq_axis,

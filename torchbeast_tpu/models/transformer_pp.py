@@ -131,6 +131,10 @@ class PipelinedTransformerNet(nn.Module):
     mesh: Optional[Any] = None  # Mesh with a `pipe` axis -> pipelined
     pipe_axis: str = "pipe"
     n_microbatches: Optional[int] = None
+    remat: bool = False  # jax.checkpoint around each stage invocation
+    # (saves the stage input only — the standard memory lever for deep
+    # towers; applies to both the pipelined and the sequential path so
+    # the parity oracle stays exact)
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -231,6 +235,8 @@ class PipelinedTransformerNet(nn.Module):
         }
 
         stage_fn = _make_stage_fn(band, offsets, M, self.dtype)
+        if self.remat:
+            stage_fn = jax.checkpoint(stage_fn)
         shared = (seg, no_done)
 
         # state tuple (k [M, B, H, hd], ...) -> stage layout [b, M, ...]

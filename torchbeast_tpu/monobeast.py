@@ -124,6 +124,13 @@ def make_parser():
                         help="Ring attention block schedule: zigzag "
                              "balances causal work (~2x fewer busiest-"
                              "device FLOPs; needs T+1 divisible by 2N).")
+    parser.add_argument("--transformer_remat", action="store_true",
+                        help="Rematerialize each transformer block's "
+                             "backward (save block inputs only) — fits "
+                             "deeper towers / longer unrolls in HBM at "
+                             "the cost of recompute (the conv trunk "
+                             "already remats by default, "
+                             "models/resnet.py).")
     parser.add_argument("--overlap_collect", action="store_true",
                         help="Act on params that are one dispatched "
                              "unroll-batch behind the learner head, so "
@@ -431,6 +438,14 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 extra["moe_mesh"] = _make_1d_mesh(
                     expert_par, "expert", "expert_parallel"
                 )
+    if getattr(flags, "transformer_remat", False):
+        if flags.model not in ("transformer", "pipelined_transformer"):
+            raise ValueError(
+                "--transformer_remat applies to the transformer families "
+                "only (the conv trunk already remats by default, "
+                "models/resnet.py `remat`)"
+            )
+        extra["remat"] = True
     if unmeshed:
         for key in ("mesh", "moe_mesh", "batch_axis"):
             extra.pop(key, None)

@@ -120,6 +120,11 @@ def make_parser():
                         help="Shard the MoE experts over N devices "
                              "(an `expert` mesh axis; dispatch/combine "
                              "become XLA all-to-alls).")
+    parser.add_argument("--transformer_remat", action="store_true",
+                        help="Rematerialize each transformer block's "
+                             "backward (save block inputs only) — the "
+                             "HBM-fit lever for deep towers / long "
+                             "unrolls.")
     parser.add_argument("--tensor_parallel", type=int, default=0,
                         help="Megatron column/row-paired tensor "
                              "parallelism for the transformer over a "
