@@ -1,0 +1,111 @@
+"""Convert checkpointed params between the two transformer layouts.
+
+`TransformerNet` keeps one flax scope per block (`block_i/q/kernel`,
+`block_i/Dense_0/...`); `PipelinedTransformerNet` keeps every block
+parameter as one stacked `[L, ...]` leaf (`wq`, `w1`, ...) so the stack
+can shard over a `pipe` mesh axis. The two compute IDENTICAL functions
+(shared attention body + cache roll, ops/attention.py; same LayerNorm
+epsilon and FFN shape), so a converted checkpoint reproduces the same
+policy bit-for-close — letting a run trained sequentially continue
+pipelined across chips, or vice versa, without retraining
+(tests/test_convert.py pins output parity both ways).
+
+Only the model params convert; optimizer state should be re-initialized
+for the new layout (an RMSProp moment tree is params-shaped, so the
+same mapping WOULD apply, but a fresh optimizer after a topology change
+is the predictable default).
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# (sequential per-block path, stacked leaf) pairs; q/k/v/out are scopes
+# with kernel+bias, LayerNorms are scopes with scale+bias.
+_LEAF_MAP = (
+    (("q", "kernel"), "wq"),
+    (("q", "bias"), "bq"),
+    (("k", "kernel"), "wk"),
+    (("k", "bias"), "bk"),
+    (("v", "kernel"), "wv"),
+    (("v", "bias"), "bv"),
+    (("out", "kernel"), "wo"),
+    (("out", "bias"), "bo"),
+    (("rel_bias",), "rel_bias"),
+    (("LayerNorm_0", "scale"), "ln1_scale"),
+    (("LayerNorm_0", "bias"), "ln1_bias"),
+    (("LayerNorm_1", "scale"), "ln2_scale"),
+    (("LayerNorm_1", "bias"), "ln2_bias"),
+    (("Dense_0", "kernel"), "w1"),
+    (("Dense_0", "bias"), "b1"),
+    (("Dense_1", "kernel"), "w2"),
+    (("Dense_1", "bias"), "b2"),
+)
+
+
+def _unwrap(params: Dict) -> Dict:
+    return params["params"] if set(params) == {"params"} else params
+
+
+def _get(tree: Dict, path):
+    for key in path:
+        tree = tree[key]
+    return tree
+
+
+def _set(tree: Dict, path, value):
+    for key in path[:-1]:
+        tree = tree.setdefault(key, {})
+    tree[path[-1]] = value
+
+
+def transformer_to_pipelined(params: Any) -> Dict:
+    """TransformerNet param tree -> PipelinedTransformerNet param tree."""
+    p = _unwrap(params)
+    blocks = sorted(
+        (k for k in p if k.startswith("block_")),
+        key=lambda k: int(k.split("_")[1]),
+    )
+    if not blocks:
+        raise ValueError("no block_* scopes — not a TransformerNet tree")
+    if any("moe" in p[b] for b in blocks):
+        raise ValueError(
+            "MoE blocks cannot convert: PipelinedTransformerNet has no "
+            "MoE formulation (its FFN is dense by design)"
+        )
+    out: Dict = {}
+    for path, stacked in _LEAF_MAP:
+        out[stacked] = jnp.stack(
+            [_get(p[b], path) for b in blocks], axis=0
+        )
+    out["encoder"] = p["Dense_0"]  # frame encoder
+    out["extras"] = p["extras"]
+    out["final_scale"] = p["LayerNorm_0"]["scale"]
+    out["final_bias"] = p["LayerNorm_0"]["bias"]
+    out["head"] = p["head"]
+    return {"params": out}
+
+
+def pipelined_to_transformer(params: Any) -> Dict:
+    """PipelinedTransformerNet param tree -> TransformerNet param tree."""
+    p = _unwrap(params)
+    if "wq" not in p:
+        raise ValueError(
+            "no stacked `wq` leaf — not a PipelinedTransformerNet tree"
+        )
+    num_layers = p["wq"].shape[0]
+    out: Dict = {}
+    for layer in range(num_layers):
+        block: Dict = {}
+        for path, stacked in _LEAF_MAP:
+            _set(block, path, p[stacked][layer])
+        out[f"block_{layer}"] = block
+    out["Dense_0"] = p["encoder"]
+    out["extras"] = p["extras"]
+    out["LayerNorm_0"] = {
+        "scale": p["final_scale"],
+        "bias": p["final_bias"],
+    }
+    out["head"] = p["head"]
+    return {"params": out}
