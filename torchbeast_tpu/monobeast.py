@@ -124,6 +124,13 @@ def make_parser():
                         help="Ring attention block schedule: zigzag "
                              "balances causal work (~2x fewer busiest-"
                              "device FLOPs; needs T+1 divisible by 2N).")
+    parser.add_argument("--num_learner_devices", type=int, default=1,
+                        help="Data-parallel learner over N local chips: "
+                             "params replicated, each learner batch "
+                             "sharded over a `data` mesh axis with an "
+                             "ICI grad all-reduce (batch_size divisible "
+                             "by N). Composing DP with SP/EP/TP/PP "
+                             "lives in the async driver (polybeast).")
     parser.add_argument("--transformer_remat", action="store_true",
                         help="Rematerialize each transformer block's "
                              "backward (save block inputs only) — fits "
@@ -533,10 +540,44 @@ def train(flags):
     # Zero-lag mode donates params (nothing references the old buffer
     # once the cell is swapped); overlap mode acts on the old params for
     # a whole unroll, so only the opt state may be donated.
-    update_step = learner_lib.make_update_step(
-        model, optimizer, hp,
-        donate="opt_only" if flags.overlap_collect else True,
-    )
+    donate = "opt_only" if flags.overlap_collect else True
+    n_dev = getattr(flags, "num_learner_devices", 1)
+    if n_dev > 1:
+        if any(
+            (getattr(flags, f, 0) or 0) > 1
+            for f in ("sequence_parallel", "expert_parallel",
+                      "pipeline_parallel")
+        ):
+            raise ValueError(
+                "--num_learner_devices in the sync trainer is plain DP; "
+                "composing DP with SP/EP/PP needs the async driver's "
+                "composite meshes (polybeast)"
+            )
+        if flags.batch_size % n_dev != 0:
+            raise ValueError(
+                f"batch_size {flags.batch_size} not divisible by "
+                f"num_learner_devices {n_dev}"
+            )
+        from torchbeast_tpu.parallel import (
+            create_mesh,
+            make_parallel_update_step,
+            replicate,
+            shard_batch,
+        )
+
+        mesh = create_mesh(n_dev)
+        params = replicate(mesh, params)
+        opt_state = replicate(mesh, opt_state)
+        update_step = make_parallel_update_step(
+            model, optimizer, hp, mesh, donate=donate
+        )
+        place_sub = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
+        log.info("Sync learner data-parallel over %d devices", n_dev)
+    else:
+        update_step = learner_lib.make_update_step(
+            model, optimizer, hp, donate=donate
+        )
+        place_sub = lambda b, s: (b, s)  # noqa: E731
     act_step = learner_lib.make_act_step(model)
 
     pool = _make_pool(flags, B)
@@ -625,6 +666,7 @@ def train(flags):
                 sub_state = jax.tree_util.tree_map(
                     lambda s: s[:, i : i + flags.batch_size], initial_agent_state
                 )
+                sub, sub_state = place_sub(sub, sub_state)
                 latest_params, opt_state, train_stats = update_step(
                     latest_params, opt_state, sub, sub_state
                 )
