@@ -96,7 +96,12 @@ def main():
             threading.Thread(
                 target=inference_loop,
                 args=(batcher, act_fn, args.max_batch_size),
-                kwargs={"lock": lock},
+                # Pipelined dispatch is single-consumer-only (see
+                # runtime/inference.py); mirror polybeast's wiring.
+                kwargs={
+                    "lock": lock,
+                    "pipelined": args.num_inference_threads == 1,
+                },
                 daemon=True,
             )
             for _ in range(args.num_inference_threads)

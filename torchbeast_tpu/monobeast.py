@@ -504,6 +504,25 @@ def train(flags):
             "num_actors must be a multiple of batch_size in the sync trainer "
             f"(got {flags.num_actors} vs {flags.batch_size})"
         )
+    n_dev = getattr(flags, "num_learner_devices", 1)
+    if n_dev > 1:
+        # Pure flag predicates — reject BEFORE any side effects
+        # (FileWriter dir, env probe, model init).
+        if any(
+            (getattr(flags, f, 0) or 0) > 1
+            for f in ("sequence_parallel", "expert_parallel",
+                      "pipeline_parallel")
+        ):
+            raise ValueError(
+                "--num_learner_devices in the sync trainer is plain DP; "
+                "composing DP with SP/EP/PP needs the async driver's "
+                "composite meshes (polybeast)"
+            )
+        if flags.batch_size % n_dev != 0:
+            raise ValueError(
+                f"batch_size {flags.batch_size} not divisible by "
+                f"num_learner_devices {n_dev}"
+            )
     if flags.xpid is None:
         flags.xpid = "torchbeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
     plogger = FileWriter(
@@ -543,21 +562,6 @@ def train(flags):
     donate = "opt_only" if flags.overlap_collect else True
     n_dev = getattr(flags, "num_learner_devices", 1)
     if n_dev > 1:
-        if any(
-            (getattr(flags, f, 0) or 0) > 1
-            for f in ("sequence_parallel", "expert_parallel",
-                      "pipeline_parallel")
-        ):
-            raise ValueError(
-                "--num_learner_devices in the sync trainer is plain DP; "
-                "composing DP with SP/EP/PP needs the async driver's "
-                "composite meshes (polybeast)"
-            )
-        if flags.batch_size % n_dev != 0:
-            raise ValueError(
-                f"batch_size {flags.batch_size} not divisible by "
-                f"num_learner_devices {n_dev}"
-            )
         from torchbeast_tpu.parallel import (
             create_mesh,
             make_parallel_update_step,

@@ -71,7 +71,7 @@ def inference_loop(
     max_batch_size: int,
     batch_dim: int = 1,
     lock: threading.Lock = None,
-    pipelined: bool = True,
+    pipelined: bool = False,
 ):
     """Thread body (run num_inference_threads of these).
 
@@ -95,9 +95,9 @@ def inference_loop(
     threads draining one batcher, another thread can steal the waiting
     request and leave this one parked on an empty batcher while holding
     finished replies, stalling those actors until new traffic arrives.
-    Callers with num_inference_threads > 1 must pass pipelined=False
-    (polybeast wires this automatically; cross-thread overlap already
-    comes from the threads themselves).
+    Default OFF: only enable it for a single consumer thread
+    (polybeast wires pipelined=num_inference_threads==1; cross-thread
+    overlap already comes from the threads themselves).
 
     A failing act_fn fails only its batch (promises broken with the error
     so producers wake immediately); the loop continues serving.
