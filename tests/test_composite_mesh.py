@@ -330,3 +330,73 @@ def test_merge_param_shardings_conflict_raises():
     b = {"w": NamedSharding(mesh, P("data"))}
     with pytest.raises(ValueError, match="conflicting"):
         merge_param_shardings(a, b)
+
+
+def test_dp_x_pp_update_matches_single_device():
+    """(data=2 x pipe=4) mesh: each data group runs its own GPipe while
+    gradients all-reduce over `data` — the full update must match the
+    single-device sequential tower for BOTH pipelined families."""
+    mesh = create_mesh(8, pipe_parallelism=4)
+    assert mesh.shape == {"data": 2, "model": 1, "pipe": 4}
+    for family, kwargs, state_fn in (
+        (
+            "pipelined_mlp",
+            dict(num_actions=A, num_stages=4, d_model=32),
+            lambda m: (),
+        ),
+        (
+            "pipelined_transformer",
+            dict(
+                num_actions=A, num_layers=4, d_model=32, num_heads=2,
+                memory_len=8,
+            ),
+            lambda m: m.initial_state(B),
+        ),
+    ):
+        single = create_model(family, **kwargs)
+        comp = create_model(
+            family, mesh=mesh, batch_axis="data", **kwargs
+        )
+        batch = _batch(seed=7)
+        state = state_fn(single)
+        params = single.init(
+            {
+                "params": jax.random.PRNGKey(8),
+                "action": jax.random.PRNGKey(9),
+            },
+            batch,
+            state,
+        )
+        hp = learner_lib.HParams(batch_size=B, unroll_length=T)
+        optimizer = learner_lib.make_optimizer(hp)
+        step_single = learner_lib.make_update_step(
+            single, optimizer, hp, donate=False
+        )
+        p_ref, _, stats_ref = step_single(
+            params, optimizer.init(params), batch, state
+        )
+        step_comp = make_parallel_update_step(
+            comp, optimizer, hp, mesh, donate=False
+        )
+        batch_p, state_p = shard_batch(mesh, batch, state)
+        params_p = jax.device_put(
+            params,
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        )
+        p_comp, _, stats_comp = step_comp(
+            params_p, optimizer.init(params_p), batch_p, state_p
+        )
+        np.testing.assert_allclose(
+            float(stats_comp["total_loss"]),
+            float(stats_ref["total_loss"]),
+            rtol=1e-5,
+            err_msg=family,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                err_msg=family,
+            ),
+            p_comp,
+            p_ref,
+        )

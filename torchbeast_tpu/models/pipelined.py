@@ -20,10 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from torchbeast_tpu.models.cores import RecurrentPolicyHead, lstm_initial_state
-from torchbeast_tpu.parallel.pp import (
-    default_n_microbatches,
-    pipeline_apply_multi,
-)
+from torchbeast_tpu.parallel.pp import can_pipeline, pipeline_apply_multi
 
 
 def _layer_norm(x, scale, bias, eps=1e-6):
@@ -60,6 +57,8 @@ class PipelinedMLPNet(nn.Module):
     mesh: Optional[Any] = None  # Mesh with a `pipe` axis -> pipelined
     pipe_axis: str = "pipe"
     n_microbatches: Optional[int] = None
+    batch_axis: Optional[str] = None  # composite (data x pipe) mesh: the
+    # axis each microbatch's rows shard over (one GPipe per data group)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -108,9 +107,10 @@ class PipelinedMLPNet(nn.Module):
         # only ever pays off on the big learner batches, and the drivers
         # validate learner-batch divisibility up front so training can
         # never land here silently (monobeast.py).
-        if self.mesh is not None and (T * B) % default_n_microbatches(
-            self.mesh, self.pipe_axis, self.n_microbatches
-        ) == 0:
+        if self.mesh is not None and can_pipeline(
+            self.mesh, T * B, self.pipe_axis, self.n_microbatches,
+            self.batch_axis,
+        ):
             x, _ = pipeline_apply_multi(
                 _stage_fn,
                 stage_params,
@@ -118,6 +118,7 @@ class PipelinedMLPNet(nn.Module):
                 mesh=self.mesh,
                 axis=self.pipe_axis,
                 n_microbatches=self.n_microbatches,
+                batch_axis=self.batch_axis,
             )
         else:
             for s in range(S):

@@ -40,10 +40,7 @@ from torchbeast_tpu.ops.attention import (
     roll_kv_cache,
     segment_ids_from_done,
 )
-from torchbeast_tpu.parallel.pp import (
-    default_n_microbatches,
-    pipeline_apply_multi,
-)
+from torchbeast_tpu.parallel.pp import can_pipeline, pipeline_apply_multi
 
 
 def _layer_norm(x, scale, bias, eps=1e-6):
@@ -131,6 +128,8 @@ class PipelinedTransformerNet(nn.Module):
     mesh: Optional[Any] = None  # Mesh with a `pipe` axis -> pipelined
     pipe_axis: str = "pipe"
     n_microbatches: Optional[int] = None
+    batch_axis: Optional[str] = None  # composite (data x pipe) mesh: the
+    # axis each microbatch's rows shard over (one GPipe per data group)
     remat: bool = False  # jax.checkpoint around each stage invocation
     # (saves the stage input only — the standard memory lever for deep
     # towers; applies to both the pipelined and the sequential path so
@@ -254,9 +253,10 @@ class PipelinedTransformerNet(nn.Module):
         # (pipelining only pays off on the big learner batches, and the
         # drivers validate learner-batch divisibility up front so
         # training can never land here silently, monobeast.py).
-        if self.mesh is not None and B % default_n_microbatches(
-            self.mesh, self.pipe_axis, self.n_microbatches
-        ) == 0:
+        if self.mesh is not None and can_pipeline(
+            self.mesh, B, self.pipe_axis, self.n_microbatches,
+            self.batch_axis,
+        ):
             stage_carry = jax.tree_util.tree_map(
                 lambda *leaves: jnp.stack(leaves, axis=0), *caches_b
             )
@@ -269,6 +269,7 @@ class PipelinedTransformerNet(nn.Module):
                 n_microbatches=self.n_microbatches,
                 stage_carry=stage_carry,
                 shared=shared,
+                batch_axis=self.batch_axis,
             )
             new_caches_b = [
                 jax.tree_util.tree_map(lambda leaf: leaf[layer], new_carry)

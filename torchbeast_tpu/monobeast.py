@@ -229,7 +229,7 @@ def _make_1d_mesh(n: int, axis: str, flag_name: str):
 
 def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                            frame_dtype=np.uint8, moe_mesh=None,
-                           seq_mesh=None, unmeshed=False,
+                           seq_mesh=None, pipe_mesh=None, unmeshed=False,
                            init_params=True):
     """Build the model + initial params from flags.
 
@@ -370,7 +370,16 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 "pipelined_transformer (the other families have no "
                 "stage-uniform tower to pipeline)"
             )
-        extra["mesh"] = _make_1d_mesh(pipe_par, "pipe", "pipeline_parallel")
+        if pipe_mesh is not None:
+            # Composite (data x pipe) mesh from the async driver: each
+            # data group runs its own GPipe; microbatch rows shard over
+            # `data` (parallel/pp.py batch_axis).
+            extra["mesh"] = pipe_mesh
+            extra["batch_axis"] = "data"
+        else:
+            extra["mesh"] = _make_1d_mesh(
+                pipe_par, "pipe", "pipeline_parallel"
+            )
         # Stage-count default differs by family: the MLP tower's depth is
         # a pipeline artifact (one stage per device, as documented); the
         # transformer's depth is an ARCHITECTURE choice, so it defaults
@@ -394,20 +403,24 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         # per pipe device) or every training forward would silently take
         # the models' sequential fallback — the opposite of what the
         # flag asks for. (Acting/eval batches fall back by design.)
-        from torchbeast_tpu.parallel.pp import default_n_microbatches
+        from torchbeast_tpu.parallel.pp import can_pipeline
 
-        n_micro = default_n_microbatches(extra["mesh"], "pipe")
         if flags.model == "pipelined_transformer":
             pipelined_quantity, what = flags.batch_size, "batch_size"
         else:  # pipelined_mlp microbatches over flattened T*B tokens
             pipelined_quantity = (flags.unroll_length + 1) * flags.batch_size
             what = "(unroll_length+1)*batch_size"
-        if pipelined_quantity % n_micro != 0:
+        if not can_pipeline(
+            extra["mesh"], pipelined_quantity,
+            batch_axis=extra.get("batch_axis"),
+        ):
             raise ValueError(
                 f"--pipeline_parallel {pipe_par} requires {what} "
                 f"(= {pipelined_quantity}) divisible by the microbatch "
-                "count (one per pipeline device) — otherwise the learner "
-                "step would silently run the sequential fallback"
+                "count (one per pipeline device), and each microbatch's "
+                "rows by the data axis when composing with DP — "
+                "otherwise the learner step would silently run the "
+                "sequential fallback"
             )
     elif flags.model in pipelined_models:
         # No mesh, but the requested tower depth still applies — a

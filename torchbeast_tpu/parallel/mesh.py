@@ -29,6 +29,7 @@ def create_mesh(
     devices: Optional[Sequence] = None,
     expert_parallelism: int = 1,
     seq_parallelism: int = 1,
+    pipe_parallelism: int = 1,
 ) -> Mesh:
     """(data[, model][, seq][, expert]) mesh over the first n devices.
 
@@ -57,14 +58,31 @@ def create_mesh(
                 f"{len(devices)} devices are visible"
             )
         devices = devices[:n_devices]
+    if pipe_parallelism > 1 and (
+        expert_parallelism > 1 or seq_parallelism > 1
+    ):
+        raise ValueError(
+            "pipe_parallelism does not combine with expert/seq axes "
+            "(the GPipe shard_map owns its schedule; only a data axis "
+            "composes with it)"
+        )
     n = len(devices)
-    inner = model_parallelism * expert_parallelism * seq_parallelism
+    inner = (
+        model_parallelism * expert_parallelism * seq_parallelism
+        * pipe_parallelism
+    )
     if n % inner != 0:
         raise ValueError(
             f"{n} devices not divisible by model_parallelism="
             f"{model_parallelism} x expert_parallelism="
             f"{expert_parallelism} x seq_parallelism={seq_parallelism}"
+            f" x pipe_parallelism={pipe_parallelism}"
         )
+    if pipe_parallelism > 1:
+        grid = np.asarray(devices).reshape(
+            n // inner, model_parallelism, pipe_parallelism
+        )
+        return Mesh(grid, ("data", "model", "pipe"))
     if expert_parallelism > 1 and seq_parallelism > 1:
         grid = np.asarray(devices).reshape(
             n // inner, model_parallelism, seq_parallelism,

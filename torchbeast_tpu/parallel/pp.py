@@ -45,6 +45,28 @@ def default_n_microbatches(
     )
 
 
+def can_pipeline(
+    mesh: Mesh,
+    batch_rows: int,
+    axis: str = "pipe",
+    n_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = None,
+) -> bool:
+    """Whether pipeline_apply accepts `batch_rows` — rows must divide
+    into microbatches AND (on a composite mesh) each microbatch's rows
+    must divide over `batch_axis`. The single gate the models' silent
+    sequential fallback and the drivers' up-front validation both use,
+    so they can never disagree with pipeline_apply's own checks."""
+    M = default_n_microbatches(mesh, axis, n_microbatches)
+    if batch_rows % M != 0:
+        return False
+    if batch_axis is not None and (
+        (batch_rows // M) % mesh.shape[batch_axis] != 0
+    ):
+        return False
+    return True
+
+
 def stack_stages(per_stage_trees):
     """Stack a list of per-stage pytrees along a new leading stage axis
     (the layout pipeline_apply expects for `stage_params`)."""
@@ -70,6 +92,7 @@ def pipeline_apply(
     n_microbatches: Optional[int] = None,
     stage_carry: Any = None,
     shared: Any = None,
+    batch_axis: Optional[str] = None,
 ):
     """Run a uniform tower of S stages as a pipeline over `axis`.
 
@@ -87,6 +110,12 @@ def pipeline_apply(
         its stage; never rotates.
       shared: optional pytree, leaves `[B, ...]` — inputs every stage
         reads for the microbatch it is processing (masks, segment ids).
+      batch_axis: optional name of a DATA axis on the same mesh — each
+        microbatch additionally shards its rows over it, so a
+        (data x pipe) mesh runs an independent GPipe per data group
+        (the cross-group gradient all-reduce comes from the params
+        being replicated over `batch_axis`, inserted by XLA as usual).
+        Requires B/M divisible by the axis size.
 
     Returns:
       `(y, new_stage_carry)`: y `[B, ...]` from the last stage (replicated
@@ -97,6 +126,11 @@ def pipeline_apply(
     M = default_n_microbatches(mesh, axis, n_microbatches)
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+    if batch_axis is not None and (B // M) % mesh.shape[batch_axis] != 0:
+        raise ValueError(
+            f"microbatch rows {B // M} not divisible by the "
+            f"`{batch_axis}` axis size {mesh.shape[batch_axis]}"
+        )
     for tree, what in ((stage_params, "stage_params"),
                        (stage_carry, "stage_carry")):
         for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
@@ -124,16 +158,22 @@ def pipeline_apply(
         lambda leaf: leaf.reshape((S, M, mb) + leaf.shape[2:]), stage_carry
     )
 
+    # Microbatch rows shard over batch_axis (if any): [M, mb, ...] ->
+    # P(None, batch_axis); the resident carry keeps its stage axis too.
+    mb_spec = P(None, batch_axis) if batch_axis else P()
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    cspec = jax.tree_util.tree_map(lambda _: P(axis), carry_mb)
-    rspec = jax.tree_util.tree_map(lambda _: P(), (xs, shared_mb))
+    cspec = jax.tree_util.tree_map(
+        lambda _: P(axis, None, batch_axis) if batch_axis else P(axis),
+        carry_mb,
+    )
+    rspec = jax.tree_util.tree_map(lambda _: mb_spec, (xs, shared_mb))
     ring = [(i, (i + 1) % S) for i in range(S)]
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(pspec, cspec, rspec[0], rspec[1]),
-        out_specs=(P(), cspec),
+        out_specs=(mb_spec, cspec),
         check_vma=False,
     )
     def run(params, carry, xs, shared_mb):
@@ -202,6 +242,7 @@ def pipeline_apply_multi(
     n_microbatches: Optional[int] = None,
     stage_carry: Any = None,
     shared: Any = None,
+    batch_axis: Optional[str] = None,
 ):
     """Pipeline S = k*P stages over P devices as k sequential passes of
     the P-stage GPipe schedule (a looped pipeline: device d runs global
@@ -219,7 +260,7 @@ def pipeline_apply_multi(
         return pipeline_apply(
             stage_fn, stage_params, x, mesh=mesh, axis=axis,
             n_microbatches=n_microbatches, stage_carry=stage_carry,
-            shared=shared,
+            shared=shared, batch_axis=batch_axis,
         )
     if S_total % P_devices != 0:
         raise ValueError(
@@ -244,7 +285,7 @@ def pipeline_apply_multi(
         x, new_c = pipeline_apply(
             stage_fn, pass_slice(stage_params, j), x, mesh=mesh,
             axis=axis, n_microbatches=n_microbatches,
-            stage_carry=carry_j, shared=shared,
+            stage_carry=carry_j, shared=shared, batch_axis=batch_axis,
         )
         new_carries.append(new_c)
     if stage_carry is None:

@@ -213,16 +213,6 @@ def train(flags):
                 f"--batch_size {flags.batch_size} (global) must be "
                 f"divisible by the {proc_count} processes"
             )
-    if flags.num_learner_devices > 1 and (
-        getattr(flags, "pipeline_parallel", 0) > 1
-    ):
-        raise ValueError(
-            "--pipeline_parallel and --num_learner_devices are mutually "
-            "exclusive: the GPipe shard_map mesh would conflict with the "
-            "data-parallel mesh. (--expert_parallel and "
-            "--sequence_parallel DO compose with DP on one composite "
-            "mesh.)"
-        )
     local_rows = flags.batch_size // proc_count
     if flags.xpid is None:
         flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
@@ -278,24 +268,32 @@ def train(flags):
                 "`model` axis unmentioned, which would force gathers of "
                 "the head-sharded projections every layer)"
             )
+    pipe_par = getattr(flags, "pipeline_parallel", 0)
     learner_mesh = None
     if flags.num_learner_devices > 1 or tensor_par > 1:
         from torchbeast_tpu.parallel import create_mesh
 
         inner = (
             max(1, expert_par) * max(1, seq_par) * max(1, tensor_par)
+            * max(1, pipe_par)
         )
         learner_mesh = create_mesh(
             flags.num_learner_devices * inner,
             model_parallelism=max(1, tensor_par),
             expert_parallelism=max(1, expert_par),
             seq_parallelism=max(1, seq_par),
+            pipe_parallelism=max(1, pipe_par),
         )
 
     model, params = _init_model_and_params(
         flags, num_actions, flags.batch_size, frame_shape, frame_dtype,
         moe_mesh=learner_mesh if expert_par > 1 else None,
         seq_mesh=learner_mesh if seq_par > 1 else None,
+        pipe_mesh=(
+            learner_mesh
+            if pipe_par > 1 and learner_mesh is not None
+            else None
+        ),
     )
     optimizer = learner_lib.make_optimizer(hp)
     opt_state = optimizer.init(params)
@@ -408,7 +406,9 @@ def train(flags):
         )
         shard = None
     act_model = model
-    if proc_count > 1 and (expert_par > 1 or seq_par > 1):
+    if proc_count > 1 and (
+        expert_par > 1 or seq_par > 1 or pipe_par > 1
+    ):
         # The learner model's MoE constraints / attention shard_maps
         # reference the GLOBAL mesh; a host-local inference jit cannot
         # touch non-addressable devices. Acting uses an unmeshed twin —
