@@ -98,3 +98,65 @@ def test_moe_blocks_refuse_conversion():
     params = _init(model, seed=30)
     with pytest.raises(ValueError, match="MoE"):
         transformer_to_pipelined(params)
+
+
+def test_checkpoint_cli_roundtrip_through_driver(tmp_path):
+    """Full workflow: train the pipelined transformer in the sync driver,
+    convert the CHECKPOINT FILE (params + optimizer moments + recorded
+    model flag) to the sequential layout, then (a) evaluate it and
+    (b) resume TRAINING it as a TransformerNet — proving the optimizer
+    state mapped, not just the params."""
+    from torchbeast_tpu import monobeast
+    from torchbeast_tpu.utils.convert import convert_checkpoint
+
+    def flags_for(model, xpid, total_steps, **over):
+        argv = [
+            "--env", "Mock", "--model", model, "--xpid", xpid,
+            "--num_actors", "2", "--batch_size", "2",
+            "--unroll_length", "5", "--total_steps", str(total_steps),
+            "--savedir", str(tmp_path), "--serial_envs",
+            "--checkpoint_interval_s", "100000",
+        ]
+        for k, v in over.items():
+            argv += [f"--{k}", str(v)]
+        return monobeast.make_parser().parse_args(argv)
+
+    # TransformerNet's default depth is 2 — build the pipelined tower to
+    # match so the flag-constructed eval model lines up.
+    stats = monobeast.train(
+        flags_for(
+            "pipelined_transformer", "src", 40, pipeline_stages=2
+        )
+    )
+    assert stats["step"] >= 40
+
+    src = tmp_path / "src" / "model.ckpt"
+    dst = tmp_path / "dst" / "model.ckpt"
+    # Drive the real CLI entry point, not just the library function.
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "torchbeast_tpu.utils.convert",
+         "--input", str(src), "--output", str(dst),
+         "--to", "sequential"],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    # Wrong direction / wrong checkpoint refuses loudly, writes nothing.
+    with pytest.raises(ValueError, match="nothing was written"):
+        convert_checkpoint(str(src), str(tmp_path / "x.ckpt"),
+                           to="pipelined")
+    assert not (tmp_path / "x.ckpt").exists()
+
+    returns = monobeast.test(
+        flags_for("transformer", "dst", 40, mode="test",
+                  num_test_episodes="2")
+    )
+    assert len(returns) == 2
+
+    # Resume TRAINING under the sequential layout from the converted
+    # checkpoint (loads converted opt_state onto the optax template).
+    stats2 = monobeast.train(flags_for("transformer", "dst", 80))
+    assert stats2["step"] >= 80

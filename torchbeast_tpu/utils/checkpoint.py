@@ -22,6 +22,16 @@ import flax.serialization
 log = logging.getLogger(__name__)
 
 
+def atomic_write(path: str, data: bytes) -> None:
+    """tmp + rename so a crash mid-write never leaves a torn file (the
+    one write-path implementation; convert.py reuses it)."""
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
 def save_checkpoint(
     path: str,
     *,
@@ -42,11 +52,7 @@ def save_checkpoint(
             k: flax.serialization.to_bytes(v) for k, v in (extra or {}).items()
         },
     }
-    tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(tmp, "wb") as f:
-        f.write(flax.serialization.msgpack_serialize(payload))
-    os.replace(tmp, path)
+    atomic_write(path, flax.serialization.msgpack_serialize(payload))
     log.info("Saved checkpoint to %s (step %d)", path, step)
 
 

@@ -10,10 +10,11 @@ policy bit-for-close — letting a run trained sequentially continue
 pipelined across chips, or vice versa, without retraining
 (tests/test_convert.py pins output parity both ways).
 
-Only the model params convert; optimizer state should be re-initialized
-for the new layout (an RMSProp moment tree is params-shaped, so the
-same mapping WOULD apply, but a fresh optimizer after a topology change
-is the predictable default).
+The CLI (`python -m torchbeast_tpu.utils.convert`) converts a whole
+checkpoint file: model params AND every params-shaped subtree inside
+the optimizer state (optax moment trees mirror the params leaf-wise, so
+the identical mapping applies — RMSProp `nu` keeps its per-parameter
+history through the layout change).
 """
 
 from typing import Any, Dict
@@ -109,3 +110,98 @@ def pipelined_to_transformer(params: Any) -> Dict:
     }
     out["head"] = p["head"]
     return {"params": out}
+
+
+def _is_sequential_tree(d: Dict) -> bool:
+    return "block_0" in d and "extras" in d
+
+
+def _is_pipelined_tree(d: Dict) -> bool:
+    return "wq" in d and "encoder" in d
+
+
+def convert_subtrees(tree: Any, to: str) -> Any:
+    """Recursively convert every params-shaped subtree (bare, i.e. the
+    content of a 'params' collection) found anywhere in `tree` — the
+    shape optimizer states carry the param mirror in. Returns
+    (converted_tree, n_converted)."""
+    if to == "pipelined":
+        detect, fn = _is_sequential_tree, transformer_to_pipelined
+    elif to == "sequential":
+        detect, fn = _is_pipelined_tree, pipelined_to_transformer
+    else:
+        raise ValueError(f"unknown target layout {to!r}")
+    count = [0]
+
+    def walk(node):
+        if isinstance(node, dict):
+            if detect(node):
+                count[0] += 1
+                return fn(node)["params"]
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(tree), count[0]
+
+
+def convert_checkpoint(in_path: str, out_path: str, to: str) -> None:
+    """Convert a saved checkpoint (utils/checkpoint.py format) between
+    the transformer layouts, including the optimizer moment trees and
+    the recorded model flag."""
+    import flax.serialization
+
+    from torchbeast_tpu.utils.checkpoint import atomic_write
+
+    with open(in_path, "rb") as f:
+        raw = f.read()
+    if raw[:1] == b"\x80":  # legacy pickle (same guard as load_checkpoint)
+        raise ValueError(
+            f"{in_path} is a legacy pickle-format checkpoint; re-save "
+            "with the current version before converting"
+        )
+    payload = flax.serialization.msgpack_restore(raw)
+    n_params_converted = 0
+    for key in ("params", "opt_state"):
+        tree = flax.serialization.msgpack_restore(payload[key])
+        tree, n = convert_subtrees(tree, to)
+        if key == "params":
+            n_params_converted = n
+        payload[key] = flax.serialization.to_bytes(tree)
+    # `extra` holds driver-specific serialized pytrees; convert any
+    # params-shaped state inside them too (e.g. EMA/target params).
+    for k, blob in (payload.get("extra") or {}).items():
+        tree, _ = convert_subtrees(
+            flax.serialization.msgpack_restore(blob), to
+        )
+        payload["extra"][k] = flax.serialization.to_bytes(tree)
+    if n_params_converted == 0:
+        raise ValueError(
+            f"{in_path}: no {('sequential', 'pipelined')[to == 'sequential']}"
+            "-layout transformer tree found in `params` — wrong "
+            "checkpoint or wrong --to direction; nothing was written"
+        )
+    if payload.get("flags", {}).get("model"):
+        payload["flags"]["model"] = (
+            "pipelined_transformer" if to == "pipelined" else "transformer"
+        )
+    atomic_write(out_path, flax.serialization.msgpack_serialize(payload))
+
+
+def _cli():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Convert a checkpoint between the sequential and "
+        "pipelined transformer layouts."
+    )
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--to", required=True,
+                    choices=["pipelined", "sequential"])
+    args = ap.parse_args()
+    convert_checkpoint(args.input, args.output, args.to)
+    print(f"converted {args.input} -> {args.output} ({args.to})")
+
+
+if __name__ == "__main__":
+    _cli()
