@@ -18,6 +18,17 @@ XLA sees static shapes and a fixed collective ring.
 
 Constraints (asserted): stage output shape == stage input shape (uniform
 tower), batch divisible by the microbatch count, and a 1-D stage axis.
+
+Why GPipe-in-scan and not 1F1B: autodiff through the scan already runs
+the schedule in REVERSE for the backward — stage s's grads compute at
+mirrored ticks, pipelined over the same ring — so the bubble fraction of
+the combined fwd+bwd matches non-interleaved 1F1B at equal M
+((S-1)/(S+M-1) per direction; raise n_microbatches to amortize). 1F1B's
+remaining advantage is peak activation memory, and that lever exists
+here as per-stage rematerialization (jax.checkpoint around stage_fn —
+models/transformer_pp.py `remat`), which bounds live activations to one
+microbatch per stage exactly like 1F1B's eager backward does, with none
+of the hand-staged VJP machinery a manual schedule would need.
 """
 
 from functools import partial
