@@ -50,7 +50,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       extra=""; [ "$mode" = on ] && extra="--overlap_collect"
       timeout 700 python -m torchbeast_tpu.monobeast --env Mock \
         --model deep --use_lstm --num_actors 8 --batch_size 8 \
-        --unroll_length 20 --total_steps 30000 --serial_envs \
+        --unroll_length 5 --total_steps 12000 --serial_envs \
         --savedir /tmp/tpu_ovl --xpid "ovl-$mode" $extra \
         > "$OUT/mono_overlap_$mode.log" 2>&1
       echo "overlap $mode rc=$?" >> "$OUT/watch.log"
