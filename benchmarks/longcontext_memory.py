@@ -1,0 +1,110 @@
+"""Long-context memory scaling: dense vs ring attention, by XLA's own
+buffer assignment (compile-time `memory_analysis()` — exact, no
+execution needed, so it runs anywhere including this 1-core container).
+
+Measures the jitted LOSS+GRAD step of the transformer policy at growing
+unroll length T, dense single-device vs ring attention over an 8-way
+`seq` mesh, and reports per-device temp memory. Dense materializes
+[B, H, T, M+T] score tensors (O(T^2)); the ring path streams K/V blocks
+(O(T^2/N) per device and never the full score matrix), which is the
+whole reason sequence parallelism is first-class here (SURVEY.md §5.7
+marks it absent in the reference).
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/longcontext_memory.py
+Prints one JSON line per (T, path).
+"""
+
+import json
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS") is None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from torchbeast_tpu import learner as learner_lib  # noqa: E402
+from torchbeast_tpu.models import create_model  # noqa: E402
+
+B, A, D_MODEL, HEADS, MEM = 2, 4, 64, 4, 64
+SEQ = 8
+
+
+def batch_for(T):
+    rng = np.random.default_rng(0)
+    return {
+        "frame": rng.integers(0, 256, (T + 1, B, 8, 8, 1), dtype=np.uint8),
+        "reward": np.zeros((T + 1, B), np.float32),
+        "done": rng.random((T + 1, B)) < 0.02,
+        "episode_return": np.zeros((T + 1, B), np.float32),
+        "episode_step": np.zeros((T + 1, B), np.int32),
+        "last_action": np.zeros((T + 1, B), np.int32),
+        "action": np.zeros((T + 1, B), np.int32),
+        "policy_logits": np.zeros((T + 1, B, A), np.float32),
+        "baseline": np.zeros((T + 1, B), np.float32),
+    }
+
+
+def measure(T, path):
+    kwargs = dict(
+        num_actions=A, num_layers=2, d_model=D_MODEL, num_heads=HEADS,
+        memory_len=MEM,
+    )
+    if path == "ring":
+        assert len(jax.devices()) >= SEQ, (
+            f"need {SEQ} devices (XLA_FLAGS host device count)"
+        )
+        mesh = Mesh(np.asarray(jax.devices()[:SEQ]), ("seq",))
+        model = create_model("transformer", mesh=mesh, **kwargs)
+        n_dev = SEQ
+    else:
+        model = create_model("transformer", **kwargs)
+        n_dev = 1
+    batch = batch_for(T)
+    state = model.initial_state(B)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+        batch,
+        state,
+    )
+    hp = learner_lib.HParams(batch_size=B, unroll_length=T)
+    optimizer = learner_lib.make_optimizer(hp)
+    step = learner_lib.make_update_step(model, optimizer, hp, donate=False)
+    compiled = step.lower(
+        params, optimizer.init(params), batch, state
+    ).compile()
+    ma = compiled.memory_analysis()
+    return {
+        "T_plus_1": T + 1,
+        "path": path,
+        "devices": n_dev,
+        # memory_analysis() reports ONE SPMD partition's buffer
+        # assignment — i.e. already per-device (verified: a seq-sharded
+        # argument reports size/N). temp is the activation working set
+        # the HBM ceiling cares about.
+        "temp_mb_per_device": round(ma.temp_size_in_bytes / 2**20, 1),
+    }
+
+
+def main():
+    for T in (255, 511, 1023, 2047):
+        for path in ("dense", "ring"):
+            print(json.dumps(measure(T, path)))
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
