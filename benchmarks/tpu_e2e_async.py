@@ -58,6 +58,7 @@ def main():
         "--savedir", "/tmp/tbt_e2e_save",
         "--xpid", f"e2e-{int(time.time())}",
         "--pipes_basename", "unix:/tmp/tbt_e2e_pipe",
+        "--prewarm_inference",  # no mid-run compile stalls in telemetry
     ]
     if args.native:
         cmd += ["--native_runtime", "--native_server"]

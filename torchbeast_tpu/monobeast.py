@@ -200,6 +200,21 @@ def _make_pool(flags, num_envs):
     return ProcessEnvPool(env_fns)
 
 
+def dummy_env_outputs(t, batch_size, frame_shape, frame_dtype):
+    """The env-output schema every acting/learning path consumes —
+    ONE definition (model init dummies and polybeast's inference
+    prewarm both build from it, so schema drift breaks both loudly
+    instead of silently desynchronizing a compiled signature)."""
+    return {
+        "frame": np.zeros(
+            (t, batch_size) + tuple(frame_shape), frame_dtype
+        ),
+        "reward": np.zeros((t, batch_size), np.float32),
+        "done": np.ones((t, batch_size), bool),
+        "last_action": np.zeros((t, batch_size), np.int32),
+    }
+
+
 def _probe_env(flags):
     """One throwaway env instance -> (num_actions, frame shape/dtype)."""
     from torchbeast_tpu.envs import num_actions_of
@@ -493,12 +508,7 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             f"({model.num_heads}) divisible by --sequence_parallel "
             f"{seq_par} (heads are the sharded resource)"
         )
-    dummy = {
-        "frame": np.zeros((1, batch_size) + tuple(frame_shape), frame_dtype),
-        "reward": np.zeros((1, batch_size), np.float32),
-        "done": np.zeros((1, batch_size), bool),
-        "last_action": np.zeros((1, batch_size), np.int32),
-    }
+    dummy = dummy_env_outputs(1, batch_size, frame_shape, frame_dtype)
     state = model.initial_state(batch_size)
     params = model.init(
         {

@@ -30,10 +30,11 @@ from torchbeast_tpu import polybeast_env
 from torchbeast_tpu.monobeast import (
     _init_model_and_params,
     _probe_env,
+    dummy_env_outputs,
     hparams_from_flags,
 )
 from torchbeast_tpu.runtime.actor_pool import ActorPool
-from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.inference import default_buckets, inference_loop
 from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
 from torchbeast_tpu.utils import (
     FileWriter,
@@ -147,6 +148,12 @@ def make_parser():
                              "(host:port); also reads "
                              "TORCHBEAST_COORDINATOR / _NUM_PROCESSES / "
                              "_PROCESS_ID env vars.")
+    parser.add_argument("--prewarm_inference", action="store_true",
+                        help="Compile every inference bucket (powers of "
+                             "two up to max_inference_batch_size) before "
+                             "actors connect, so no actor ever stalls on "
+                             "a mid-run XLA compile. Costs startup time; "
+                             "steady-state behavior unchanged.")
     parser.add_argument("--max_inference_batch_size", type=int, default=64)
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
@@ -544,6 +551,20 @@ def train(flags):
     # dispatch/device-sync work. Measured on 32 actors x 2 threads:
     # +27% steps/s (python runtime) / +18% (native), p99 latency -20-35%
     # (benchmarks/inference_bench.py, artifacts/inference_lock_decision.md).
+    if flags.prewarm_inference:
+        t0 = time.time()
+        buckets = default_buckets(flags.max_inference_batch_size)
+        for b in buckets:
+            dummy_env = dummy_env_outputs(1, b, frame_shape, frame_dtype)
+            dummy_state = jax.tree_util.tree_map(
+                np.asarray, act_model.initial_state(b)
+            )
+            act_fn(dummy_env, dummy_state, b)
+        log.info(
+            "Prewarmed %d inference buckets in %.1fs",
+            len(buckets), time.time() - t0,
+        )
+
     inference_threads = [
         threading.Thread(
             target=inference_loop,
