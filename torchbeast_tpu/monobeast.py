@@ -96,6 +96,12 @@ def make_parser():
                              "pipeline over N devices (a `pipe` mesh "
                              "axis; stage params one-per-chip, "
                              "activations rotate via ppermute).")
+    parser.add_argument("--pipeline_microbatches", type=int, default=0,
+                        help="Microbatch count M for the GPipe schedule "
+                             "(default: one per pipeline device). Bubble "
+                             "fraction is (P-1)/(M+P-1) per pass — raise "
+                             "M to amortize it; the learner batch must "
+                             "divide into M microbatches.")
     parser.add_argument("--pipeline_stages", type=int, default=0,
                         help="Total tower depth (pipelined_mlp stages / "
                              "pipelined_transformer layers). Default: "
@@ -414,6 +420,13 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 f"--pipeline_parallel {pipe_par}"
             )
         extra[stage_kwarg] = n_stages
+        n_mb = getattr(flags, "pipeline_microbatches", 0)
+        if n_mb < 0:
+            raise ValueError(
+                f"--pipeline_microbatches {n_mb} must be >= 1"
+            )
+        if n_mb:
+            extra["n_microbatches"] = n_mb
         # The learner batch must divide into microbatches (default: one
         # per pipe device) or every training forward would silently take
         # the models' sequential fallback — the opposite of what the
@@ -427,15 +440,23 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
             what = "(unroll_length+1)*batch_size"
         if not can_pipeline(
             extra["mesh"], pipelined_quantity,
+            n_microbatches=extra.get("n_microbatches"),
             batch_axis=extra.get("batch_axis"),
         ):
+            from torchbeast_tpu.parallel.pp import (
+                default_n_microbatches,
+            )
+
+            m_eff = default_n_microbatches(
+                extra["mesh"], "pipe", extra.get("n_microbatches")
+            )
             raise ValueError(
                 f"--pipeline_parallel {pipe_par} requires {what} "
                 f"(= {pipelined_quantity}) divisible by the microbatch "
-                "count (one per pipeline device), and each microbatch's "
-                "rows by the data axis when composing with DP — "
-                "otherwise the learner step would silently run the "
-                "sequential fallback"
+                f"count ({m_eff}; --pipeline_microbatches overrides the "
+                "one-per-device default), and each microbatch's rows by "
+                "the data axis when composing with DP — otherwise the "
+                "learner step would silently run the sequential fallback"
             )
     elif flags.model in pipelined_models:
         # No mesh, but the requested tower depth still applies — a

@@ -113,6 +113,10 @@ def make_parser():
                              "pipeline over N devices (a `pipe` mesh "
                              "axis). MLP tower depth = N; the "
                              "transformer keeps its own num_layers.")
+    parser.add_argument("--pipeline_microbatches", type=int, default=0,
+                        help="Microbatch count M for the GPipe schedule "
+                             "(default: one per pipeline device; raise "
+                             "to amortize the (P-1)/(M+P-1) bubble).")
     parser.add_argument("--num_experts", type=int, default=0,
                         help="Replace the transformer's FFN with a top-2 "
                              "mixture of N experts (model=transformer "
