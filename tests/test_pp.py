@@ -250,3 +250,23 @@ def test_multi_pass_rejects_non_multiple():
             jnp.zeros((8, D)),
             mesh=mesh,
         )
+
+
+def test_can_pipeline_gate():
+    """The single divisibility gate the models' fallback and the
+    drivers' validation share (parallel/pp.py can_pipeline)."""
+    from torchbeast_tpu.parallel import create_mesh
+    from torchbeast_tpu.parallel.pp import can_pipeline
+
+    pipe_only = create_mesh(4, pipe_parallelism=4)  # data=1 x pipe=4
+    assert can_pipeline(pipe_only, 8, "pipe")
+    assert not can_pipeline(pipe_only, 6, "pipe")  # 6 % 4 != 0
+    assert can_pipeline(pipe_only, 6, "pipe", n_microbatches=3)
+    composite = create_mesh(8, pipe_parallelism=4)  # data=2 x pipe=4
+    assert can_pipeline(composite, 8, "pipe", batch_axis="data")
+    # 4 rows -> mb=1 per microbatch, not divisible by data=2.
+    assert not can_pipeline(composite, 4, "pipe", batch_axis="data")
+    # Custom M fixes it: mb=2 rows over data=2.
+    assert can_pipeline(
+        composite, 4, "pipe", n_microbatches=2, batch_axis="data"
+    )
