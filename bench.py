@@ -32,7 +32,13 @@ a child with a timeout, and if anything fails or overruns, the supervisor
 replays the last committed real-TPU result
 (benchmarks/artifacts/last_tpu_bench.json) with provenance instead of
 hanging or printing nothing. A successful accelerator run refreshes that
-artifact, so the fallback always carries the newest chip numbers. The XLA
+artifact, so the fallback always carries the newest chip numbers. Every
+line carries machine-readable staleness fields — `fresh` (was this
+measured by THIS run) and `measured_age_days` (age of the numbers) — so
+a replay can never be mistaken for a measurement without parsing prose;
+and a child that *crashes* while the tunnel is up emits a value-null
+error record rather than replaying (a crash is a code regression the
+caller must see, not a wedge to paper over). The XLA
 compile cache is keyed per host CPU so an AOT result built on one machine is
 never loaded on another (SIGILL risk).
 """
@@ -127,7 +133,14 @@ def _probe_backend(timeout_s: float):
 def _base_result(**extra):
     """The metric-line skeleton every emit site shares (final result,
     preliminary child line, replay fallback, forced-CPU failure) — one
-    definition so the schema cannot drift between them."""
+    definition so the schema cannot drift between them.
+
+    `fresh` / `measured_age_days` are first-class staleness fields: a
+    dashboard must not need to parse `platform`/`note` prose to tell a
+    replayed line from a measurement. Defaults are the conservative
+    not-a-fresh-measurement values; live emit sites pass
+    `**_live_fields()` to override.
+    """
     result = {
         "metric": (
             "IMPALA learner update throughput "
@@ -136,9 +149,38 @@ def _base_result(**extra):
         "value": None,
         "unit": "frames/sec/chip",
         "vs_baseline": None,
+        "fresh": False,
+        "measured_age_days": None,
     }
     result.update(extra)
     return result
+
+
+def _live_fields():
+    """Staleness fields for a measurement made in THIS process, now."""
+    return {"fresh": True, "measured_age_days": 0}
+
+
+def _strip_staleness(result: dict) -> dict:
+    """The persisted last_tpu artifact must not assert fresh:true on
+    numbers that age in git — its measured_at stamp is the only truth,
+    and every consumer (replay included) derives staleness from that."""
+    return {
+        k: v
+        for k, v in result.items()
+        if k not in ("fresh", "measured_age_days")
+    }
+
+
+def _age_days(measured_at: str):
+    """Days since a `%Y-%m-%d[ %H:%M:%S]` stamp; None if unparseable."""
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            t = time.mktime(time.strptime(measured_at, fmt))
+        except (ValueError, TypeError):
+            continue
+        return max(0.0, round((time.time() - t) / 86400, 1))
+    return None
 
 
 def _load_last_tpu():
@@ -160,6 +202,14 @@ def _replay_fallback(reason: str) -> None:
     if data and isinstance(data.get("result"), dict):
         result = dict(data["result"])
         result["platform"] = "tpu(replayed)"
+        # Machine-readable staleness: the stored result carries the
+        # fresh=True stamped when it was measured; a replay is, by
+        # definition, not fresh, and its age is however old the
+        # artifact's measurement stamp is.
+        result["fresh"] = False
+        result["measured_age_days"] = _age_days(
+            data.get("measured_at", "")
+        )
         result["note"] = (
             f"REPLAYED from benchmarks/artifacts/last_tpu_bench.json "
             f"(measured {data.get('measured_at', 'unknown date')}): "
@@ -316,6 +366,7 @@ def run_bench(child_deadline: float):
         device_kind=device.device_kind,
         step_ms=round(step_ms, 2),
         note="preliminary (f32 only; later phases pending)",
+        **_live_fields(),
     )))
     sys.stdout.flush()
     # bf16 trunk variant: only worth the extra compile on an accelerator,
@@ -434,13 +485,9 @@ def run_bench(child_deadline: float):
             f"bench: skipping anakin phase ({remaining():.0f}s left)\n"
         )
 
-    result = {
-        "metric": (
-            "IMPALA learner update throughput "
-            f"(deep ResNet+LSTM, T={T}, B={B})"
-        ),
+    result = _base_result(**_live_fields())
+    result.update({
         "value": round(frames_per_sec, 1),
-        "unit": "frames/sec/chip",
         "vs_baseline": (
             round(frames_per_sec / baseline, 2) if baseline else None
         ),
@@ -470,7 +517,7 @@ def run_bench(child_deadline: float):
             round(inference_sps, 1) if inference_sps else None
         ),
         "anakin_sps": round(anakin_sps, 1) if anakin_sps else None,
-    }
+    })
     if not on_accel:
         # A CPU fallback is close to worthless as a TPU benchmark — say
         # so, and point at the last recorded real-TPU measurement so the
@@ -488,6 +535,7 @@ def run_bench(child_deadline: float):
         # COMPLETE run refreshes: a budget-truncated run (skipped
         # bf16/inference/anakin) must not overwrite recorded numbers
         # with nulls that every later replay would then serve.
+        stored = _strip_staleness(result)
         try:
             with open(LAST_TPU_PATH, "w") as f:
                 json.dump(
@@ -497,7 +545,7 @@ def run_bench(child_deadline: float):
                             "bench.py fresh accelerator run "
                             "(auto-refreshed on success)"
                         ),
-                        "result": result,
+                        "result": stored,
                     },
                     f,
                     indent=2,
@@ -642,8 +690,53 @@ def main():
             )
         print(line)
         sys.stdout.flush()
-    else:
+    elif force_cpu:
         fail(f"measurement child failed (rc={proc.returncode})")
+    elif (
+        reprobe := _probe_backend(
+            min(30.0, max(5.0, deadline - time.monotonic() - 10.0))
+        )
+    ) is None or reprobe[0] != probe[0]:
+        # The child died with no measurement line AND the backend is no
+        # longer what it was: either nothing answers, or the probe now
+        # sees a DIFFERENT platform — when the tunnel drops fast (conn
+        # refused rather than hang), jax falls back to the cpu platform,
+        # so a non-None answer alone does not mean the accelerator is
+        # still there. Either way the tunnel dropped mid-run (a drop can
+        # raise inside the child rather than hang it): an infra failure,
+        # not a code regression — replay applies.
+        fail(
+            f"measurement child failed (rc={proc.returncode}) and the "
+            f"backend changed ({probe[0]} -> "
+            f"{reprobe[0] if reprobe else 'no answer'}) — tunnel "
+            "dropped mid-run"
+        )
+    else:
+        # The backend probe SUCCEEDED before AND after the child's
+        # failure, and the child produced no measurement line: that is
+        # a code crash, not a tunnel wedge. Replaying last-known-good
+        # chip numbers here would report a genuinely broken bench as
+        # success indefinitely — emit an unmistakable error record
+        # instead (value null, fresh false). Replay stays reserved for
+        # probe failures, mid-run timeouts, and tunnel drops, where the
+        # measurement was impossible rather than broken.
+        tail = "; ".join(
+            (proc.stderr or "").strip().splitlines()[-3:]
+        )
+        print(json.dumps(_base_result(
+            platform="error",
+            error=(
+                f"measurement child crashed (rc={proc.returncode}) "
+                "after a successful backend probe"
+            ),
+            note=(
+                "no replay: a crash with the tunnel up is a code "
+                "regression, not a wedge; last recorded chip numbers "
+                "remain in benchmarks/artifacts/last_tpu_bench.json. "
+                f"stderr tail: {tail}"
+            ),
+        )))
+        sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -17,9 +17,44 @@ import bench  # noqa: E402
 def test_base_result_schema():
     r = bench._base_result(platform="cpu", note="x")
     assert set(r) >= {"metric", "value", "unit", "vs_baseline",
-                      "platform", "note"}
+                      "platform", "note", "fresh", "measured_age_days"}
     assert r["unit"] == "frames/sec/chip"
+    # Staleness defaults are the conservative not-a-measurement values;
+    # only _live_fields() flips them.
+    assert r["fresh"] is False
+    assert r["measured_age_days"] is None
     assert json.dumps(r).startswith('{"metric"')  # supervisor line match
+
+
+def test_live_fields_mark_fresh_measurements():
+    """Both live emit sites (preliminary + final) stamp their line via
+    _live_fields(): fresh and zero days old."""
+    live = bench._base_result(**bench._live_fields())
+    assert live["fresh"] is True
+    assert live["measured_age_days"] == 0
+
+
+def test_age_days():
+    import time as _time
+
+    stamp = _time.strftime(
+        "%Y-%m-%d %H:%M:%S", _time.localtime(_time.time() - 3 * 86400)
+    )
+    age = bench._age_days(stamp)
+    assert age is not None and 2.8 <= age <= 3.2
+    assert bench._age_days("not a date") is None
+    assert bench._age_days("") is None
+
+
+def test_strip_staleness_for_persisted_artifact():
+    """What bench persists to last_tpu_bench.json must not carry the
+    live-run staleness stamps — the artifact ages in git while a stored
+    fresh:true would not."""
+    live = bench._base_result(value=1.0, **bench._live_fields())
+    stored = bench._strip_staleness(live)
+    assert "fresh" not in stored
+    assert "measured_age_days" not in stored
+    assert stored["value"] == 1.0
 
 
 def test_replay_fallback_replays_committed_artifact(capsys):
@@ -31,6 +66,11 @@ def test_replay_fallback_replays_committed_artifact(capsys):
     assert parsed["vs_baseline"] and parsed["vs_baseline"] > 1
     assert "unit test reason" in parsed["note"]
     assert "last_tpu_bench.json" in parsed["note"]
+    # A replay is machine-readably stale: fresh false, a real age from
+    # the artifact's measured_at stamp.
+    assert parsed["fresh"] is False
+    assert parsed["measured_age_days"] is not None
+    assert parsed["measured_age_days"] >= 0
 
 
 def test_replay_fallback_without_artifact(tmp_path, monkeypatch):
@@ -46,6 +86,104 @@ def test_replay_fallback_without_artifact(tmp_path, monkeypatch):
     parsed = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert parsed["value"] is None
     assert parsed["platform"] == "none"
+    assert parsed["fresh"] is False
+
+
+class _FakeProc:
+    def __init__(self, returncode, stdout="", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _run_supervisor(monkeypatch, capsys, proc):
+    """Drive bench.main() with a successful probe and a scripted child."""
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: ("tpu", "v5e"))
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *a, **k: proc
+    )
+    monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(
+        [ln for ln in out if ln.startswith('{"metric"')][-1]
+    )
+
+
+def test_child_crash_emits_error_record_not_replay(monkeypatch, capsys):
+    """ADVICE r3: a child that crashes (rc!=0, no metric line) while the
+    tunnel is UP must NOT be papered over with last-known-good chip
+    numbers — that would report a broken bench as success forever."""
+    parsed = _run_supervisor(
+        monkeypatch, capsys,
+        _FakeProc(1, stdout="", stderr="Traceback\nBoomError: x\n"),
+    )
+    assert parsed["platform"] == "error"
+    assert parsed["value"] is None
+    assert parsed["fresh"] is False
+    assert "crashed" in parsed["error"]
+    assert "BoomError" in parsed["note"]
+
+
+def test_child_crash_with_dead_backend_replays(monkeypatch, capsys):
+    """A child that dies while the backend STOPPED answering is a tunnel
+    drop mid-run (drops can raise rather than hang) — infra, not a code
+    regression, so the replay contract applies."""
+    probes = iter([("tpu", "v5e"), None])  # up before, dead after
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: next(probes)
+    )
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _FakeProc(1, stderr="RuntimeError: conn reset\n"),
+    )
+    monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(
+        [ln for ln in out if ln.startswith('{"metric"')][-1]
+    )
+    assert parsed["platform"] == "tpu(replayed)"
+    assert parsed["fresh"] is False
+    assert "tunnel dropped mid-run" in parsed["note"]
+
+
+def test_child_crash_with_cpu_fallback_probe_replays(monkeypatch, capsys):
+    """When the tunnel drops FAST (conn refused, not a hang), jax falls
+    back to the cpu platform, so the post-crash probe answers — with the
+    WRONG platform. That must still count as a tunnel drop (replay), not
+    a code crash."""
+    probes = iter([("tpu", "v5e"), ("cpu", "cpu")])
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: next(probes)
+    )
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _FakeProc(1, stderr="ConnectionRefusedError\n"),
+    )
+    monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(
+        [ln for ln in out if ln.startswith('{"metric"')][-1]
+    )
+    assert parsed["platform"] == "tpu(replayed)"
+    assert "tunnel dropped mid-run" in parsed["note"]
+
+
+def test_child_success_line_passes_through(monkeypatch, capsys):
+    good = json.dumps(bench._base_result(
+        value=1.0, platform="tpu", step_ms=5.0, **bench._live_fields()
+    ))
+    parsed = _run_supervisor(
+        monkeypatch, capsys, _FakeProc(0, stdout=good + "\n")
+    )
+    assert parsed["platform"] == "tpu"
+    assert parsed["fresh"] is True
+    assert parsed["measured_age_days"] == 0
 
 
 def test_forced_cpu_starved_budget_never_replays_tpu():

@@ -1,0 +1,147 @@
+"""Split deployment: the learner and the env-server group run as SEPARATE
+OS process trees connected only by TCP — the cross-machine topology
+(reference polybeast_env.py:61-77 launches the env group on its own
+machine; polybeast_learner.py:436-444 is the learner that dials it;
+BASELINE config 5's shape). The env group is launched through its REAL
+CLI (`python -m torchbeast_tpu.polybeast_env`), the learner runs with
+--no_start_servers, trains to completion, then RESUMES from its
+checkpoint against the same still-running servers — the env group's
+lifetime is fully decoupled from the learner's, which is the point of
+the split."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu import polybeast
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NUM_SERVERS = 2
+
+
+def _free_port_base(n: int) -> int:
+    """A base port with n consecutive free TCP ports (best-effort: bind
+    them all, then release — the env CLI rebinds right after)."""
+    for _ in range(50):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            if base + n >= 65535:
+                continue
+            for i in range(1, n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("could not find a free port range")
+
+
+def _wait_listening(ports, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    remaining = set(ports)
+    while remaining and time.monotonic() < deadline:
+        for p in list(remaining):
+            with socket.socket() as s:
+                s.settimeout(0.5)
+                try:
+                    s.connect(("127.0.0.1", p))
+                except OSError:
+                    continue
+                remaining.discard(p)
+        if remaining:
+            time.sleep(0.3)
+    return not remaining
+
+
+def _learner_flags(tmp_path, base_port, total_steps):
+    return polybeast.make_parser().parse_args([
+        "--env", "Mock",
+        "--no_start_servers",
+        "--num_servers", str(NUM_SERVERS),
+        "--batch_size", "2",
+        "--unroll_length", "5",
+        "--total_steps", str(total_steps),
+        "--savedir", str(tmp_path),
+        "--xpid", "split-tcp",
+        "--model", "shallow",
+        "--pipes_basename", f"127.0.0.1:{base_port}",
+        "--num_inference_threads", "1",
+        "--max_inference_batch_size", "4",
+        "--checkpoint_interval_s", "100000",
+    ])
+
+
+def test_split_deployment_external_tcp_servers_train_and_resume(
+    tmp_path, caplog
+):
+    base_port = _free_port_base(NUM_SERVERS)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",  # the env CLI must never touch the tunnel
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    group = subprocess.Popen(
+        [
+            sys.executable, "-m", "torchbeast_tpu.polybeast_env",
+            "--env", "Mock",
+            "--num_servers", str(NUM_SERVERS),
+            "--pipes_basename", f"127.0.0.1:{base_port}",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        assert _wait_listening(
+            [base_port + i for i in range(NUM_SERVERS)]
+        ), "env-server group never came up on its TCP ports"
+
+        # Phase 1: train to completion against the external group.
+        stats = polybeast.train(_learner_flags(tmp_path, base_port, 60))
+        assert stats["step"] >= 60
+        assert np.isfinite(stats["total_loss"])
+        ckpt = tmp_path / "split-tcp" / "model.ckpt"
+        assert ckpt.exists()
+
+        # The env group must have been untouched by learner shutdown:
+        # it belongs to a different machine in the real topology.
+        assert group.poll() is None, "env group died with the learner"
+
+        # Phase 2: a NEW learner process-equivalent resumes from the
+        # checkpoint against the same still-running servers and trains
+        # further (each reconnect gets a fresh env stream server-side).
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="torchbeast_tpu"):
+            stats = polybeast.train(
+                _learner_flags(tmp_path, base_port, 120)
+            )
+        assert any("Resuming" in r.message for r in caplog.records), (
+            "phase 2 trained from scratch instead of resuming"
+        )
+        assert stats["step"] >= 120
+        assert np.isfinite(stats["total_loss"])
+    finally:
+        group.terminate()
+        try:
+            group.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            group.kill()
+            group.wait(timeout=10)

@@ -98,7 +98,8 @@ def make_parser():
                              "activations rotate via ppermute).")
     parser.add_argument("--pipeline_microbatches", type=int, default=0,
                         help="Microbatch count M for the GPipe schedule "
-                             "(default: one per pipeline device). Bubble "
+                             "(0, the default, means one per pipeline "
+                             "device). Bubble "
                              "fraction is (P-1)/(M+P-1) per pass — raise "
                              "M to amortize it; the learner batch must "
                              "divide into M microbatches.")
@@ -423,7 +424,9 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         n_mb = getattr(flags, "pipeline_microbatches", 0)
         if n_mb < 0:
             raise ValueError(
-                f"--pipeline_microbatches {n_mb} must be >= 1"
+                f"--pipeline_microbatches {n_mb} must be >= 0 "
+                "(0 means the default: one microbatch per pipeline "
+                "device)"
             )
         if n_mb:
             extra["n_microbatches"] = n_mb

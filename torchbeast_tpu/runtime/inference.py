@@ -95,6 +95,13 @@ def inference_loop(
     threads draining one batcher, another thread can steal the waiting
     request and leave this one parked on an empty batcher while holding
     finished replies, stalling those actors until new traffic arrives.
+    Tail-latency cost: the held reply for batch k is only flushed once
+    the batcher YIELDS batch k+1 — if size() > 0 but that next batch is
+    still forming (waiting on stragglers to reach min batch size), the
+    deferred actors wait up to the batcher's formation timeout (default
+    100 ms) beyond the dispatch-side win. Worth it only when the reply
+    path is the bottleneck (remote-tunnel round-trips); for local
+    devices the default (off) avoids the tail.
     Default OFF: only enable it for a single consumer thread
     (polybeast wires pipelined=num_inference_threads==1; cross-thread
     overlap already comes from the threads themselves).
