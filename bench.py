@@ -692,6 +692,22 @@ def main():
         sys.stdout.flush()
     elif force_cpu:
         fail(f"measurement child failed (rc={proc.returncode})")
+    elif any(
+        sig in (proc.stderr or "")
+        for sig in (
+            "ConnectionRefused", "ConnectionReset", "Connection reset",
+            "Connection refused", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+            "Socket closed", "Broken pipe", "EOFError",
+        )
+    ):
+        # The child's own stderr shows a connection failure: the tunnel
+        # dropped mid-run, even if it has already RECOVERED by the time
+        # we could reprobe (round-3 logs show intermittent blips). Infra,
+        # not code — replay.
+        fail(
+            f"measurement child failed (rc={proc.returncode}) with a "
+            "connection error in stderr — tunnel dropped mid-run"
+        )
     elif (
         reprobe := _probe_backend(
             min(30.0, max(5.0, deadline - time.monotonic() - 10.0))

@@ -5,8 +5,9 @@
 #                              (auto-refreshes last_tpu_bench.json)
 #   2. profile_step.py bf16  — op-level trace + roofline evidence
 #   3. profile_step.py f32
-#   4. tpu_e2e_async.py      — full async driver system SPS + queues
-#   5. monobeast overlap A/B — zero-lag vs --overlap_collect timings
+#   4. mfu_ablation.py       — trunk share + channel/batch scaling
+#   5. tpu_e2e_async.py      — full async driver system SPS + queues
+#   6. monobeast overlap A/B — zero-lag vs --overlap_collect timings
 # Everything lands under $OUT; summarize into repo artifacts by hand
 # afterwards (this script never writes to benchmarks/artifacts itself,
 # except bench.py's own last_tpu refresh).
@@ -40,6 +41,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       --steps 10 --out "$OUT/trace_f32" \
       > "$OUT/profile_f32.json" 2> "$OUT/profile_f32.err"
     echo "profile f32 rc=$?" >> "$OUT/watch.log"
+    echo "=== mfu ablation ===" >> "$OUT/watch.log"
+    timeout 1300 python benchmarks/mfu_ablation.py --full \
+      --budget_s 1200 \
+      > "$OUT/mfu_ablation.json" 2> "$OUT/mfu_ablation.err"
+    echo "mfu ablation rc=$?" >> "$OUT/watch.log"
     echo "=== e2e async ===" >> "$OUT/watch.log"
     timeout 1300 python benchmarks/tpu_e2e_async.py \
       --total_steps 200000 --timeout_s 1200 --out "$OUT/e2e.log" \

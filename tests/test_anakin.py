@@ -3,6 +3,7 @@ train step, and an actual learning check — after a few hundred updates the
 agent must catch the ball far more often than chance."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +69,7 @@ def run_anakin(tmp_path, total_steps, **overrides):
     return anakin.train(anakin.make_parser().parse_args(argv))
 
 
+@pytest.mark.slow
 def test_anakin_learns_catch(tmp_path):
     # Chance-level mean return is ~-0.3 (paddle random walk); a learning
     # agent approaches +1. 700 updates x 32 envs x 9 steps is plenty for
@@ -78,6 +80,7 @@ def test_anakin_learns_catch(tmp_path):
     assert stats.get("mean_episode_return", -1.0) > 0.5
 
 
+@pytest.mark.slow
 def test_anakin_resume(tmp_path):
     import csv
 
@@ -102,6 +105,7 @@ def test_anakin_resume(tmp_path):
     assert first_new > saved_step
 
 
+@pytest.mark.slow
 def test_anakin_data_parallel(tmp_path):
     stats = run_anakin(
         tmp_path, total_steps=10_000, xpid="anakin-dp", num_devices="4",

@@ -82,6 +82,7 @@ def test_ring_matches_dense(with_segments):
     )
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     mesh = create_mesh(8)
     q, k, v = make_qkv(seed=3)
@@ -138,6 +139,7 @@ def test_zigzag_ring_matches_dense(with_segments):
     )
 
 
+@pytest.mark.slow
 def test_zigzag_ring_gradients_match_dense():
     mesh = create_mesh(8)
     q, k, v = make_qkv(seed=6)
@@ -161,6 +163,7 @@ def test_zigzag_ring_gradients_match_dense():
 
 
 @pytest.mark.parametrize("with_segments", [False, True])
+@pytest.mark.slow
 def test_zigzag_ring_long_sequence(with_segments):
     # T=512 on the 8-way mesh -> chunk size 32: exercises the intra-chunk
     # tril-and-segment interaction at c > 1 (T=16 degenerates to c=1).

@@ -174,6 +174,32 @@ def test_child_crash_with_cpu_fallback_probe_replays(monkeypatch, capsys):
     assert "tunnel dropped mid-run" in parsed["note"]
 
 
+def test_child_crash_with_recovered_tunnel_still_replays(
+    monkeypatch, capsys
+):
+    """A transient blip can drop the child and RECOVER before the
+    supervisor's reprobe; the connection-error signature in the child's
+    stderr must still classify it as infra (replay), not code."""
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: ("tpu", "v5e")
+    )  # up before AND after
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _FakeProc(
+            1, stderr="RuntimeError: Connection reset by peer\n"
+        ),
+    )
+    monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(
+        [ln for ln in out if ln.startswith('{"metric"')][-1]
+    )
+    assert parsed["platform"] == "tpu(replayed)"
+    assert "tunnel dropped mid-run" in parsed["note"]
+
+
 def test_child_success_line_passes_through(monkeypatch, capsys):
     good = json.dumps(bench._base_result(
         value=1.0, platform="tpu", step_ms=5.0, **bench._live_fields()

@@ -16,6 +16,8 @@ from torchbeast_tpu.parallel import (
     shard_batch,
 )
 
+pytestmark = pytest.mark.slow
+
 T, B, A = 4, 8, 5
 
 

@@ -56,6 +56,7 @@ def _assert_same_outputs(out_a, state_a, out_b, state_b):
     )
 
 
+@pytest.mark.slow
 def test_transformer_to_pipelined_same_outputs():
     seq = create_model("transformer", **KW)
     pipe = create_model("pipelined_transformer", **KW)
@@ -100,6 +101,7 @@ def test_moe_blocks_refuse_conversion():
         transformer_to_pipelined(params)
 
 
+@pytest.mark.slow
 def test_checkpoint_cli_roundtrip_through_driver(tmp_path):
     """Full workflow: train the pipelined transformer in the sync driver,
     convert the CHECKPOINT FILE (params + optimizer moments + recorded

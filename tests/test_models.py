@@ -188,6 +188,29 @@ def test_resnet_remat_variants_identical(remat):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_resnet_trunk_channels_variant():
+    """Opt-in widened trunk (--trunk_channels): stage widths and the fc
+    input dim (11*11*C2) follow the requested channels; forward runs and
+    produces the usual heads."""
+    model = create_model(
+        "deep", num_actions=NUM_ACTIONS, use_lstm=True,
+        trunk_channels=(32, 64, 64),
+    )
+    inputs = make_inputs(t=2, b=2)
+    state = model.initial_state(2)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "action": jax.random.PRNGKey(1)},
+        inputs,
+        state,
+    )
+    trunk = params["params"]["trunk"]
+    assert trunk["feat_conv_0"]["kernel"].shape[-1] == 32
+    assert trunk["feat_conv_2"]["kernel"].shape[-1] == 64
+    assert trunk["fc"]["kernel"].shape == (11 * 11 * 64, 256)
+    out, _ = model.apply(params, inputs, state, sample_action=False)
+    assert out.policy_logits.shape == (2, 2, NUM_ACTIONS)
+
+
 def test_resnet_remat_length_validated():
     model = ResNet(num_actions=NUM_ACTIONS, remat=(True, False))
     inputs = make_inputs(t=2, b=1)

@@ -9,6 +9,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 from torchbeast_tpu import learner as learner_lib
@@ -102,6 +103,7 @@ def test_aux_loss_sown_and_balanced_floor():
     assert float(aux) >= 0.99
 
 
+@pytest.mark.slow
 def test_transformer_moe_trains_and_aux_flows():
     T, B, A = 4, 4, 5
     model = create_model(

@@ -4,6 +4,7 @@ checkpoint round-trips, logs written, test mode evaluates."""
 import os
 
 import numpy as np
+import pytest
 
 from torchbeast_tpu import monobeast
 
@@ -58,6 +59,7 @@ def test_test_mode(tmp_path):
     assert all(r == 200.0 for r in returns)
 
 
+@pytest.mark.slow
 def test_mono_learns_catch(tmp_path):
     """End-to-end learning check on a real task: the sync driver must
     learn Catch well above chance (~-0.3) within a small frame budget."""
@@ -79,6 +81,17 @@ def test_mono_learns_catch(tmp_path):
     assert stats.get("mean_episode_return", -1.0) > 0.5
 
 
+def test_trunk_channels_validation(tmp_path):
+    with pytest.raises(ValueError, match="deep only"):
+        monobeast.train(
+            make_flags(tmp_path, trunk_channels="32,64,64")
+        )  # default model is shallow
+    with pytest.raises(ValueError, match="three positive"):
+        monobeast.train(
+            make_flags(tmp_path, model="deep", trunk_channels="32,64")
+        )
+
+
 def test_unaligned_actors_rejected(tmp_path):
     flags = make_flags(tmp_path, num_actors="3")
     try:
@@ -89,6 +102,7 @@ def test_unaligned_actors_rejected(tmp_path):
     assert raised
 
 
+@pytest.mark.slow
 def test_train_transformer_sequence_parallel(tmp_path):
     """The transformer trains with its unroll attention running as ring
     attention over a 4-way `seq` mesh (T+1 = 8 divisible by 4; acting at
@@ -107,6 +121,7 @@ def test_train_transformer_sequence_parallel(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_train_transformer_zigzag_sequence_parallel(tmp_path):
     """Sequence-parallel training with the zig-zag ring schedule
     (T+1 = 16 divisible by 2N = 8 on a 4-way seq mesh)."""
@@ -138,6 +153,7 @@ def test_train_overlap_collect(tmp_path):
     assert stats2["step"] >= 80
 
 
+@pytest.mark.slow
 def test_overlap_collect_learns_catch(tmp_path):
     """Lag-1 acting must not break learning: Catch is solved (or close)
     within the same budget the zero-lag test uses."""
@@ -150,6 +166,7 @@ def test_overlap_collect_learns_catch(tmp_path):
     assert stats["mean_episode_return"] > 0.8
 
 
+@pytest.mark.slow
 def test_train_sp_x_ep_composite_flags(tmp_path):
     """--sequence_parallel + --expert_parallel through the real flag
     path: one composite (data=1, model=1, seq, expert) mesh shared by
@@ -166,6 +183,7 @@ def test_train_sp_x_ep_composite_flags(tmp_path):
     assert stats["aux_loss"] > 0.0
 
 
+@pytest.mark.slow
 def test_train_mono_data_parallel(tmp_path):
     """--num_learner_devices: sync trainer DP over 4 virtual devices,
     incl. checkpoint/resume and composition with --overlap_collect."""
@@ -193,8 +211,6 @@ def test_train_mono_data_parallel(tmp_path):
 
 
 def test_mono_dp_rejects_bad_combos(tmp_path):
-    import pytest
-
     flags = make_flags(
         tmp_path, xpid="mono-dp-bad", num_learner_devices="3",
     )

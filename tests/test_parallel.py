@@ -63,6 +63,7 @@ def test_mesh_shapes():
         create_mesh(64)  # more than the 8 virtual devices
 
 
+@pytest.mark.slow
 def test_parallel_update_matches_single_device(setup):
     model, params, state, hp, optimizer = setup
     batch = make_batch()
@@ -162,6 +163,7 @@ def test_parallel_update_keeps_params_replicated(setup):
     assert len(frame.sharding.device_set) == 8
 
 
+@pytest.mark.slow
 def test_transformer_megatron_tp_matches_single_device():
     """Megatron column/row-paired TP for the transformer on a
     (data=4 x model=2) mesh: the update must match single-device, and

@@ -3,8 +3,10 @@ unix sockets with the real model, inference bucketing, and the learner
 thread; checkpoint written; steps advance."""
 
 import numpy as np
+import pytest
 
 from torchbeast_tpu import polybeast
+
 
 
 def make_flags(tmp_path, **overrides):
@@ -36,6 +38,7 @@ def test_polybeast_train_smoke(tmp_path):
     assert (tmp_path / "poly-smoke" / "logs.csv").exists()
 
 
+@pytest.mark.slow
 def test_polybeast_train_lstm(tmp_path):
     flags = make_flags(tmp_path, xpid="poly-lstm", use_lstm=True)
     stats = polybeast.train(flags)
@@ -43,6 +46,7 @@ def test_polybeast_train_lstm(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_polybeast_train_native_runtime(tmp_path):
     from torchbeast_tpu.runtime.native import available
 
@@ -57,6 +61,7 @@ def test_polybeast_train_native_runtime(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_polybeast_test_mode(tmp_path):
     # Train a checkpoint, then greedy-evaluate it via the poly CLI (the
     # reference's poly test() raises NotImplementedError).
@@ -68,6 +73,7 @@ def test_polybeast_test_mode(tmp_path):
     assert returns[0] == 200.0  # Mock: 200 steps x reward 1.0
 
 
+@pytest.mark.slow
 def test_polybeast_bf16_trunk(tmp_path):
     flags = make_flags(tmp_path, xpid="poly-bf16", model_dtype="bfloat16")
     stats = polybeast.train(flags)
@@ -75,6 +81,7 @@ def test_polybeast_bf16_trunk(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_polybeast_train_data_parallel(tmp_path):
     # 4-way DP learner over the virtual CPU mesh inside the async driver.
     flags = make_flags(
@@ -86,6 +93,7 @@ def test_polybeast_train_data_parallel(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_polybeast_train_native_feedforward(tmp_path):
     # The default (no-LSTM) path carries an EMPTY agent-state nest through
     # the whole C++ pipeline — distinct empty-nest round-trip coverage.
@@ -101,6 +109,7 @@ def test_polybeast_train_native_feedforward(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_poly_transformer_sequence_parallel(tmp_path):
     """The async driver trains the transformer with ring attention over a
     4-way seq mesh (unroll+1 = 8 divisible by 4; the T=1 inference path
@@ -125,6 +134,7 @@ def test_poly_transformer_sequence_parallel(tmp_path):
     assert np.isfinite(stats["total_loss"])
 
 
+@pytest.mark.slow
 def test_prewarm_inference(tmp_path, caplog):
     """--prewarm_inference compiles every bucket before actors connect
     and the run proceeds normally (the log record proves the prewarm

@@ -107,6 +107,7 @@ def test_pipeline_carry_and_shared():
     )
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_sequential():
     """Backprop through the schedule == backprop through the stack; the
     fill/drain bubble computations must be gradient-invisible."""
@@ -207,6 +208,7 @@ def test_multi_pass_pipeline_matches_sequential():
     )
 
 
+@pytest.mark.slow
 def test_multi_pass_pipeline_gradients_match_sequential():
     from torchbeast_tpu.parallel.pp import pipeline_apply_multi
 

@@ -5,11 +5,14 @@ train it over a `pipe` mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 from torchbeast_tpu import learner as learner_lib
 from torchbeast_tpu.models import create_model
 from torchbeast_tpu.parallel.pp import stage_param_shardings
+
+pytestmark = pytest.mark.slow
 
 T, B, A = 4, 8, 5
 

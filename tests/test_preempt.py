@@ -7,6 +7,10 @@ import subprocess
 import sys
 import time
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

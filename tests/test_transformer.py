@@ -3,6 +3,7 @@ forward == step-by-step forward with carried KV cache), and episode-
 boundary isolation."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -192,6 +193,7 @@ def _ring_model(dense_model):
     )
 
 
+@pytest.mark.slow
 def test_ring_path_matches_dense_forward_and_state():
     """The ring formulation (band + segments + rel-bias + cache leg,
     online-merged) must reproduce the dense path bit-for-bit-ish — with a
@@ -233,6 +235,7 @@ def test_ring_path_matches_dense_forward_and_state():
         np.testing.assert_array_equal(np.asarray(rval), np.asarray(dval))
 
 
+@pytest.mark.slow
 def test_ring_path_gradients_match_dense():
     t = 8
     model, params = init_model(memory_len=4)
@@ -273,6 +276,7 @@ def test_ring_path_falls_back_to_dense_for_short_t():
     )
 
 
+@pytest.mark.slow
 def test_zigzag_ring_path_matches_dense():
     """Zig-zag-scheduled sequence-parallel training path: same numerics
     as dense, with cache + dones + band clipping (T=32 over the 8-way
@@ -315,6 +319,7 @@ def test_zigzag_ring_path_matches_dense():
         np.testing.assert_array_equal(np.asarray(zval), np.asarray(dval))
 
 
+@pytest.mark.slow
 def test_zigzag_ring_path_gradients_match_dense():
     t = 16
     model, params = init_model(memory_len=4)
@@ -348,6 +353,7 @@ def test_zigzag_ring_path_gradients_match_dense():
         )
 
 
+@pytest.mark.slow
 def test_remat_update_matches_non_remat():
     """--transformer_remat: per-block rematerialization must be a pure
     memory/recompute trade — outputs and one full update identical to

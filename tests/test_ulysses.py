@@ -46,6 +46,7 @@ def test_ulysses_with_segments_matches_dense():
     np.testing.assert_allclose(out, dense, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_gradients_match_dense():
     q, k, v = _qkv(jax.random.PRNGKey(3))
     mesh = _mesh(4)
@@ -92,6 +93,7 @@ def _transformer_batch(T_, A, seed=5):
     }
 
 
+@pytest.mark.slow
 def test_ulysses_transformer_matches_dense():
     """Full model forward: ulysses path == dense path with identical
     params, including cache attention, band mask, segments, rel bias."""

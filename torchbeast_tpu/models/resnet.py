@@ -114,6 +114,13 @@ class ResNet(nn.Module):
     remat: Any = True  # bool or per-stage tuple, see ResNetBase.remat
 
     hidden_size: int = 256
+    # Opt-in trunk widths. The reference's 16/32/32 (polybeast_learner.py
+    # :140-147) keeps parity but wastes most of an MXU tile: a v5e
+    # contracts 128x128, and a 16-channel conv's im2col matmul fills 16
+    # of 128 output lanes. Wider trunks (e.g. 32/64/64 or 64/128/128)
+    # buy model capacity at far less than proportional step-time on the
+    # chip — benchmarks/mfu_ablation.py measures exactly that scaling.
+    trunk_channels: Sequence[int] = (16, 32, 32)
 
     @nn.compact
     def __call__(self, inputs, core_state=(), *, sample_action: bool = True):
@@ -121,6 +128,7 @@ class ResNet(nn.Module):
         T, B = frame.shape[:2]
 
         x = ResNetBase(
+            channels=tuple(self.trunk_channels),
             dtype=self.dtype, remat=self.remat, name="trunk"
         )(frame)
 

@@ -74,6 +74,14 @@ def make_parser():
                         choices=["float32", "bfloat16"],
                         help="Conv/fc trunk compute dtype (bfloat16 rides "
                              "the MXU; params and losses stay float32).")
+    parser.add_argument("--trunk_channels", default="",
+                        help="Opt-in deep-trunk widths as a comma list "
+                             "(e.g. 32,64,64). Default: the reference's "
+                             "16/32/32. A 16-channel conv fills 16 of an "
+                             "MXU tile's 128 output lanes — wider trunks "
+                             "buy capacity at far under proportional "
+                             "step-time (benchmarks/mfu_ablation.py "
+                             "measures the scaling). Deep model only.")
     parser.add_argument("--serial_envs", action="store_true",
                         help="Step envs in-process (tests/cheap envs).")
     parser.add_argument("--attention_impl", default="dense",
@@ -505,6 +513,23 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 "models/resnet.py `remat`)"
             )
         extra["remat"] = True
+    trunk_channels = getattr(flags, "trunk_channels", "")
+    if trunk_channels:
+        if flags.model != "deep":
+            raise ValueError(
+                "--trunk_channels applies to --model deep only (the "
+                "knob widens the ResNet conv trunk)"
+            )
+        try:
+            widths = tuple(int(c) for c in trunk_channels.split(","))
+        except ValueError:
+            widths = ()
+        if len(widths) != 3 or any(w < 1 for w in widths):
+            raise ValueError(
+                f"--trunk_channels {trunk_channels!r} must be three "
+                "positive comma-separated ints (e.g. 32,64,64)"
+            )
+        extra["trunk_channels"] = widths
     if unmeshed:
         for key in ("mesh", "moe_mesh", "batch_axis"):
             extra.pop(key, None)

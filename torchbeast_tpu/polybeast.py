@@ -82,6 +82,11 @@ def make_parser():
                         choices=["float32", "bfloat16"],
                         help="Conv/fc trunk compute dtype (bfloat16 rides "
                              "the MXU; params and losses stay float32).")
+    parser.add_argument("--trunk_channels", default="",
+                        help="Opt-in deep-trunk widths as a comma list "
+                             "(e.g. 32,64,64; default: the reference's "
+                             "16/32/32). See monobeast and "
+                             "benchmarks/mfu_ablation.py.")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--num_inference_threads", type=int, default=2)
     parser.add_argument("--native_runtime", action="store_true",

@@ -13,15 +13,30 @@ import platform as platform_mod
 
 
 def host_keyed_cache_dir(prefix: str = "torchbeast_tpu_xla") -> str:
+    # Key by ISA flags AND the CPU identity lines (model name / family /
+    # model / stepping): LLVM tuning is derived from the CPU *model*,
+    # not the flag list, so two hosts with identical cpuinfo flags can
+    # still produce mutually-foreign AOT entries. Note the loader's
+    # "+prefer-no-gather is not supported on the host machine ... could
+    # lead to SIGILL" warning is NOT a reliable foreignness signal: the
+    # prefer-no-* entries are LLVM tuning preferences that appear in the
+    # stored compile-feature list but never in the loader's host-feature
+    # list, so that warning fires even when reloading entries compiled
+    # minutes earlier on this same host (observed 2026-07-30). The wider
+    # key guards against real model-level drift; it cannot (and does not
+    # try to) silence that warning. Hostname stays out — it would bust
+    # the cache on pod churn without adding any SIGILL protection.
+    wanted = ("flags", "model name", "cpu family", "model", "stepping")
+    fingerprint = ""
     try:
         with open("/proc/cpuinfo") as f:
-            fingerprint = next(
-                (line for line in f if line.startswith("flags")), ""
-            )
+            for line in f:
+                if line.startswith(wanted):
+                    fingerprint += line
+                if line.strip() == "":
+                    break  # first core only; they are homogeneous
     except OSError:
-        fingerprint = ""
-    # ISA flags only — hostname would bust the cache on pod churn without
-    # adding any SIGILL protection.
+        pass
     fingerprint += platform_mod.machine()
     key = hashlib.sha1(fingerprint.encode()).hexdigest()[:10]
     return os.path.expanduser(f"~/.cache/{prefix}_{key}")
