@@ -54,3 +54,51 @@ def test_serial_pool_stacks():
     out = pool.step(np.zeros(3, np.int32))
     assert out["episode_step"].tolist() == [1, 1, 1]
     pool.close()
+
+
+def test_memory_chain_mechanics():
+    """Cue visible ONLY at reset; corridor/query frames cue-independent;
+    forward required before the query (−0.5 otherwise, breaking any
+    last-action relay); the query-step action decides ±1."""
+    import numpy as np
+
+    from torchbeast_tpu.envs import MemoryChainEnv, create_env
+
+    env = MemoryChainEnv(length=4, seed=0)
+    fwd = env.FORWARD
+    seen = set()
+    for _ in range(20):
+        frame = env.reset()
+        cue = int(np.argmax(frame[:2, 0, 0]))
+        assert frame[2, 0, 0] == 0 and frame[3, 0, 0] == 0
+        seen.add(cue)
+        for t in range(1, env.length + 1):
+            act = cue if t == env.length else fwd
+            frame, reward, done = env.step(act)
+            # Post-cue frames carry NO cue information.
+            assert frame[0, 0, 0] == 0 and frame[1, 0, 0] == 0
+            if t < env.length:
+                assert reward == 0.0 and not done
+                if t == env.length - 1:
+                    assert frame[3, 0, 0] == 255  # query beacon
+                else:
+                    assert frame[2, 0, 0] == 255  # corridor beacon
+            else:
+                assert done and reward == 1.0  # matched the cue
+    assert seen == {0, 1}  # both cues drawn
+
+    # Mismatched query answer -> -1; non-forward corridor step -> -0.5
+    # (the relay tax: a full last-action relay costs (length-1)*0.5,
+    # strictly worse than honest coin-flipping).
+    env2 = MemoryChainEnv(length=4, seed=1)
+    frame = env2.reset()
+    cue = int(np.argmax(frame[:2, 0, 0]))
+    _, reward, done = env2.step(cue)  # announcing the cue = violation
+    assert reward == -0.5 and not done
+    for t in range(2, env2.length):
+        _, reward, done = env2.step(fwd)
+        assert reward == 0.0 and not done
+    _, reward, done = env2.step(1 - cue)
+    assert done and reward == -1.0
+
+    assert create_env("Memory").num_actions == 3

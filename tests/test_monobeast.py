@@ -81,6 +81,65 @@ def test_mono_learns_catch(tmp_path):
     assert stats.get("mean_episode_return", -1.0) > 0.5
 
 
+@pytest.mark.slow
+def test_mono_learns_catch_with_lstm(tmp_path):
+    """BASELINE config 3's shape (--use_lstm): the recurrent core must
+    LEARN, not just run — state carry/reset through the unroll is the
+    trickiest on-policy machinery (reference monobeast.py:599-611,
+    core_agent_state_test.py). Pilot run solved Catch (return 1.0) by
+    ~38k steps with these hyperparameters
+    (benchmarks/artifacts/lstm_learning.md)."""
+    flags = monobeast.make_parser().parse_args([
+        "--env", "Catch",
+        "--model", "mlp",
+        "--use_lstm",
+        "--num_actors", "16",
+        "--batch_size", "16",
+        "--unroll_length", "20",
+        "--total_steps", "60000",
+        "--serial_envs",
+        "--learning_rate", "2e-3",
+        "--entropy_cost", "0.01",
+        "--savedir", str(tmp_path),
+        "--xpid", "catch-lstm",
+        "--checkpoint_interval_s", "100000",
+    ])
+    stats = monobeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.5
+
+
+@pytest.mark.slow
+def test_lstm_solves_memory_env(tmp_path):
+    """The FF-vs-LSTM differential on the Memory probe (MemoryChainEnv):
+    nothing observable at the decision step correlates with the cue and
+    the forward-penalty breaks the last-action relay, so feed-forward
+    caps at ~0 while a working recurrent core reaches +1. Pilot curves:
+    LSTM sustained 1.0 from ~37k steps; FF oscillated in [-0.35, +0.3]
+    for 150k (benchmarks/artifacts/lstm_learning.md)."""
+
+    def run(use_lstm, xpid):
+        argv = [
+            "--env", "Memory",
+            "--model", "mlp",
+            "--num_actors", "16",
+            "--batch_size", "16",
+            "--unroll_length", "20",
+            "--total_steps", "80000",
+            "--serial_envs",
+            "--learning_rate", "1e-3",
+            "--entropy_cost", "0.01",
+            "--savedir", str(tmp_path),
+            "--xpid", xpid,
+            "--checkpoint_interval_s", "100000",
+        ] + (["--use_lstm"] if use_lstm else [])
+        return monobeast.train(monobeast.make_parser().parse_args(argv))
+
+    lstm_stats = run(True, "mem-lstm")
+    assert lstm_stats.get("mean_episode_return", -1.0) > 0.6
+    ff_stats = run(False, "mem-ff")
+    assert ff_stats.get("mean_episode_return", 1.0) < 0.5
+
+
 def test_trunk_channels_validation(tmp_path):
     with pytest.raises(ValueError, match="deep only"):
         monobeast.train(

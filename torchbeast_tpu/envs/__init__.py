@@ -2,14 +2,17 @@
 
 `create_env(name, ...)` mirrors the reference's `create_env(flags)`
 (monobeast.py:638-646, polybeast_env.py:49-58): "Mock"/"Counting" build the
-dependency-free test envs; anything else is treated as a gymnasium Atari id
-and gets the DeepMind preprocessing stack.
+dependency-free test envs, "Catch"/"Memory" the dependency-free LEARNABLE
+tasks (Memory requires a recurrent core — see MemoryChainEnv); anything
+else is treated as a gymnasium Atari id and gets the DeepMind
+preprocessing stack.
 """
 
 from torchbeast_tpu.envs.environment import Environment  # noqa: F401
 from torchbeast_tpu.envs.mock import (  # noqa: F401
     CatchEnv,
     CountingEnv,
+    MemoryChainEnv,
     MockEnv,
 )
 
@@ -29,6 +32,8 @@ def create_env(name: str, **kwargs):
         return CountingEnv(**kwargs)
     if name == "Catch":
         return CatchEnv(**kwargs)
+    if name == "Memory":
+        return MemoryChainEnv(**kwargs)
     from torchbeast_tpu.envs.atari import create_atari_env
 
     return create_atari_env(name, **kwargs)

@@ -94,3 +94,70 @@ class CountingEnv:
         done = self._t >= self.episode_length
         frame = np.full(self.frame_shape, self._t, dtype=np.uint8)
         return frame, float(self._t), done
+
+
+class MemoryChainEnv:
+    """T-maze memory probe: a binary cue is visible ONLY in the reset
+    frame, a featureless corridor follows, a distinct QUERY frame marks
+    the decision step, and the final action must reproduce the cue
+    (+1 / −1). Every pre-decision step demands the `forward` action
+    (2) — anything else costs −0.5.
+
+    Why it exists: Catch is solvable reactively, so a feed-forward
+    policy learning it proves nothing about the recurrent core. Here
+    nothing the decision-step policy can SEE correlates with the cue:
+    the query frame is cue-independent, reward before the decision
+    depends only on the agent's own compliance, and — the subtle leak —
+    the model's last-action input cannot be used as a relay (a₀ = cue,
+    then copy last action forward) because every relay step is a
+    non-forward action: with `length` = 6 a full relay chain costs
+    5 × 0.5 = 2.5, making relay return −1.5 < the 0 of honest play.
+    So a feed-forward policy caps at expected return ≈ 0 (forward
+    through the corridor, coin-flip at the query), while a recurrent
+    core that carries the cue across the unroll (the machinery the
+    reference's core_agent_state_test pins, monobeast.py:599-611)
+    reaches +1. The FF-vs-LSTM gap on this env is the direct functional
+    proof that --use_lstm carries memory.
+    """
+
+    FORWARD = 2
+
+    def __init__(self, length=6, seed=None):
+        if length < 3:
+            raise ValueError(
+                "length must be >= 3 (cue step + corridor + query)"
+            )
+        self.length = length
+        self.num_actions = 3  # 0/1 = answers, 2 = forward
+        # seed=None: OS entropy per instance so parallel actors see
+        # independent cue draws (pass a seed for determinism).
+        self._rng = np.random.default_rng(seed)
+        self._cue = 0
+        self._t = 0
+
+    def _frame(self):
+        # (4, 1, 1): rows 0/1 = cue indicators, 2 = corridor beacon,
+        # 3 = query beacon.
+        frame = np.zeros((4, 1, 1), np.uint8)
+        if self._t == 0:
+            frame[self._cue, 0, 0] = 255
+        elif self._t == self.length - 1:
+            frame[3, 0, 0] = 255
+        else:
+            frame[2, 0, 0] = 255
+        return frame
+
+    def reset(self):
+        self._cue = int(self._rng.integers(0, 2))
+        self._t = 0
+        return self._frame()
+
+    def step(self, action):
+        at_query = self._t == self.length - 1  # action answers the query
+        self._t += 1
+        done = self._t >= self.length
+        if at_query:
+            reward = 1.0 if int(action) == self._cue else -1.0
+        else:
+            reward = 0.0 if int(action) == self.FORWARD else -0.5
+        return self._frame(), reward, done
