@@ -62,6 +62,16 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       echo "overlap $mode rc=$?" >> "$OUT/watch.log"
     done
     echo "$(date -Is) capture COMPLETE" | tee -a "$OUT/watch.log"
+    # Persist the raw capture into the repo tree immediately: a fire in
+    # the round's last minutes must not strand the only chip evidence in
+    # /tmp (the driver's end-of-round commit picks up the working tree).
+    # JSON summaries, stderr, and driver logs only — the multi-MB xplane
+    # trace dirs stay in $OUT.
+    RAW="$REPO/benchmarks/artifacts/tpu_capture_raw"
+    mkdir -p "$RAW"
+    cp "$OUT"/*.json "$OUT"/*.err "$OUT"/*.log "$RAW/" 2>/dev/null
+    echo "$(date -Is) raw capture persisted to $RAW" >> "$OUT/watch.log"
+    cp "$OUT/watch.log" "$RAW/" 2>/dev/null
     exit 0
   fi
   echo "$(date -Is) tunnel down" >> "$OUT/watch.log"
