@@ -148,3 +148,21 @@ def test_prewarm_inference(tmp_path, caplog):
     assert any(
         "Prewarmed 3 inference buckets" in r.message for r in caplog.records
     ), [r.message for r in caplog.records][:20]
+
+
+@pytest.mark.slow
+def test_poly_lstm_solves_memory_env(tmp_path):
+    """The async stack's agent-state path (per-actor state through the
+    DynamicBatcher + rollout re-pairing) must carry memory end-to-end:
+    the Memory probe is unsolvable without it (see MemoryChainEnv and
+    benchmarks/artifacts/lstm_learning.md §2b; pilot hit +0.99 by ~19k
+    steps, sustained 1.0 to 150k)."""
+    flags = make_flags(
+        tmp_path, xpid="poly-mem-lstm", env="Memory", model="mlp",
+        use_lstm=True, num_servers="8", num_actors="16",
+        batch_size="16", unroll_length="20", total_steps="80000",
+        learning_rate="1e-3", entropy_cost="0.01",
+        max_inference_batch_size="16",
+    )
+    stats = polybeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.6
