@@ -112,3 +112,62 @@ def test_anakin_data_parallel(tmp_path):
     )
     assert stats["step"] >= 10_000
     assert np.isfinite(stats["total_loss"])
+
+
+class TestMemoryJax:
+    def test_parity_with_host_env(self):
+        """MemoryChainJax is a rule-for-rule twin of the host
+        MemoryChainEnv: identical frames, rewards, and done flags for
+        the same cue and action script (honest, relay, and mixed)."""
+        from torchbeast_tpu.envs.jax_env import MemoryChainJax, MemoryState
+        from torchbeast_tpu.envs.mock import MemoryChainEnv
+
+        fwd = MemoryChainJax.FORWARD
+        for cue in (0, 1):
+            scripts = [
+                [fwd, fwd, fwd, fwd, fwd, cue],       # honest solve
+                [cue, cue, cue, cue, cue, cue],       # full relay (taxed)
+                [0, 1, fwd, 0, fwd, 1 - cue],         # mixed + wrong answer
+            ]
+            for script in scripts:
+                host = MemoryChainEnv(length=6, seed=0)
+                host.reset()
+                host._cue = cue  # force the drawn cue for parity
+                jenv = MemoryChainJax(length=6)
+                state = MemoryState(
+                    cue=jnp.int32(cue), t=jnp.int32(0),
+                    key=jax.random.PRNGKey(0),
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(jenv.observe(state)), host._frame()
+                )
+                for a in script:
+                    state, jframe, jr, jd = jenv.step(state, jnp.int32(a))
+                    hframe, hr, hd = host.step(a)
+                    assert float(jr) == hr, (cue, script, a)
+                    assert bool(jd) == hd
+                    np.testing.assert_array_equal(
+                        np.asarray(jframe), hframe
+                    )
+
+
+@pytest.mark.slow
+def test_anakin_lstm_solves_memory(tmp_path):
+    """On-device recurrent state carry (lax.scan carry through the fused
+    env+policy+update program): the Memory probe is unsolvable without
+    it. Pilot: LSTM at +1.0 by the first log point (154k steps); FF
+    oscillates around its cap of 0 for 3M steps
+    (benchmarks/artifacts/lstm_learning.md §2c)."""
+    lstm = run_anakin(
+        tmp_path, total_steps=1_000_000, xpid="anakin-mem-lstm",
+        env="Memory", use_lstm=True, batch_size="64",
+        unroll_length="12", learning_rate="1e-3",
+        log_interval_updates="100",
+    )
+    assert lstm.get("mean_episode_return", -1.0) > 0.6
+    ff = run_anakin(
+        tmp_path, total_steps=1_000_000, xpid="anakin-mem-ff",
+        env="Memory", batch_size="64", unroll_length="12",
+        learning_rate="1e-3", log_interval_updates="100",
+    )
+    assert ff.get("mean_episode_return", 1.0) < 0.5

@@ -76,6 +76,64 @@ class CatchJax:
         return frame[..., None]
 
 
+class MemoryState(NamedTuple):
+    cue: jnp.ndarray  # i32 in {0, 1}
+    t: jnp.ndarray  # i32 step within the episode
+    key: jnp.ndarray
+
+
+class MemoryChainJax:
+    """Jittable twin of envs/mock.py:MemoryChainEnv (same rules, same
+    frame layout): cue visible only at t=0, corridor demands the
+    `forward` action (−0.5 otherwise, which breaks the last-action
+    relay), a distinct query frame at t=length−1, and the query action
+    must reproduce the cue (+1/−1). Branch-free; solvable only by a
+    recurrent core — the on-device probe for anakin's `--use_lstm`
+    state carry (see benchmarks/artifacts/lstm_learning.md)."""
+
+    FORWARD = 2
+
+    def __init__(self, length: int = 6):
+        if length < 3:
+            raise ValueError(
+                "length must be >= 3 (cue step + corridor + query)"
+            )
+        self.length = length
+        self.num_actions = 3  # 0/1 = answers, 2 = forward
+        self.frame_shape = (4, 1, 1)
+
+    def reset(self, key) -> MemoryState:
+        key, sub = jax.random.split(key)
+        cue = jax.random.randint(sub, (), 0, 2)
+        return MemoryState(
+            cue=cue.astype(jnp.int32), t=jnp.int32(0), key=key
+        )
+
+    def observe(self, state: MemoryState):
+        # Rows 0/1 = cue indicators (t == 0), 2 = corridor beacon,
+        # 3 = query beacon (t == length − 1).
+        row = jnp.where(
+            state.t == 0,
+            state.cue,
+            jnp.where(state.t == self.length - 1, 3, 2),
+        )
+        frame = jnp.zeros((4,), jnp.uint8).at[row].set(255)
+        return frame.reshape(self.frame_shape)
+
+    def step(self, state: MemoryState, action):
+        action = action.astype(jnp.int32)
+        at_query = state.t == self.length - 1
+        t = state.t + 1
+        done = t >= self.length
+        reward = jnp.where(
+            at_query,
+            jnp.where(action == state.cue, 1.0, -1.0),
+            jnp.where(action == self.FORWARD, 0.0, -0.5),
+        ).astype(jnp.float32)
+        new_state = MemoryState(cue=state.cue, t=t, key=state.key)
+        return new_state, self.observe(new_state), reward, done
+
+
 class AccountedState(NamedTuple):
     env_state: Any
     episode_return: jnp.ndarray
@@ -140,6 +198,7 @@ class JaxEnvironment:
 
 _JAX_ENVS = {
     "Catch": CatchJax,
+    "Memory": MemoryChainJax,
 }
 
 
