@@ -166,3 +166,21 @@ def test_poly_lstm_solves_memory_env(tmp_path):
     )
     stats = polybeast.train(flags)
     assert stats.get("mean_episode_return", -1.0) > 0.6
+
+
+def test_failed_validation_reaps_servers(tmp_path):
+    """A post-spawn failure (here: a flag-validation raise) must reap
+    the just-spawned env-server group — terminate-without-join used to
+    strand spawn-context children as orphans (ppid 1) after every
+    validation-failure run."""
+    import multiprocessing as mp
+
+    before = {p.pid for p in mp.active_children()}
+    flags = make_flags(tmp_path, xpid="leak-check", tensor_parallel="2")
+    with pytest.raises(ValueError, match="tensor_parallel"):
+        polybeast.train(flags)
+    # Order-independent: only processes spawned BY this train call count.
+    leftovers = [
+        p for p in mp.active_children() if p.pid not in before
+    ]
+    assert not leftovers, [p.pid for p in leftovers]

@@ -104,4 +104,13 @@ class ProcessEnvPool:
         for proc in self._procs:
             proc.join(timeout=5)
             if proc.is_alive():
+                # Full escalation (terminate -> join -> kill -> join):
+                # terminate-without-join strands spawn-context children
+                # when SIGTERM lands mid-bootstrap and leaves zombies
+                # otherwise — the same reaping contract as polybeast's
+                # _reap_servers.
                 proc.terminate()
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5)

@@ -657,66 +657,74 @@ def train(flags):
     act_step = learner_lib.make_act_step(model)
 
     pool = _make_pool(flags, B)
-    rng = jax.random.PRNGKey(flags.seed + 2)
+    # A failure between the pool spawn and the main try/finally
+    # (collector priming, closure setup) must not leak the env
+    # worker processes — same reaping contract as polybeast's
+    # server group.
+    try:
+        rng = jax.random.PRNGKey(flags.seed + 2)
 
-    # Mutable cell so the policy closure always samples with fresh rng.
-    rng_cell = [rng]
+        # Mutable cell so the policy closure always samples with fresh rng.
+        rng_cell = [rng]
 
-    def policy(env_output, agent_state):
-        rng_cell[0], key = jax.random.split(rng_cell[0])
-        model_inputs = {
-            k: env_output[k]
-            for k in ("frame", "reward", "done", "last_action")
-        }
-        out, new_state = act_step(params_cell[0], key, model_inputs, agent_state)
-        return jax.device_get(out), new_state
+        def policy(env_output, agent_state):
+            rng_cell[0], key = jax.random.split(rng_cell[0])
+            model_inputs = {
+                k: env_output[k]
+                for k in ("frame", "reward", "done", "last_action")
+            }
+            out, new_state = act_step(params_cell[0], key, model_inputs, agent_state)
+            return jax.device_get(out), new_state
 
-    params_cell = [params]
-    collector = RolloutCollector(
-        pool, policy, model.initial_state(B), unroll_length=T
-    )
+        params_cell = [params]
+        collector = RolloutCollector(
+            pool, policy, model.initial_state(B), unroll_length=T
+        )
 
-    timings = Timings()
-    last_checkpoint_time = time.time()
-    last_log_time = time.time()
-    last_log_step = step
+        timings = Timings()
+        last_checkpoint_time = time.time()
+        last_log_time = time.time()
+        last_log_step = step
 
-    if flags.profile_dir:
-        jax.profiler.start_trace(flags.profile_dir)
+        if flags.profile_dir:
+            jax.profiler.start_trace(flags.profile_dir)
 
-    # One-iteration-delayed stats fetch: updates for unroll k are
-    # DISPATCHED (async) and the host immediately starts collecting
-    # unroll k+1; the blocking device_get of k's stats happens after
-    # k+1's work is underway. What overlaps beyond that depends on the
-    # policy-lag choice:
-    # - default (zero lag): the first act of unroll k+1 data-depends on
-    #   the updated params, so its device_get blocks until the update
-    #   chain finishes — only the stats fetch is truly overlapped. This
-    #   is a deliberate on-policy guarantee the reference does not have.
-    # - --overlap_collect: acting adopts the chain head only after a
-    #   full collect has passed since its dispatch, so the update chain
-    #   always hides behind env stepping and no act ever blocks on it.
-    #   The acting params trail the learner head by one dispatched
-    #   unroll-batch — still strictly tighter than the reference, whose
-    #   actors lag by queue depth (SURVEY.md, actorpool backpressure).
-    pending = None  # (list of device stats, step after those updates)
-    latest_params = params_cell[0]  # head of the update chain
+        # One-iteration-delayed stats fetch: updates for unroll k are
+        # DISPATCHED (async) and the host immediately starts collecting
+        # unroll k+1; the blocking device_get of k's stats happens after
+        # k+1's work is underway. What overlaps beyond that depends on the
+        # policy-lag choice:
+        # - default (zero lag): the first act of unroll k+1 data-depends on
+        #   the updated params, so its device_get blocks until the update
+        #   chain finishes — only the stats fetch is truly overlapped. This
+        #   is a deliberate on-policy guarantee the reference does not have.
+        # - --overlap_collect: acting adopts the chain head only after a
+        #   full collect has passed since its dispatch, so the update chain
+        #   always hides behind env stepping and no act ever blocks on it.
+        #   The acting params trail the learner head by one dispatched
+        #   unroll-batch — still strictly tighter than the reference, whose
+        #   actors lag by queue depth (SURVEY.md, actorpool backpressure).
+        pending = None  # (list of device stats, step after those updates)
+        latest_params = params_cell[0]  # head of the update chain
 
-    def flush_stats(pending_entry):
-        device_stats, at_step = pending_entry
-        sub_stats = jax.device_get(device_stats)  # one batched transfer
-        agg = {}
-        for key in sub_stats[0]:
-            vals = [float(s[key]) for s in sub_stats]
-            if key in ("episode_returns_sum", "episode_count"):
-                agg[key] = sum(vals)
-            else:
-                agg[key] = sum(vals) / len(vals)
-        out = learner_lib.episode_stat_postprocess(agg)
-        out["step"] = at_step
-        plogger.log(out)
-        return out
+        def flush_stats(pending_entry):
+            device_stats, at_step = pending_entry
+            sub_stats = jax.device_get(device_stats)  # one batched transfer
+            agg = {}
+            for key in sub_stats[0]:
+                vals = [float(s[key]) for s in sub_stats]
+                if key in ("episode_returns_sum", "episode_count"):
+                    agg[key] = sum(vals)
+                else:
+                    agg[key] = sum(vals) / len(vals)
+            out = learner_lib.episode_stat_postprocess(agg)
+            out["step"] = at_step
+            plogger.log(out)
+            return out
 
+    except BaseException:
+        pool.close()
+        raise
     try:
         while step < flags.total_steps:
             timings.reset()

@@ -187,6 +187,20 @@ def make_parser():
     return parser
 
 
+def _reap_servers(procs):
+    """Terminate, join (bounded), then kill a spawned env-server group.
+    Terminate-without-join strands spawn-context children when SIGTERM
+    lands mid-bootstrap (observed: orphaned `spawn_main` processes after
+    validation-failure runs) and leaves zombies otherwise."""
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=5)
+
+
 def train(flags):
     from torchbeast_tpu.parallel import initialize_distributed
 
@@ -251,490 +265,503 @@ def train(flags):
         for i in range(num_actors)
     ]
 
+    # Any failure from the instant the server group exists until the
+    # main try/finally below takes over (the settle sleep, flag
+    # validation, env-spec probe, model/mesh construction) must not
+    # leak the just-spawned processes — observed as orphaned
+    # spawn-context children after validation-failure tests. Even a
+    # KeyboardInterrupt during the settle sleep reaps them.
     server_procs = []
-    if flags.start_servers:
-        server_procs = polybeast_env.start_servers(
-            flags, pipes_basename=pipes_basename
-        )
-        time.sleep(0.5)
-
-    hp = hparams_from_flags(flags)
-    num_actions, frame_shape, frame_dtype = _probe_env_via_server(
-        flags, addresses[0]
-    )
-
-    # Composite (data x expert|seq) mesh: built BEFORE the model so the
-    # MoE sharding constraints / attention shard_maps and the jitted
-    # update step reference the SAME mesh. The inner axis is innermost —
-    # its collectives stay within a data-parallel replica group.
-    expert_par = getattr(flags, "expert_parallel", 0)
-    seq_par = flags.sequence_parallel
-    tensor_par = getattr(flags, "tensor_parallel", 0)
-    if tensor_par > 1:
-        if flags.model != "transformer":
-            raise ValueError(
-                "--tensor_parallel needs --model transformer (the "
-                "Megatron pairing targets its projection/FFN layout)"
+    try:
+        if flags.start_servers:
+            server_procs = polybeast_env.start_servers(
+                flags, pipes_basename=pipes_basename
             )
-        if seq_par > 1 or getattr(flags, "pipeline_parallel", 0) > 1:
-            raise ValueError(
-                "--tensor_parallel composes with --num_learner_devices "
-                "and --expert_parallel, not with --sequence_parallel or "
-                "--pipeline_parallel (their shard_maps leave the "
-                "`model` axis unmentioned, which would force gathers of "
-                "the head-sharded projections every layer)"
-            )
-    pipe_par = getattr(flags, "pipeline_parallel", 0)
-    learner_mesh = None
-    if flags.num_learner_devices > 1 or tensor_par > 1:
-        from torchbeast_tpu.parallel import create_mesh
+            time.sleep(0.5)
 
-        inner = (
-            max(1, expert_par) * max(1, seq_par) * max(1, tensor_par)
-            * max(1, pipe_par)
-        )
-        learner_mesh = create_mesh(
-            flags.num_learner_devices * inner,
-            model_parallelism=max(1, tensor_par),
-            expert_parallelism=max(1, expert_par),
-            seq_parallelism=max(1, seq_par),
-            pipe_parallelism=max(1, pipe_par),
+        hp = hparams_from_flags(flags)
+        num_actions, frame_shape, frame_dtype = _probe_env_via_server(
+            flags, addresses[0]
         )
 
-    model, params = _init_model_and_params(
-        flags, num_actions, flags.batch_size, frame_shape, frame_dtype,
-        moe_mesh=learner_mesh if expert_par > 1 else None,
-        seq_mesh=learner_mesh if seq_par > 1 else None,
-        pipe_mesh=(
-            learner_mesh
-            if pipe_par > 1 and learner_mesh is not None
-            else None
-        ),
-    )
-    optimizer = learner_lib.make_optimizer(hp)
-    opt_state = optimizer.init(params)
-
-    step = 0
-    stats = {}
-    if os.path.exists(checkpoint_path):
-        restored = load_checkpoint(
-            checkpoint_path,
-            params_template=params,
-            opt_state_template=opt_state,
-        )
-        params, opt_state = restored["params"], restored["opt_state"]
-        step = restored["step"]
-        stats = restored["stats"]
-        log.info("Resuming preempted job, current stats:\n%s", stats)
-    if proc_count > 1:
-        # Hosts that restore different checkpoints (savedir not shared, or
-        # a file visible only to the lead) would silently all-reduce
-        # gradients from different params and then hang at shutdown when
-        # their update counts diverge. Fail loudly at startup instead.
-        from jax.experimental import multihost_utils
-
-        sumsq = sum(
-            float(np.square(np.asarray(leaf, np.float64)).sum())
-            for leaf in jax.tree_util.tree_leaves(params)
-        )
-        fingerprint = np.asarray([float(step), sumsq], np.float64)
-        gathered = multihost_utils.process_allgather(fingerprint)
-        if not np.allclose(gathered, gathered[0], rtol=1e-9):
-            raise RuntimeError(
-                "Hosts restored inconsistent checkpoints "
-                f"(step/param fingerprints {gathered.tolist()}); the "
-                "savedir must be a shared filesystem so every host "
-                "resumes the lead's checkpoint."
-            )
-
-    # donate="opt_only": params stay undonated (inference threads hold
-    # live references), but opt_state buffers alias the new opt_state in
-    # place — donation's HBM savings on the optimizer without invalidating
-    # an in-flight act dispatch. Requires update dispatch and checkpoint
-    # reads of opt_state to be serialized (donation_lock, below).
-    mesh = learner_mesh
-    if learner_mesh is not None:
-        from torchbeast_tpu.parallel import (
-            make_parallel_update_step,
-            replicate,
-            shard_batch,
-        )
-
-        if flags.batch_size % flags.num_learner_devices != 0:
-            raise ValueError(
-                f"batch_size {flags.batch_size} not divisible by "
-                f"num_learner_devices {flags.num_learner_devices}"
-            )
-        # Param/opt sharding rules: EP shards the MoE expert kernels, TP
-        # the attention/dense-FFN leaves — disjoint sets, merged onto
-        # one tree when both are active. optax state mirrors the params
-        # leaf-wise (same key paths at the leaves), so each rule applies
-        # to it unchanged. Explicit placement is REQUIRED: opt_state is
-        # donated, and donation needs input placement == output sharding.
-        rules = []
-        if expert_par > 1:
-            from torchbeast_tpu.parallel import expert_param_shardings
-
-            rules.append(expert_param_shardings)
+        # Composite (data x expert|seq) mesh: built BEFORE the model so the
+        # MoE sharding constraints / attention shard_maps and the jitted
+        # update step reference the SAME mesh. The inner axis is innermost —
+        # its collectives stay within a data-parallel replica group.
+        expert_par = getattr(flags, "expert_parallel", 0)
+        seq_par = flags.sequence_parallel
+        tensor_par = getattr(flags, "tensor_parallel", 0)
         if tensor_par > 1:
-            from torchbeast_tpu.parallel import transformer_tp_shardings
-
-            rules.append(transformer_tp_shardings)
-        param_shardings = opt_shardings = None
-        if rules:
-            from torchbeast_tpu.parallel import merge_param_shardings
-
-            param_shardings = merge_param_shardings(
-                *(rule(mesh, params) for rule in rules)
-            )
-            opt_shardings = merge_param_shardings(
-                *(rule(mesh, opt_state) for rule in rules)
-            )
-        update_step = make_parallel_update_step(
-            model, optimizer, hp, mesh, donate="opt_only",
-            param_shardings=param_shardings,
-            opt_shardings=opt_shardings,
-        )
-        if param_shardings is None:
-            params = replicate(mesh, params)
-            opt_state = replicate(mesh, opt_state)
-        else:
-            params = jax.tree_util.tree_map(
-                jax.device_put, params, param_shardings
-            )
-            opt_state = jax.tree_util.tree_map(
-                jax.device_put, opt_state, opt_shardings
-            )
-        shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
-        inner_desc = (
-            (f" x model={tensor_par}" if tensor_par > 1 else "")
-            + (f" x expert={expert_par}" if expert_par > 1 else "")
-            + (f" x seq={seq_par}" if seq_par > 1 else "")
-        )
-        log.info(
-            "Parallel learner: data=%d%s (%d chips total, %d processes)",
-            flags.num_learner_devices, inner_desc,
-            flags.num_learner_devices * inner, proc_count,
-        )
-    else:
-        update_step = learner_lib.make_update_step(
-            model, optimizer, hp, donate="opt_only"
-        )
-        shard = None
-    act_model = model
-    if proc_count > 1 and (
-        expert_par > 1 or seq_par > 1 or pipe_par > 1
-    ):
-        # The learner model's MoE constraints / attention shard_maps
-        # reference the GLOBAL mesh; a host-local inference jit cannot
-        # touch non-addressable devices. Acting uses an unmeshed twin —
-        # identical flags and param tree, no mesh bindings (meshes only
-        # select compute paths, never parameters).
-        act_model, _ = _init_model_and_params(
-            flags, num_actions, flags.batch_size, frame_shape,
-            frame_dtype, unmeshed=True, init_params=False,
-        )
-    act_step = learner_lib.make_act_step(act_model)
-
-    infer_device = jax.local_devices()[0]
-
-    def local_view(tree, device=None):
-        """Host-local full-value view of a global pytree. Multi-host
-        inference and checkpointing must not hand jit/np a global array
-        spanning non-addressable devices, so:
-
-        - replicated leaves: this host's replica, zero-copy
-          (addressable_data shares the device buffer);
-        - leaves sharded over an INNER mesh axis (expert/model — the
-          mesh nests those inside the cross-host data axis, so every
-          shard index is present on this host's local devices): the
-          full value is assembled from addressable shards, no
-          cross-process communication (this must stay collective-free:
-          checkpointing calls it on the lead host only).
-
-        `device`: placement for assembled leaves — the inference rebind
-        passes the local device (one H2D per rebind instead of one per
-        act call); the checkpoint path leaves them on host (the
-        serializer would only copy them straight back).
-        """
-        if proc_count == 1:
-            return tree
-
-        def view(a):
-            if a.sharding.is_fully_replicated:
-                return a.addressable_data(0)
-            out = np.empty(a.shape, a.dtype)
-            covered = 0
-            seen = set()
-            for sh in a.addressable_shards:
-                key = str(sh.index)
-                if key in seen:  # data-axis replicas repeat the index
-                    continue
-                seen.add(key)
-                piece = np.asarray(sh.data)
-                out[sh.index] = piece
-                covered += piece.size
-            if covered != a.size:
+            if flags.model != "transformer":
                 raise ValueError(
-                    "local_view: leaf sharded ACROSS processes "
-                    f"(host covers {covered}/{a.size} elements); inner "
-                    "parallel axes must nest inside the data axis "
-                    "(parallel/mesh.py) for host-local inference and "
-                    "checkpointing"
+                    "--tensor_parallel needs --model transformer (the "
+                    "Megatron pairing targets its projection/FFN layout)"
                 )
-            return jax.device_put(out, device) if device is not None else out
+            if seq_par > 1 or getattr(flags, "pipeline_parallel", 0) > 1:
+                raise ValueError(
+                    "--tensor_parallel composes with --num_learner_devices "
+                    "and --expert_parallel, not with --sequence_parallel or "
+                    "--pipeline_parallel (their shard_maps leave the "
+                    "`model` axis unmentioned, which would force gathers of "
+                    "the head-sharded projections every layer)"
+                )
+        pipe_par = getattr(flags, "pipeline_parallel", 0)
+        learner_mesh = None
+        if flags.num_learner_devices > 1 or tensor_par > 1:
+            from torchbeast_tpu.parallel import create_mesh
 
-        return jax.tree_util.tree_map(view, tree)
-
-    # Shared mutable state: the learner rebinds these; inference reads them.
-    state = {
-        "params": params,
-        "infer_params": local_view(params, device=infer_device),
-        "opt_state": opt_state,
-        "step": step,
-        "stats": dict(stats),
-        "rng": jax.random.PRNGKey(flags.seed + proc_id),
-        "done": False,
-    }
-    state_lock = threading.Lock()
-    # Serializes update-step dispatch (which invalidates donated opt_state
-    # buffers) against checkpoint reads of opt_state. Deliberately separate
-    # from state_lock so the inference hot path never waits on a dispatch.
-    donation_lock = threading.Lock()
-
-    if flags.native_runtime:
-        from torchbeast_tpu.runtime.native import import_native
-
-        core = import_native()
-        if core is None:
-            raise RuntimeError(
-                "--native_runtime requested but _tbt_core is not built; "
-                "run scripts/build_native.sh"
+            inner = (
+                max(1, expert_par) * max(1, seq_par) * max(1, tensor_par)
+                * max(1, pipe_par)
             )
-        queue_mod = core
-        log.info("Using native (C++) runtime")
-    else:
-        import torchbeast_tpu.runtime as queue_mod
-
-    # Each host's queue batches its LOCAL rows; shard_batch assembles the
-    # global array across hosts (local_rows == batch_size single-host).
-    learner_queue = queue_mod.BatchingQueue(
-        batch_dim=1,
-        minimum_batch_size=local_rows,
-        maximum_batch_size=local_rows,
-        maximum_queue_size=flags.max_learner_queue_size or local_rows,
-        check_inputs=True,
-    )
-    inference_batcher = queue_mod.DynamicBatcher(
-        batch_dim=1,
-        minimum_batch_size=1,
-        maximum_batch_size=flags.max_inference_batch_size,
-        timeout_ms=flags.inference_timeout_ms,
-    )
-
-    def act_fn(env_outputs, agent_state, batch_size):
-        """Bucket-static jitted forward. Called CONCURRENTLY from every
-        inference thread (no global lock — see the measurement note at
-        the thread setup): any shared state touched here must stay under
-        state_lock."""
-        with state_lock:
-            params_now = state["infer_params"]
-            state["rng"], key = jax.random.split(state["rng"])
-        model_inputs = {
-            k: env_outputs[k]
-            for k in ("frame", "reward", "done", "last_action")
-        }
-        # act_step consumes [B, ...] (adds T=1 itself); inputs are [1, B].
-        model_inputs = {k: v[0] for k, v in model_inputs.items()}
-        out, new_state = act_step(params_now, key, model_inputs, agent_state)
-        out = {
-            "action": np.asarray(out.action)[None],
-            "policy_logits": np.asarray(out.policy_logits)[None],
-            "baseline": np.asarray(out.baseline)[None],
-        }
-        return out, new_state
-
-    # No global inference lock (unlike reference polybeast_learner.py:269):
-    # act_fn is a pure jitted call whose shared state access is already
-    # synchronized, so concurrent threads overlap their host-side pad/
-    # dispatch/device-sync work. Measured on 32 actors x 2 threads:
-    # +27% steps/s (python runtime) / +18% (native), p99 latency -20-35%
-    # (benchmarks/inference_bench.py, artifacts/inference_lock_decision.md).
-    if flags.prewarm_inference:
-        t0 = time.time()
-        buckets = default_buckets(flags.max_inference_batch_size)
-        for b in buckets:
-            dummy_env = dummy_env_outputs(1, b, frame_shape, frame_dtype)
-            dummy_state = jax.tree_util.tree_map(
-                np.asarray, act_model.initial_state(b)
+            learner_mesh = create_mesh(
+                flags.num_learner_devices * inner,
+                model_parallelism=max(1, tensor_par),
+                expert_parallelism=max(1, expert_par),
+                seq_parallelism=max(1, seq_par),
+                pipe_parallelism=max(1, pipe_par),
             )
-            act_fn(dummy_env, dummy_state, b)
-        log.info(
-            "Prewarmed %d inference buckets in %.1fs",
-            len(buckets), time.time() - t0,
-        )
 
-    inference_threads = [
-        threading.Thread(
-            target=inference_loop,
-            args=(
-                inference_batcher,
-                act_fn,
-                flags.max_inference_batch_size,
+        model, params = _init_model_and_params(
+            flags, num_actions, flags.batch_size, frame_shape, frame_dtype,
+            moe_mesh=learner_mesh if expert_par > 1 else None,
+            seq_mesh=learner_mesh if seq_par > 1 else None,
+            pipe_mesh=(
+                learner_mesh
+                if pipe_par > 1 and learner_mesh is not None
+                else None
             ),
-            # Pipelined dispatch only with a single consumer thread: its
-            # held-reply optimization is unsafe with several threads
-            # draining one batcher (runtime/inference.py docstring);
-            # with >1 threads the overlap comes from the threads.
-            kwargs={
-                "lock": None,
-                "pipelined": flags.num_inference_threads == 1,
-            },
-            daemon=True,
-            name=f"inference-{i}",
         )
-        for i in range(flags.num_inference_threads)
-    ]
+        optimizer = learner_lib.make_optimizer(hp)
+        opt_state = optimizer.init(params)
 
-    pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
-    actors = pool_cls(
-        unroll_length=flags.unroll_length,
-        learner_queue=learner_queue,
-        inference_batcher=inference_batcher,
-        env_server_addresses=addresses,
-        initial_agent_state=model.initial_state(1),
-        max_reconnects=flags.max_actor_reconnects,
-    )
-    actor_thread = threading.Thread(
-        target=actors.run, daemon=True, name="actorpool"
-    )
-
-    timings = Timings()
-
-    # Host->HBM prefetch (SURVEY §7 hard part #3): a double-buffered stage
-    # between the learner queue and the learner thread. device_put (and
-    # the DP shard placement) is async, so by the time the learner pulls
-    # an item its transfer is already riding behind the previous update's
-    # compute instead of stalling dispatch.
-    prefetch_q = stdlib_queue.Queue(maxsize=2)
-
-    def prefetch_loop():
-        try:
-            for item in learner_queue:
-                batch = item["batch"]
-                initial_agent_state = item["initial_agent_state"]
-                if shard is not None:
-                    batch, initial_agent_state = shard(
-                        batch, initial_agent_state
-                    )
-                else:
-                    batch = jax.device_put(batch)
-                    initial_agent_state = jax.device_put(initial_agent_state)
-                entry = (batch, initial_agent_state)
-                while True:
-                    try:
-                        prefetch_q.put(entry, timeout=1.0)
-                        break
-                    except stdlib_queue.Full:
-                        with state_lock:
-                            if state["done"]:
-                                return
-        except Exception:
-            log.exception("Prefetch thread failed")
-        # No end-sentinel put: the queue may be full of live items the
-        # learner still wants; the learner detects the end by this thread
-        # having exited with the queue drained.
-
-    prefetch_thread = threading.Thread(
-        target=prefetch_loop, daemon=True, name="prefetch"
-    )
-
-    def learner_loop():
-        try:
-            _learner_loop_body()
-        finally:
-            # Always mark done — an async XLA error surfacing in the
-            # delayed flush must stop the monitor loop, not wedge it.
-            with state_lock:
-                state["done"] = True
-
-    def _learner_loop_body():
-        # One-step-delayed stats fetch: device_get on the PREVIOUS update's
-        # stats happens after the current one is dispatched, so the host
-        # never stalls XLA's async pipeline (the reference's equivalent
-        # overlap came from extra learner threads + a lock).
-        pending = None  # (device_stats, step_after_that_update)
-
-        def flush(pending_entry):
-            device_stats, at_step = pending_entry
-            s = learner_lib.episode_stat_postprocess(
-                jax.device_get(device_stats)
+        step = 0
+        stats = {}
+        if os.path.exists(checkpoint_path):
+            restored = load_checkpoint(
+                checkpoint_path,
+                params_template=params,
+                opt_state_template=opt_state,
             )
-            s["step"] = at_step
-            s["learner_queue_size"] = learner_queue.size()
-            with state_lock:
-                state["stats"] = s
-            plogger.log(s)
+            params, opt_state = restored["params"], restored["opt_state"]
+            step = restored["step"]
+            stats = restored["stats"]
+            log.info("Resuming preempted job, current stats:\n%s", stats)
+        if proc_count > 1:
+            # Hosts that restore different checkpoints (savedir not shared, or
+            # a file visible only to the lead) would silently all-reduce
+            # gradients from different params and then hang at shutdown when
+            # their update counts diverge. Fail loudly at startup instead.
+            from jax.experimental import multihost_utils
 
-        while True:
-            # reset BEFORE blocking so 'dequeue' measures the actual wait
-            # for a prefetched batch (actor starvation shows up here).
-            timings.reset()
-            try:
-                batch, initial_agent_state = prefetch_q.get(timeout=1.0)
-            except stdlib_queue.Empty:
-                if not prefetch_thread.is_alive():
-                    break
-                continue
-            timings.time("dequeue")
-            # Dispatch under donation_lock (NOT state_lock): opt_state is
-            # donated, so the dispatch that invalidates the old opt
-            # buffers must not race a checkpoint's device_get of them —
-            # but dispatch can block behind in-flight compute, and holding
-            # state_lock here would stall every inference thread's params
-            # read for that long. Checkpointing takes donation_lock first.
-            with donation_lock:
-                with state_lock:
-                    params_now, opt_now = state["params"], state["opt_state"]
-                new_params, new_opt, train_stats = update_step(
-                    params_now, opt_now, batch, initial_agent_state
+            sumsq = sum(
+                float(np.square(np.asarray(leaf, np.float64)).sum())
+                for leaf in jax.tree_util.tree_leaves(params)
+            )
+            fingerprint = np.asarray([float(step), sumsq], np.float64)
+            gathered = multihost_utils.process_allgather(fingerprint)
+            if not np.allclose(gathered, gathered[0], rtol=1e-9):
+                raise RuntimeError(
+                    "Hosts restored inconsistent checkpoints "
+                    f"(step/param fingerprints {gathered.tolist()}); the "
+                    "savedir must be a shared filesystem so every host "
+                    "resumes the lead's checkpoint."
                 )
-                # Build the host view OUTSIDE state_lock: for multi-host
-                # sharded params this blocks on the dispatched compute +
-                # D2H/H2D, and holding the lock for that long would stall
-                # every inference thread's params read.
-                infer_view = local_view(new_params, device=infer_device)
+
+        # donate="opt_only": params stay undonated (inference threads hold
+        # live references), but opt_state buffers alias the new opt_state in
+        # place — donation's HBM savings on the optimizer without invalidating
+        # an in-flight act dispatch. Requires update dispatch and checkpoint
+        # reads of opt_state to be serialized (donation_lock, below).
+        mesh = learner_mesh
+        if learner_mesh is not None:
+            from torchbeast_tpu.parallel import (
+                make_parallel_update_step,
+                replicate,
+                shard_batch,
+            )
+
+            if flags.batch_size % flags.num_learner_devices != 0:
+                raise ValueError(
+                    f"batch_size {flags.batch_size} not divisible by "
+                    f"num_learner_devices {flags.num_learner_devices}"
+                )
+            # Param/opt sharding rules: EP shards the MoE expert kernels, TP
+            # the attention/dense-FFN leaves — disjoint sets, merged onto
+            # one tree when both are active. optax state mirrors the params
+            # leaf-wise (same key paths at the leaves), so each rule applies
+            # to it unchanged. Explicit placement is REQUIRED: opt_state is
+            # donated, and donation needs input placement == output sharding.
+            rules = []
+            if expert_par > 1:
+                from torchbeast_tpu.parallel import expert_param_shardings
+
+                rules.append(expert_param_shardings)
+            if tensor_par > 1:
+                from torchbeast_tpu.parallel import transformer_tp_shardings
+
+                rules.append(transformer_tp_shardings)
+            param_shardings = opt_shardings = None
+            if rules:
+                from torchbeast_tpu.parallel import merge_param_shardings
+
+                param_shardings = merge_param_shardings(
+                    *(rule(mesh, params) for rule in rules)
+                )
+                opt_shardings = merge_param_shardings(
+                    *(rule(mesh, opt_state) for rule in rules)
+                )
+            update_step = make_parallel_update_step(
+                model, optimizer, hp, mesh, donate="opt_only",
+                param_shardings=param_shardings,
+                opt_shardings=opt_shardings,
+            )
+            if param_shardings is None:
+                params = replicate(mesh, params)
+                opt_state = replicate(mesh, opt_state)
+            else:
+                params = jax.tree_util.tree_map(
+                    jax.device_put, params, param_shardings
+                )
+                opt_state = jax.tree_util.tree_map(
+                    jax.device_put, opt_state, opt_shardings
+                )
+            shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
+            inner_desc = (
+                (f" x model={tensor_par}" if tensor_par > 1 else "")
+                + (f" x expert={expert_par}" if expert_par > 1 else "")
+                + (f" x seq={seq_par}" if seq_par > 1 else "")
+            )
+            log.info(
+                "Parallel learner: data=%d%s (%d chips total, %d processes)",
+                flags.num_learner_devices, inner_desc,
+                flags.num_learner_devices * inner, proc_count,
+            )
+        else:
+            update_step = learner_lib.make_update_step(
+                model, optimizer, hp, donate="opt_only"
+            )
+            shard = None
+        act_model = model
+        if proc_count > 1 and (
+            expert_par > 1 or seq_par > 1 or pipe_par > 1
+        ):
+            # The learner model's MoE constraints / attention shard_maps
+            # reference the GLOBAL mesh; a host-local inference jit cannot
+            # touch non-addressable devices. Acting uses an unmeshed twin —
+            # identical flags and param tree, no mesh bindings (meshes only
+            # select compute paths, never parameters).
+            act_model, _ = _init_model_and_params(
+                flags, num_actions, flags.batch_size, frame_shape,
+                frame_dtype, unmeshed=True, init_params=False,
+            )
+        act_step = learner_lib.make_act_step(act_model)
+
+        infer_device = jax.local_devices()[0]
+
+        def local_view(tree, device=None):
+            """Host-local full-value view of a global pytree. Multi-host
+            inference and checkpointing must not hand jit/np a global array
+            spanning non-addressable devices, so:
+
+            - replicated leaves: this host's replica, zero-copy
+              (addressable_data shares the device buffer);
+            - leaves sharded over an INNER mesh axis (expert/model — the
+              mesh nests those inside the cross-host data axis, so every
+              shard index is present on this host's local devices): the
+              full value is assembled from addressable shards, no
+              cross-process communication (this must stay collective-free:
+              checkpointing calls it on the lead host only).
+
+            `device`: placement for assembled leaves — the inference rebind
+            passes the local device (one H2D per rebind instead of one per
+            act call); the checkpoint path leaves them on host (the
+            serializer would only copy them straight back).
+            """
+            if proc_count == 1:
+                return tree
+
+            def view(a):
+                if a.sharding.is_fully_replicated:
+                    return a.addressable_data(0)
+                out = np.empty(a.shape, a.dtype)
+                covered = 0
+                seen = set()
+                for sh in a.addressable_shards:
+                    key = str(sh.index)
+                    if key in seen:  # data-axis replicas repeat the index
+                        continue
+                    seen.add(key)
+                    piece = np.asarray(sh.data)
+                    out[sh.index] = piece
+                    covered += piece.size
+                if covered != a.size:
+                    raise ValueError(
+                        "local_view: leaf sharded ACROSS processes "
+                        f"(host covers {covered}/{a.size} elements); inner "
+                        "parallel axes must nest inside the data axis "
+                        "(parallel/mesh.py) for host-local inference and "
+                        "checkpointing"
+                    )
+                return jax.device_put(out, device) if device is not None else out
+
+            return jax.tree_util.tree_map(view, tree)
+
+        # Shared mutable state: the learner rebinds these; inference reads them.
+        state = {
+            "params": params,
+            "infer_params": local_view(params, device=infer_device),
+            "opt_state": opt_state,
+            "step": step,
+            "stats": dict(stats),
+            "rng": jax.random.PRNGKey(flags.seed + proc_id),
+            "done": False,
+        }
+        state_lock = threading.Lock()
+        # Serializes update-step dispatch (which invalidates donated opt_state
+        # buffers) against checkpoint reads of opt_state. Deliberately separate
+        # from state_lock so the inference hot path never waits on a dispatch.
+        donation_lock = threading.Lock()
+
+        if flags.native_runtime:
+            from torchbeast_tpu.runtime.native import import_native
+
+            core = import_native()
+            if core is None:
+                raise RuntimeError(
+                    "--native_runtime requested but _tbt_core is not built; "
+                    "run scripts/build_native.sh"
+                )
+            queue_mod = core
+            log.info("Using native (C++) runtime")
+        else:
+            import torchbeast_tpu.runtime as queue_mod
+
+        # Each host's queue batches its LOCAL rows; shard_batch assembles the
+        # global array across hosts (local_rows == batch_size single-host).
+        learner_queue = queue_mod.BatchingQueue(
+            batch_dim=1,
+            minimum_batch_size=local_rows,
+            maximum_batch_size=local_rows,
+            maximum_queue_size=flags.max_learner_queue_size or local_rows,
+            check_inputs=True,
+        )
+        inference_batcher = queue_mod.DynamicBatcher(
+            batch_dim=1,
+            minimum_batch_size=1,
+            maximum_batch_size=flags.max_inference_batch_size,
+            timeout_ms=flags.inference_timeout_ms,
+        )
+
+        def act_fn(env_outputs, agent_state, batch_size):
+            """Bucket-static jitted forward. Called CONCURRENTLY from every
+            inference thread (no global lock — see the measurement note at
+            the thread setup): any shared state touched here must stay under
+            state_lock."""
+            with state_lock:
+                params_now = state["infer_params"]
+                state["rng"], key = jax.random.split(state["rng"])
+            model_inputs = {
+                k: env_outputs[k]
+                for k in ("frame", "reward", "done", "last_action")
+            }
+            # act_step consumes [B, ...] (adds T=1 itself); inputs are [1, B].
+            model_inputs = {k: v[0] for k, v in model_inputs.items()}
+            out, new_state = act_step(params_now, key, model_inputs, agent_state)
+            out = {
+                "action": np.asarray(out.action)[None],
+                "policy_logits": np.asarray(out.policy_logits)[None],
+                "baseline": np.asarray(out.baseline)[None],
+            }
+            return out, new_state
+
+        # No global inference lock (unlike reference polybeast_learner.py:269):
+        # act_fn is a pure jitted call whose shared state access is already
+        # synchronized, so concurrent threads overlap their host-side pad/
+        # dispatch/device-sync work. Measured on 32 actors x 2 threads:
+        # +27% steps/s (python runtime) / +18% (native), p99 latency -20-35%
+        # (benchmarks/inference_bench.py, artifacts/inference_lock_decision.md).
+        if flags.prewarm_inference:
+            t0 = time.time()
+            buckets = default_buckets(flags.max_inference_batch_size)
+            for b in buckets:
+                dummy_env = dummy_env_outputs(1, b, frame_shape, frame_dtype)
+                dummy_state = jax.tree_util.tree_map(
+                    np.asarray, act_model.initial_state(b)
+                )
+                act_fn(dummy_env, dummy_state, b)
+            log.info(
+                "Prewarmed %d inference buckets in %.1fs",
+                len(buckets), time.time() - t0,
+            )
+
+        inference_threads = [
+            threading.Thread(
+                target=inference_loop,
+                args=(
+                    inference_batcher,
+                    act_fn,
+                    flags.max_inference_batch_size,
+                ),
+                # Pipelined dispatch only with a single consumer thread: its
+                # held-reply optimization is unsafe with several threads
+                # draining one batcher (runtime/inference.py docstring);
+                # with >1 threads the overlap comes from the threads.
+                kwargs={
+                    "lock": None,
+                    "pipelined": flags.num_inference_threads == 1,
+                },
+                daemon=True,
+                name=f"inference-{i}",
+            )
+            for i in range(flags.num_inference_threads)
+        ]
+
+        pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
+        actors = pool_cls(
+            unroll_length=flags.unroll_length,
+            learner_queue=learner_queue,
+            inference_batcher=inference_batcher,
+            env_server_addresses=addresses,
+            initial_agent_state=model.initial_state(1),
+            max_reconnects=flags.max_actor_reconnects,
+        )
+        actor_thread = threading.Thread(
+            target=actors.run, daemon=True, name="actorpool"
+        )
+
+        timings = Timings()
+
+        # Host->HBM prefetch (SURVEY §7 hard part #3): a double-buffered stage
+        # between the learner queue and the learner thread. device_put (and
+        # the DP shard placement) is async, so by the time the learner pulls
+        # an item its transfer is already riding behind the previous update's
+        # compute instead of stalling dispatch.
+        prefetch_q = stdlib_queue.Queue(maxsize=2)
+
+        def prefetch_loop():
+            try:
+                for item in learner_queue:
+                    batch = item["batch"]
+                    initial_agent_state = item["initial_agent_state"]
+                    if shard is not None:
+                        batch, initial_agent_state = shard(
+                            batch, initial_agent_state
+                        )
+                    else:
+                        batch = jax.device_put(batch)
+                        initial_agent_state = jax.device_put(initial_agent_state)
+                    entry = (batch, initial_agent_state)
+                    while True:
+                        try:
+                            prefetch_q.put(entry, timeout=1.0)
+                            break
+                        except stdlib_queue.Full:
+                            with state_lock:
+                                if state["done"]:
+                                    return
+            except Exception:
+                log.exception("Prefetch thread failed")
+            # No end-sentinel put: the queue may be full of live items the
+            # learner still wants; the learner detects the end by this thread
+            # having exited with the queue drained.
+
+        prefetch_thread = threading.Thread(
+            target=prefetch_loop, daemon=True, name="prefetch"
+        )
+
+        def learner_loop():
+            try:
+                _learner_loop_body()
+            finally:
+                # Always mark done — an async XLA error surfacing in the
+                # delayed flush must stop the monitor loop, not wedge it.
                 with state_lock:
-                    state["params"], state["opt_state"] = new_params, new_opt
-                    state["infer_params"] = infer_view
-                    # Global frames: every host ran this collective update.
-                    state["step"] += flags.unroll_length * flags.batch_size
-                    now_step = state["step"]
+                    state["done"] = True
+
+        def _learner_loop_body():
+            # One-step-delayed stats fetch: device_get on the PREVIOUS update's
+            # stats happens after the current one is dispatched, so the host
+            # never stalls XLA's async pipeline (the reference's equivalent
+            # overlap came from extra learner threads + a lock).
+            pending = None  # (device_stats, step_after_that_update)
+
+            def flush(pending_entry):
+                device_stats, at_step = pending_entry
+                s = learner_lib.episode_stat_postprocess(
+                    jax.device_get(device_stats)
+                )
+                s["step"] = at_step
+                s["learner_queue_size"] = learner_queue.size()
+                with state_lock:
+                    state["stats"] = s
+                plogger.log(s)
+
+            while True:
+                # reset BEFORE blocking so 'dequeue' measures the actual wait
+                # for a prefetched batch (actor starvation shows up here).
+                timings.reset()
+                try:
+                    batch, initial_agent_state = prefetch_q.get(timeout=1.0)
+                except stdlib_queue.Empty:
+                    if not prefetch_thread.is_alive():
+                        break
+                    continue
+                timings.time("dequeue")
+                # Dispatch under donation_lock (NOT state_lock): opt_state is
+                # donated, so the dispatch that invalidates the old opt
+                # buffers must not race a checkpoint's device_get of them —
+                # but dispatch can block behind in-flight compute, and holding
+                # state_lock here would stall every inference thread's params
+                # read for that long. Checkpointing takes donation_lock first.
+                with donation_lock:
+                    with state_lock:
+                        params_now, opt_now = state["params"], state["opt_state"]
+                    new_params, new_opt, train_stats = update_step(
+                        params_now, opt_now, batch, initial_agent_state
+                    )
+                    # Build the host view OUTSIDE state_lock: for multi-host
+                    # sharded params this blocks on the dispatched compute +
+                    # D2H/H2D, and holding the lock for that long would stall
+                    # every inference thread's params read.
+                    infer_view = local_view(new_params, device=infer_device)
+                    with state_lock:
+                        state["params"], state["opt_state"] = new_params, new_opt
+                        state["infer_params"] = infer_view
+                        # Global frames: every host ran this collective update.
+                        state["step"] += flags.unroll_length * flags.batch_size
+                        now_step = state["step"]
+                if pending is not None:
+                    flush(pending)
+                pending = (train_stats, now_step)
+                timings.time("learn")
+                if now_step >= flags.total_steps:
+                    break
             if pending is not None:
                 flush(pending)
-            pending = (train_stats, now_step)
-            timings.time("learn")
-            if now_step >= flags.total_steps:
-                break
-        if pending is not None:
-            flush(pending)
 
-    learner_thread = threading.Thread(
-        target=learner_loop, daemon=True, name="learner"
-    )
-
-    for t in inference_threads:
-        t.start()
-    actor_thread.start()
-    prefetch_thread.start()
-    learner_thread.start()
-
-    if flags.profile_dir:
-        jax.profiler.start_trace(flags.profile_dir)
-
-    last_checkpoint = time.time()
-    last_step, last_time = state["step"], time.time()
+        learner_thread = threading.Thread(
+            target=learner_loop, daemon=True, name="learner"
+        )
+    except BaseException:
+        _reap_servers(server_procs)
+        raise
+    # From the first thread start onward, the main try/finally below owns
+    # ALL cleanup (queues closed, threads joined, logger closed, servers
+    # reaped) — a failure here must run that full path, not just the
+    # server reap.
     try:
+        for t in inference_threads:
+            t.start()
+        actor_thread.start()
+        prefetch_thread.start()
+        learner_thread.start()
+
+        if flags.profile_dir:
+            jax.profiler.start_trace(flags.profile_dir)
+
+        last_checkpoint = time.time()
+        last_step, last_time = state["step"], time.time()
         while not state["done"]:
             time.sleep(5)
             pool_errors = getattr(actors, "errors", [])
@@ -790,7 +817,10 @@ def train(flags):
         raise
     finally:
         if flags.profile_dir:
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError:
+                pass  # start_trace itself failed; don't mask the cause
         # Shutdown ordering mirrors the reference (polybeast_learner.py:
         # 587-593): close batcher + queue, join actors, join threads.
         for closer in (inference_batcher, learner_queue):
@@ -812,8 +842,7 @@ def train(flags):
                     stats=state["stats"],
                 )
         plogger.close(successful=successful)
-        for p in server_procs:
-            p.terminate()
+        _reap_servers(server_procs)
     log.info("Learning finished after %d steps.", state["step"])
     return state["stats"]
 
