@@ -72,11 +72,14 @@ def _random_inputs(rng, shape, log_rho_range=(-2.5, 2.5)):
     )
 
 
+@pytest.mark.parametrize("scan_impl", ["sequential", "associative"])
 @pytest.mark.parametrize("shape", [(5, 4), (8, 2), (1, 1)])
 @pytest.mark.parametrize(
     "clip_rho,clip_pg_rho", [(1.0, 1.0), (3.7, 2.2), (None, None)]
 )
-def test_from_importance_weights_matches_ground_truth(shape, clip_rho, clip_pg_rho):
+def test_from_importance_weights_matches_ground_truth(
+    shape, clip_rho, clip_pg_rho, scan_impl
+):
     rng = np.random.default_rng(42)
     inputs = _random_inputs(rng, shape)
     gt_vs, gt_pg = ground_truth_vtrace(
@@ -86,9 +89,46 @@ def test_from_importance_weights_matches_ground_truth(shape, clip_rho, clip_pg_r
         **{k: jnp.asarray(v) for k, v in inputs.items()},
         clip_rho_threshold=clip_rho,
         clip_pg_rho_threshold=clip_pg_rho,
+        scan_impl=scan_impl,
     )
     np.testing.assert_allclose(out.vs, gt_vs, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(out.pg_advantages, gt_pg, rtol=1e-4, atol=1e-4)
+
+
+def test_associative_scan_matches_sequential_long_t():
+    """The log-depth associative solve must agree with the sequential
+    scan well past the reference's unrolls (T=1024 — long-context
+    shape) to float reassociation tolerance, under jit."""
+    rng = np.random.default_rng(7)
+    inputs = {
+        k: jnp.asarray(v)
+        for k, v in _random_inputs(rng, (1024, 2)).items()
+    }
+    seq = jax.jit(
+        lambda: vtrace.from_importance_weights(
+            **inputs, scan_impl="sequential"
+        )
+    )()
+    ass = jax.jit(
+        lambda: vtrace.from_importance_weights(
+            **inputs, scan_impl="associative"
+        )
+    )()
+    np.testing.assert_allclose(ass.vs, seq.vs, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        ass.pg_advantages, seq.pg_advantages, rtol=2e-5, atol=2e-5
+    )
+
+
+def test_bad_scan_impl_rejected():
+    import pytest as _pytest
+
+    rng = np.random.default_rng(0)
+    inputs = {
+        k: jnp.asarray(v) for k, v in _random_inputs(rng, (3, 2)).items()
+    }
+    with _pytest.raises(ValueError, match="scan_impl"):
+        vtrace.from_importance_weights(**inputs, scan_impl="nope")
 
 
 def test_higher_rank_inputs():

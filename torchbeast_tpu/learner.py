@@ -45,6 +45,9 @@ class HParams(NamedTuple):
     total_steps: int = 100_000_000
     unroll_length: int = 80
     batch_size: int = 8
+    # "sequential" (lax.scan, right for T<=80) or "associative"
+    # (lax.associative_scan, O(log T) depth — long-unroll configs).
+    vtrace_impl: str = "sequential"
 
 
 def make_optimizer(hp: HParams) -> optax.GradientTransformation:
@@ -117,6 +120,7 @@ def compute_loss(
         rewards=rewards,
         values=values,
         bootstrap_value=bootstrap_value,
+        scan_impl=hp.vtrace_impl,
     )
 
     pg_loss = compute_policy_gradient_loss(

@@ -62,6 +62,12 @@ def make_parser():
                         help="Total environment frames to train for.")
     parser.add_argument("--batch_size", type=int, default=8,
                         help="Learner batch size.")
+    parser.add_argument("--vtrace_impl", default="sequential",
+                        choices=["sequential", "associative"],
+                        help="V-trace backward recursion: lax.scan "
+                             "(T dependent steps, right for T<=80) or "
+                             "lax.associative_scan (O(log T) depth - "
+                             "the long-unroll/long-context choice).")
     parser.add_argument("--unroll_length", type=int, default=80,
                         help="The unroll length (time dimension).")
     parser.add_argument("--model", default="shallow",
@@ -201,6 +207,7 @@ def hparams_from_flags(flags) -> learner_lib.HParams:
         total_steps=flags.total_steps,
         unroll_length=flags.unroll_length,
         batch_size=flags.batch_size,
+        vtrace_impl=getattr(flags, "vtrace_impl", "sequential"),
     )
 
 

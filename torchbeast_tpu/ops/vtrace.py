@@ -56,6 +56,7 @@ def from_logits(
     bootstrap_value,
     clip_rho_threshold=1.0,
     clip_pg_rho_threshold=1.0,
+    scan_impl="sequential",
 ):
     """V-trace for softmax policies (reference vtrace.py:58-88)."""
     target_action_log_probs = action_log_probs(target_policy_logits, actions)
@@ -69,6 +70,7 @@ def from_logits(
         bootstrap_value=bootstrap_value,
         clip_rho_threshold=clip_rho_threshold,
         clip_pg_rho_threshold=clip_pg_rho_threshold,
+        scan_impl=scan_impl,
     )
     return VTraceFromLogitsReturns(
         log_rhos=log_rhos,
@@ -86,12 +88,34 @@ def from_importance_weights(
     bootstrap_value,
     clip_rho_threshold=1.0,
     clip_pg_rho_threshold=1.0,
+    scan_impl="sequential",
 ):
     """V-trace from log importance weights (reference vtrace.py:91-139).
 
     All inputs are time-major `[T, B, ...]`; `bootstrap_value` is `[B, ...]`.
     Returns VTraceReturns(vs, pg_advantages), both gradient-stopped.
+
+    `scan_impl` picks how the backward recursion runs on device:
+
+    - "sequential": `lax.scan(reverse=True)` — T dependent steps. The
+      right choice for the usual T<=80 unrolls (tiny per-step work;
+      scan keeps it fused and cheap).
+    - "associative": `lax.associative_scan` over the affine maps
+      f_t(x) = a_t x + b_t with a_t = discount_t * c_t, b_t = delta_t.
+      The recursion is a first-order linear recurrence, so suffix
+      composition is associative and the whole solve runs in O(log T)
+      depth instead of O(T) — the TPU-first choice for long-unroll
+      (transformer / long-context) configs where a sequential
+      1000-step chain of scalar-vector ops would serialize the loss
+      section of the step. Bit-for-bit it differs from sequential only
+      by float reassociation (parity pinned to 1e-6 in
+      tests/test_vtrace.py).
     """
+    if scan_impl not in ("sequential", "associative"):
+        raise ValueError(
+            f"scan_impl {scan_impl!r} must be 'sequential' or "
+            "'associative'"
+        )
     rhos = jnp.exp(log_rhos)
     if clip_rho_threshold is not None:
         clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
@@ -105,17 +129,34 @@ def from_importance_weights(
     )
     deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
 
-    def scan_fn(acc, xs):
-        delta_t, discount_t, c_t = xs
-        acc = delta_t + discount_t * c_t * acc
-        return acc, acc
+    if scan_impl == "sequential":
 
-    _, vs_minus_v_xs = lax.scan(
-        scan_fn,
-        jnp.zeros_like(bootstrap_value),
-        (deltas, discounts, cs),
-        reverse=True,
-    )
+        def scan_fn(acc, xs):
+            delta_t, discount_t, c_t = xs
+            acc = delta_t + discount_t * c_t * acc
+            return acc, acc
+
+        _, vs_minus_v_xs = lax.scan(
+            scan_fn,
+            jnp.zeros_like(bootstrap_value),
+            (deltas, discounts, cs),
+            reverse=True,
+        )
+    else:
+        # Suffix-compose the affine maps f_t(x) = a_t x + b_t:
+        # acc_t = (f_t o f_{t+1} o ... o f_{T-1})(0). Flip to a prefix
+        # problem, combine with (q o p) (p = already-accumulated earlier
+        # flipped indices = LATER time, applied first), flip back.
+        a = jnp.flip(discounts * cs, 0)
+        b = jnp.flip(deltas, 0)
+
+        def combine(p, q):
+            pa, pb = p
+            qa, qb = q
+            return qa * pa, qa * pb + qb
+
+        _, acc = lax.associative_scan(combine, (a, b), axis=0)
+        vs_minus_v_xs = jnp.flip(acc, 0)
 
     vs = vs_minus_v_xs + values
 

@@ -74,6 +74,12 @@ def make_parser():
     parser.add_argument("--savedir", default="~/logs/torchbeast_tpu")
     parser.add_argument("--total_steps", type=int, default=100000)
     parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--vtrace_impl", default="sequential",
+                        choices=["sequential", "associative"],
+                        help="V-trace backward recursion: lax.scan "
+                             "(T dependent steps, right for T<=80) or "
+                             "lax.associative_scan (O(log T) depth - "
+                             "the long-unroll/long-context choice).")
     parser.add_argument("--unroll_length", type=int, default=80)
     parser.add_argument("--model", default="deep",
                         choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer", "pipelined_transformer"])
