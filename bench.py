@@ -69,6 +69,60 @@ RESERVE_S = 45.0
 # worse than a replayed last-known-TPU line with provenance.
 PROBE_SCHEDULE = ((60, 15), (90, 30), (120, 0))
 
+# A connection-failure substring only counts as "tunnel dropped" when
+# it is attributable to the device transport via one of these markers
+# (lowercased match). Generic EOFError/Broken-pipe lines from the
+# repo's own IPC must not trigger a replay.
+_TRANSPORT_MARKERS = (
+    "jaxlib", "jax.errors", "xlaruntimeerror", "pjrt", "axon",
+    "grpc", "xla_bridge", "libtpu",
+)
+
+_CONNECTION_SIGNATURES = (
+    "ConnectionRefused", "ConnectionReset", "Connection reset",
+    "Connection refused", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+    "Socket closed", "Broken pipe", "EOFError",
+)
+
+
+def _is_transport_connection_error(stderr: str) -> bool:
+    """True when a connection-failure signature in `stderr` is
+    attributable to the device transport.
+
+    Attribution accepts a marker on the signature line itself (the
+    single-line `jax.errors.JaxRuntimeError: UNAVAILABLE: ...` form) OR
+    on a line of the enclosing traceback block (a drop surfacing as a
+    bare `ConnectionResetError:` whose `File ".../axon/..."` frames
+    carry the marker). Markers elsewhere in stderr do NOT count —
+    routine jaxlib/xla_bridge warnings appear in every run's stderr and
+    must not turn the repo's own IPC EOFErrors into a replay.
+    """
+    block = None  # lines of the currently-open traceback block
+    for line in stderr.splitlines():
+        if line.startswith("Traceback (most recent call last):"):
+            block = [line]
+            continue
+        if block is not None:
+            block.append(line)
+        if any(sig in line for sig in _CONNECTION_SIGNATURES):
+            # Attribution scope: the enclosing traceback block when one
+            # is open, else the signature line alone — NEVER arbitrary
+            # preceding stderr (routine warning lines carry markers).
+            scope = block if block is not None else [line]
+            if any(
+                m in bl.lower()
+                for bl in scope
+                for m in _TRANSPORT_MARKERS
+            ):
+                return True
+        if block is not None and not line.startswith((" ", "\t")):
+            # A non-indented line is the exception line that terminates
+            # the traceback (chained tracebacks reopen with their own
+            # header); markers from this block must not leak onto later
+            # unrelated signatures.
+            block = None
+    return False
+
 _REPO = os.path.dirname(os.path.abspath(__file__))
 LAST_TPU_PATH = os.path.join(
     _REPO, "benchmarks", "artifacts", "last_tpu_bench.json"
@@ -692,18 +746,18 @@ def main():
         sys.stdout.flush()
     elif force_cpu:
         fail(f"measurement child failed (rc={proc.returncode})")
-    elif any(
-        sig in (proc.stderr or "")
-        for sig in (
-            "ConnectionRefused", "ConnectionReset", "Connection reset",
-            "Connection refused", "UNAVAILABLE", "DEADLINE_EXCEEDED",
-            "Socket closed", "Broken pipe", "EOFError",
-        )
-    ):
-        # The child's own stderr shows a connection failure: the tunnel
-        # dropped mid-run, even if it has already RECOVERED by the time
-        # we could reprobe (round-3 logs show intermittent blips). Infra,
-        # not code — replay.
+    elif _is_transport_connection_error(proc.stderr or ""):
+        # The child's own stderr shows a connection failure
+        # ATTRIBUTABLE TO THE DEVICE TRANSPORT (jaxlib/XLA/PJRT/axon/
+        # grpc — see _is_transport_connection_error for the attribution
+        # rule): the tunnel dropped mid-run, even if it has already
+        # RECOVERED by the time we could reprobe (round-3 logs show
+        # intermittent blips). Infra, not code — replay. The same
+        # substrings on unattributed lines (e.g. a runtime queue/IPC
+        # bug raising EOFError, or an env-server pipe broken by a
+        # learner crash) do NOT qualify; those fall through to the
+        # reprobe arms below, so a code regression is never silently
+        # replayed as last-known-good chip numbers.
         fail(
             f"measurement child failed (rc={proc.returncode}) with a "
             "connection error in stderr — tunnel dropped mid-run"

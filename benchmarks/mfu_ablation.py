@@ -215,18 +215,23 @@ def main():
         return ms, fl
 
     channels = []
-    base_ms = base_fl = None
+    base_ms = base_fl = base_w = None
     for w in widths:
         tag = "channels " + "/".join(map(str, w))
         if over_budget(tag):
             continue
         ms, fl = step_at(w)
         if base_ms is None:
-            base_ms, base_fl = ms, fl
+            # Ratios baseline to the first width that RAN, which is not
+            # necessarily widths[0] (earlier points can be skipped by
+            # the budget check) — so every entry records its baseline
+            # width and the ratios stay self-describing.
+            base_ms, base_fl, base_w = ms, fl, list(w)
         channels.append({
             "trunk_channels": list(w),
             "step_ms": round(ms, 2),
             "flops": fl,
+            "baseline_channels": base_w,
             "time_x": round(ms / base_ms, 2),
             "flops_x": round(fl / base_fl, 2) if fl and base_fl else None,
         })

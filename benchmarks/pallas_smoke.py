@@ -1,0 +1,157 @@
+"""Mosaic lowering smoke for the Pallas kernels (VERDICT r4 item 2).
+
+Every test in the repo runs `ops/pallas_attention.py` and
+`ops/pallas_pool.py` under the Pallas INTERPRETER (CPU); a Mosaic
+lowering failure — block shapes, memory-space limits — would otherwise
+surface for the first time mid-capture on chip day. This script runs
+both kernels with `interpret=False` against their dense/XLA twins and
+prints one JSON verdict line. It sits in scripts/tpu_capture.sh between
+bench and the long captures so a live tunnel validates the kernels
+BEFORE spending the capture budget.
+
+On CPU, `interpret=False` exercises the Pallas-to-XLA:CPU path (not
+Mosaic); the JSON records which backend actually compiled, so a CPU
+pass is labeled as the weaker claim it is.
+
+Usage: python benchmarks/pallas_smoke.py [--sizes test,chip]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import traceback
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+
+def attention_case(b, t, h, d, m, seed=0):
+    from torchbeast_tpu.ops.pallas_attention import (
+        _reference,
+        transformer_attention,
+    )
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)).astype(np.float32))
+    k = jnp.asarray(
+        rng.standard_normal((b, m + t, h, d)).astype(np.float32)
+    )
+    v = jnp.asarray(
+        rng.standard_normal((b, m + t, h, d)).astype(np.float32)
+    )
+    done = rng.random((t, b)) < 0.15
+    seg = jnp.asarray(np.cumsum(done, axis=0).T.astype(np.int32))
+    cache_valid = jnp.asarray((rng.random((b, m)) < 0.7).astype(np.float32))
+    no_done = jnp.asarray(np.cumsum(done, axis=0).T == 0)
+    rel_bias = jnp.asarray(
+        rng.standard_normal((h, m + 1)).astype(np.float32) * 0.1
+    )
+    t0 = time.perf_counter()
+    ours = transformer_attention(
+        m, False, q, k, v, seg, cache_valid, no_done, rel_bias
+    )
+    jax.block_until_ready(ours)
+    compile_s = time.perf_counter() - t0
+    ref = _reference(q, k, v, seg, cache_valid, no_done, rel_bias, m)
+    err = float(jnp.max(jnp.abs(ours - ref)))
+    scale = float(jnp.max(jnp.abs(ref))) or 1.0
+    return {
+        "kernel": "transformer_attention",
+        "shape": f"B{b} T{t} H{h} D{d} M{m}",
+        "max_abs_err": err,
+        "rel_err": err / scale,
+        "compile_s": round(compile_s, 2),
+        "ok": bool(err / scale < 5e-4),
+    }
+
+
+def pool_case(shape, seed=0):
+    from torchbeast_tpu.ops.pallas_pool import pool_bwd
+
+    def fwd(x):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)),
+        )
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    y, vjp = jax.vjp(fwd, x)
+    g = jnp.asarray(rng.standard_normal(y.shape), jnp.float32)
+    gx_ref = vjp(g)[0]
+    t0 = time.perf_counter()
+    gx = pool_bwd(x, y, g, interpret=False)
+    jax.block_until_ready(gx)
+    compile_s = time.perf_counter() - t0
+    err = float(jnp.max(jnp.abs(gx - gx_ref)))
+    return {
+        "kernel": "pool_bwd",
+        "shape": "x".join(map(str, shape)),
+        "max_abs_err": err,
+        "compile_s": round(compile_s, 2),
+        "ok": bool(err < 1e-5),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--sizes", default="test,chip",
+        help="comma set: 'test' = unit-test shapes, 'chip' = flagship "
+        "transformer/trunk shapes",
+    )
+    args = ap.parse_args()
+    sizes = set(args.sizes.split(","))
+
+    backend = jax.default_backend()
+    cases = []
+    if "test" in sizes:
+        cases.append(("attn-test", lambda: attention_case(2, 12, 4, 16, 8)))
+        cases.append(("pool-test", lambda: pool_case((2, 21, 21, 32))))
+    if "chip" in sizes:
+        # Flagship shapes: the transformer's RL-unroll attention
+        # (models/transformer.py defaults) and the deep trunk's stage-1
+        # pool (84x84 Atari, 32 channels).
+        cases.append(("attn-chip", lambda: attention_case(8, 20, 4, 64, 40)))
+        cases.append(("pool-chip", lambda: pool_case((8, 84, 84, 32))))
+
+    results, failures = [], []
+    for name, fn in cases:
+        try:
+            r = fn()
+            r["case"] = name
+            results.append(r)
+            if not r["ok"]:
+                failures.append(name)
+        except Exception as e:  # noqa: BLE001 — verdict must always print
+            results.append({
+                "case": name,
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+                "traceback": traceback.format_exc()[-1500:],
+            })
+            failures.append(name)
+
+    print(json.dumps({
+        "bench": "pallas_smoke",
+        "backend": backend,
+        "mosaic": backend == "tpu",
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "ok": not failures,
+        "failures": failures,
+        "cases": results,
+    }))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

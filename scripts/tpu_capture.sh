@@ -3,11 +3,15 @@
 # TPU evidence in one serial pass (the chip is single-tenant):
 #   1. bench.py              — fresh headline numbers + HBM roofline
 #                              (auto-refreshes last_tpu_bench.json)
-#   2. profile_step.py bf16  — op-level trace + roofline evidence
-#   3. profile_step.py f32
-#   4. mfu_ablation.py       — trunk share + channel/batch scaling
-#   5. tpu_e2e_async.py      — full async driver system SPS + queues
-#   6. monobeast overlap A/B — zero-lag vs --overlap_collect timings
+#   2. pallas_smoke.py       — Mosaic lowering + parity for both
+#                              Pallas kernels (fail fast, 5 min cap)
+#   3. vtrace_bench.py       — sequential vs associative V-trace at
+#                              long T (the O(log T) claim's chip row)
+#   4. profile_step.py bf16  — op-level trace + roofline evidence
+#   5. profile_step.py f32
+#   6. mfu_ablation.py       — trunk share + channel/batch scaling
+#   7. tpu_e2e_async.py      — full async driver system SPS + queues
+#   8. monobeast overlap A/B — zero-lag vs --overlap_collect timings
 # Everything lands under $OUT; summarize into repo artifacts by hand
 # afterwards (this script never writes to benchmarks/artifacts itself,
 # except bench.py's own last_tpu refresh).
@@ -31,6 +35,21 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     BENCH_BUDGET_S=900 timeout 960 python bench.py \
       > "$OUT/bench.json" 2> "$OUT/bench.err"
     echo "bench rc=$?" >> "$OUT/watch.log"
+    echo "=== pallas smoke ===" >> "$OUT/watch.log"
+    # Mosaic lowering check BEFORE the long captures: the kernels have
+    # only ever run under the interpreter on CPU; a block-shape or
+    # memory-space lowering failure should cost 5 minutes, not the
+    # whole capture budget.
+    timeout 300 python benchmarks/pallas_smoke.py \
+      > "$OUT/pallas_smoke.json" 2> "$OUT/pallas_smoke.err"
+    echo "pallas smoke rc=$?" >> "$OUT/watch.log"
+    echo "=== vtrace scan bench ===" >> "$OUT/watch.log"
+    # Sequential vs associative V-trace at T in {80, 1000, 4000}: the
+    # O(log T) depth claim in --vtrace_impl's help text is decided by
+    # this chip row (CPU rows only bound overhead).
+    timeout 300 python benchmarks/vtrace_bench.py \
+      > "$OUT/vtrace_bench.json" 2> "$OUT/vtrace_bench.err"
+    echo "vtrace bench rc=$?" >> "$OUT/watch.log"
     echo "=== profile bf16 ===" >> "$OUT/watch.log"
     timeout 600 python benchmarks/profile_step.py --dtype bf16 \
       --steps 10 --out "$OUT/trace_bf16" \

@@ -5,7 +5,7 @@ global 4-device mesh.
 
 Invoked by test_distributed.py:
     poly_distributed_worker.py <proc_id> <coordinator_port> <savedir>
-        <total_steps>
+        <total_steps> [mode] [n_procs]
 
 Everything lives under the __main__ guard: the driver spawns env-server
 children with the multiprocessing "spawn" context, which re-imports this
@@ -23,6 +23,7 @@ def main():
     savedir = sys.argv[3]
     total_steps = int(sys.argv[4])
     mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
+    n_procs = int(sys.argv[6]) if len(sys.argv) > 6 else 2
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -39,7 +40,8 @@ def main():
         "--xpid", f"poly-dist-{mode}" if mode != "dp" else "poly-dist",
         "--coordinator_address", f"127.0.0.1:{port}",
         "--num_servers", "2",
-        "--batch_size", "4",       # global; 2 local rows per host
+        # Global batch; 2 local rows per host either way.
+        "--batch_size", "8" if mode == "dp_pod" else "4",
         "--unroll_length", "5",
         "--total_steps", str(total_steps),
         "--savedir", savedir,
@@ -48,6 +50,12 @@ def main():
     ]
     if mode == "dp":
         argv += ["--model", "mlp", "--num_learner_devices", "4"]
+    elif mode == "dp_pod":
+        # BASELINE config 5's shape in miniature: 4 hosts x 2 devices,
+        # one global 8-device data mesh, each host running its own env
+        # servers/actors/inference group (the pod story of reference
+        # README.md:10 / polybeast_learner.py:436-444 address fan-out).
+        argv += ["--model", "mlp", "--num_learner_devices", "8"]
     elif mode == "dp_ep":
         # Composite (data=2 x expert=2) global mesh ACROSS the two
         # processes: collective updates carry both the grad all-reduce
@@ -79,7 +87,7 @@ def main():
     else:
         raise ValueError(f"unknown mode {mode!r}")
     flags = polybeast.make_parser().parse_args(argv)
-    os.environ["TORCHBEAST_NUM_PROCESSES"] = "2"
+    os.environ["TORCHBEAST_NUM_PROCESSES"] = str(n_procs)
     os.environ["TORCHBEAST_PROCESS_ID"] = str(proc_id)
 
     stats = polybeast.train(flags)

@@ -179,14 +179,20 @@ def test_child_crash_with_recovered_tunnel_still_replays(
 ):
     """A transient blip can drop the child and RECOVER before the
     supervisor's reprobe; the connection-error signature in the child's
-    stderr must still classify it as infra (replay), not code."""
+    stderr must still classify it as infra (replay), not code — but
+    ONLY when the line is attributable to the device transport
+    (jaxlib/XLA/PJRT/grpc), the way a real drop surfaces."""
     monkeypatch.setattr(
         bench, "_probe_backend", lambda t: ("tpu", "v5e")
     )  # up before AND after
     monkeypatch.setattr(
         bench.subprocess, "run",
         lambda *a, **k: _FakeProc(
-            1, stderr="RuntimeError: Connection reset by peer\n"
+            1,
+            stderr=(
+                "jax.errors.JaxRuntimeError: UNAVAILABLE: "
+                "Connection reset by peer\n"
+            ),
         ),
     )
     monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
@@ -198,6 +204,116 @@ def test_child_crash_with_recovered_tunnel_still_replays(
     )
     assert parsed["platform"] == "tpu(replayed)"
     assert "tunnel dropped mid-run" in parsed["note"]
+
+
+def test_multiline_transport_traceback_still_replays(monkeypatch, capsys):
+    """A drop can surface as a bare builtin exception
+    (`ConnectionResetError:` carries no marker) whose traceback frames
+    (`File ".../axon/..."`) do. Block-scoped attribution must classify
+    that as infra even when the tunnel has recovered by reprobe time."""
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: ("tpu", "v5e")
+    )  # up before AND after
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _FakeProc(
+            1,
+            stderr=(
+                "Traceback (most recent call last):\n"
+                '  File "/root/.axon_site/axon/register/__init__.py",'
+                " line 619, in _axon_get_backend_uncached\n"
+                "ConnectionResetError: [Errno 104] Connection reset "
+                "by peer\n"
+            ),
+        ),
+    )
+    monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(
+        [ln for ln in out if ln.startswith('{"metric"')][-1]
+    )
+    assert parsed["platform"] == "tpu(replayed)"
+    assert "tunnel dropped mid-run" in parsed["note"]
+
+
+def test_marker_outside_traceback_block_does_not_attribute():
+    """Routine jaxlib/xla_bridge warning lines appear in EVERY child's
+    stderr; they must not attribute an unrelated IPC EOFError traceback
+    to the device transport."""
+    stderr = (
+        "WARNING:jax._src.xla_bridge:905: Platform 'axon' is "
+        "experimental\n"
+        "Traceback (most recent call last):\n"
+        '  File "runtime/queues.py", line 40, in get\n'
+        "EOFError\n"
+    )
+    assert bench._is_transport_connection_error(stderr) is False
+
+    # No traceback at all: a logged repo-IPC failure must not borrow
+    # markers from earlier warning lines.
+    stderr = (
+        "WARNING:jax._src.xla_bridge:905: Platform 'axon' is "
+        "experimental\n"
+        "env server: send failed: Broken pipe\n"
+    )
+    assert bench._is_transport_connection_error(stderr) is False
+
+    # A signature AFTER an unrelated marker-bearing traceback has
+    # closed must not inherit that block's markers.
+    stderr = (
+        "Traceback (most recent call last):\n"
+        '  File "/opt/venv/lib/python3.12/site-packages/jaxlib/x.py",'
+        " line 1, in f\n"
+        "ValueError: unrelated\n"
+        "EOFError\n"
+    )
+    assert bench._is_transport_connection_error(stderr) is False
+
+    # Positive control: the same signature INSIDE a transport-attributed
+    # traceback still attributes.
+    stderr = (
+        "Traceback (most recent call last):\n"
+        '  File "/opt/venv/lib/python3.12/site-packages/jaxlib/x.py",'
+        " line 1, in f\n"
+        "ConnectionResetError: [Errno 104] Connection reset by peer\n"
+    )
+    assert bench._is_transport_connection_error(stderr) is True
+
+
+def test_unattributed_connection_error_is_code_not_infra(
+    monkeypatch, capsys
+):
+    """An EOFError/Broken-pipe from the repo's OWN IPC (a queue bug, an
+    env-server pipe broken by a learner crash) carries no jaxlib/XLA/
+    PJRT marker on its line. With the tunnel up before and after, that
+    is a code regression: it must emit the no-replay error record, not
+    serve last-known-good chip numbers."""
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: ("tpu", "v5e")
+    )  # up before AND after
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _FakeProc(
+            1,
+            stderr=(
+                "Traceback (most recent call last):\n"
+                '  File "runtime/queues.py", line 40, in get\n'
+                "EOFError\n"
+            ),
+        ),
+    )
+    monkeypatch.delenv("_TB_BENCH_CHILD", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(
+        [ln for ln in out if ln.startswith('{"metric"')][-1]
+    )
+    assert parsed["platform"] == "error"
+    assert parsed["fresh"] is False
+    assert "no replay" in parsed["note"]
 
 
 def test_child_success_line_passes_through(monkeypatch, capsys):

@@ -58,7 +58,9 @@ def test_two_process_dp_update_matches_single_device():
         )
 
 
-def _run_poly_workers(tmp_path, total_steps, timeout=420, mode="dp"):
+def _run_poly_workers(
+    tmp_path, total_steps, timeout=420, mode="dp", n_procs=2
+):
     port = _free_port()
     worker = os.path.join(
         os.path.dirname(__file__), "poly_distributed_worker.py"
@@ -74,13 +76,13 @@ def _run_poly_workers(tmp_path, total_steps, timeout=420, mode="dp"):
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(i), str(port), str(tmp_path),
-             str(total_steps), mode],
+             str(total_steps), mode, str(n_procs)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
         )
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outputs = []
     for p in procs:
@@ -121,6 +123,50 @@ def test_poly_driver_two_hosts_end_to_end(tmp_path):
 
     # Resume: both hosts load the lead's checkpoint and continue.
     outputs = _run_poly_workers(tmp_path, 2 * total)
+    for out in outputs:
+        assert "Resuming preempted job" in out
+    saved = flax.serialization.msgpack_restore(ckpt.read_bytes())
+    assert saved["step"] >= 2 * total
+
+
+def test_poly_driver_four_host_pod_miniature(tmp_path):
+    """BASELINE config 5's topology in miniature: the FULL async driver
+    across 4 jax.distributed processes (2 virtual CPU devices each, one
+    global 8-device data mesh), each host running its own env-server
+    group — multi-host DP with per-host actor groups, the largest
+    no-TPU step toward the 16-host v5e-64 story (reference README.md:10
+    cross-machine training; polybeast_learner.py:436-444 address
+    fan-out). Lead-host checkpoint + all-host resume included."""
+    total = 240  # 6 collective updates of 5*8 global frames
+    outputs = _run_poly_workers(
+        tmp_path, total, timeout=900, mode="dp_pod", n_procs=4
+    )
+    for i, out in enumerate(outputs):
+        assert f"worker {i}: final step" in out
+
+    # Host-aware layout: every host trained and logged...
+    assert (tmp_path / "poly-dist-dp_pod" / "logs.csv").exists()
+    for host in range(1, 4):
+        assert (
+            tmp_path / f"poly-dist-dp_pod-host{host}" / "logs.csv"
+        ).exists()
+    # ...but only the lead host wrote the checkpoint.
+    ckpt = tmp_path / "poly-dist-dp_pod" / "model.ckpt"
+    assert ckpt.exists()
+    for host in range(1, 4):
+        assert not (
+            tmp_path / f"poly-dist-dp_pod-host{host}" / "model.ckpt"
+        ).exists()
+
+    import flax.serialization
+
+    saved = flax.serialization.msgpack_restore(ckpt.read_bytes())
+    assert saved["step"] >= total
+
+    # Resume: all 4 hosts load the lead's checkpoint and continue.
+    outputs = _run_poly_workers(
+        tmp_path, 2 * total, timeout=900, mode="dp_pod", n_procs=4
+    )
     for out in outputs:
         assert "Resuming preempted job" in out
     saved = flax.serialization.msgpack_restore(ckpt.read_bytes())

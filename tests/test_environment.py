@@ -64,7 +64,14 @@ def test_memory_chain_mechanics():
 
     from torchbeast_tpu.envs import MemoryChainEnv, create_env
 
-    env = MemoryChainEnv(length=4, seed=0)
+    import pytest
+
+    # Floor: below length 6 the one-branch asymmetric relay matches or
+    # beats honest play, so short probes are rejected outright.
+    with pytest.raises(ValueError, match="length must be >= 6"):
+        MemoryChainEnv(length=5, seed=0)
+
+    env = MemoryChainEnv(length=6, seed=0)
     fwd = env.FORWARD
     seen = set()
     for _ in range(20):
@@ -88,9 +95,10 @@ def test_memory_chain_mechanics():
     assert seen == {0, 1}  # both cues drawn
 
     # Mismatched query answer -> -1; non-forward corridor step -> -0.5
-    # (the relay tax: a full last-action relay costs (length-1)*0.5,
-    # strictly worse than honest coin-flipping).
-    env2 = MemoryChainEnv(length=4, seed=1)
+    # (the relay tax: even the asymmetric one-branch relay expects
+    # 1 - (length-1)*0.25 < 0 at length >= 6, strictly worse than
+    # honest coin-flipping).
+    env2 = MemoryChainEnv(length=6, seed=1)
     frame = env2.reset()
     cue = int(np.argmax(frame[:2, 0, 0]))
     _, reward, done = env2.step(cue)  # announcing the cue = violation

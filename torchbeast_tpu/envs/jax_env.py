@@ -94,10 +94,14 @@ class MemoryChainJax:
     FORWARD = 2
 
     def __init__(self, length: int = 6):
-        if length < 3:
-            raise ValueError(
-                "length must be >= 3 (cue step + corridor + query)"
-            )
+        if length < 6:
+            # Same floor (and same reason) as MemoryChainEnv: below 6
+            # the asymmetric last-action relay (cue 0 -> FORWARD free,
+            # cue 1 -> one fully-penalised branch) returns
+            # 1-(length-1)*0.25 >= 0, so feed-forward matches honest
+            # play and the probe's FF-vs-LSTM differential guarantee
+            # is void.
+            raise ValueError("length must be >= 6 (see MemoryChainEnv)")
         self.length = length
         self.num_actions = 3  # 0/1 = answers, 2 = forward
         self.frame_shape = (4, 1, 1)

@@ -108,24 +108,34 @@ class MemoryChainEnv:
     nothing the decision-step policy can SEE correlates with the cue:
     the query frame is cue-independent, reward before the decision
     depends only on the agent's own compliance, and — the subtle leak —
-    the model's last-action input cannot be used as a relay (a₀ = cue,
-    then copy last action forward) because every relay step is a
-    non-forward action: with `length` = 6 a full relay chain costs
-    5 × 0.5 = 2.5, making relay return −1.5 < the 0 of honest play.
-    So a feed-forward policy caps at expected return ≈ 0 (forward
-    through the corridor, coin-flip at the query), while a recurrent
-    core that carries the cue across the unroll (the machinery the
-    reference's core_agent_state_test pins, monobeast.py:599-611)
-    reaches +1. The FF-vs-LSTM gap on this env is the direct functional
-    proof that --use_lstm carries memory.
+    the model's last-action input cannot be used as a relay (encode the
+    cue in a₀, then copy last action forward to the query). The best
+    such relay is ASYMMETRIC: encode cue 0 as FORWARD (penalty-free)
+    and only cue 1 as a non-forward action, paying the corridor tax in
+    one branch. Its expected return is 1 − (length−1)·0.25 (half the
+    episodes relay for free, half pay (length−1)·0.5), versus ≈ 0 for
+    honest play (forward corridor, coin-flip at the query). The relay
+    is strictly losing only when (length−1)·0.25 > 1, i.e. length ≥ 6
+    — hence the constructor floor below; at length 5 the relay ties
+    honest play and below that it WINS, breaking the probe. With
+    length ≥ 6 a feed-forward policy caps at expected return ≈ 0,
+    while a recurrent core that carries the cue across the unroll (the
+    machinery the reference's core_agent_state_test pins,
+    monobeast.py:599-611) reaches +1. The FF-vs-LSTM gap on this env
+    is the direct functional proof that --use_lstm carries memory.
     """
 
     FORWARD = 2
 
     def __init__(self, length=6, seed=None):
-        if length < 3:
+        if length < 6:
             raise ValueError(
-                "length must be >= 3 (cue step + corridor + query)"
+                "length must be >= 6: below that the asymmetric "
+                "last-action relay (cue 0 -> FORWARD, cue 1 -> "
+                "non-forward) returns 1 - (length-1)*0.25 >= 0 and a "
+                "feed-forward policy can match or beat honest play, "
+                "voiding the FF-vs-LSTM differential the probe exists "
+                "to measure"
             )
         self.length = length
         self.num_actions = 3  # 0/1 = answers, 2 = forward
