@@ -76,6 +76,10 @@ PROBE_SCHEDULE = ((60, 15), (90, 30), (120, 0))
 _TRANSPORT_MARKERS = (
     "jaxlib", "jax.errors", "xlaruntimeerror", "pjrt", "axon",
     "grpc", "xla_bridge", "libtpu",
+    # C++/glog-surfaced transport failures carry the source file or
+    # syscall instead of a Python module path (e.g. "E0730 ...
+    # tcp_posix.cc:123] recvmsg: Connection reset by peer").
+    "tcp_posix", "recvmsg", "tsl/", "socket_utils",
 )
 
 _CONNECTION_SIGNATURES = (

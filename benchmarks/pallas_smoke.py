@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import traceback
@@ -30,11 +31,18 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax  # noqa: E402
+
+if "JAX_PLATFORMS" in os.environ:
+    # The axon sitecustomize forces the remote backend BY CONFIG, not
+    # just env; a CPU rehearsal without this re-apply hangs on the
+    # tunnel (the round-3 profile_step trap).
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
 
-def attention_case(b, t, h, d, m, seed=0):
+def attention_case(b, t, h, d, m, seed=0, interpret=False):
     from torchbeast_tpu.ops.pallas_attention import (
         _reference,
         transformer_attention,
@@ -57,7 +65,7 @@ def attention_case(b, t, h, d, m, seed=0):
     )
     t0 = time.perf_counter()
     ours = transformer_attention(
-        m, False, q, k, v, seg, cache_valid, no_done, rel_bias
+        m, interpret, q, k, v, seg, cache_valid, no_done, rel_bias
     )
     jax.block_until_ready(ours)
     compile_s = time.perf_counter() - t0
@@ -74,7 +82,7 @@ def attention_case(b, t, h, d, m, seed=0):
     }
 
 
-def pool_case(shape, seed=0):
+def pool_case(shape, seed=0, interpret=False):
     from torchbeast_tpu.ops.pallas_pool import pool_bwd
 
     def fwd(x):
@@ -89,7 +97,7 @@ def pool_case(shape, seed=0):
     g = jnp.asarray(rng.standard_normal(y.shape), jnp.float32)
     gx_ref = vjp(g)[0]
     t0 = time.perf_counter()
-    gx = pool_bwd(x, y, g, interpret=False)
+    gx = pool_bwd(x, y, g, interpret=interpret)
     jax.block_until_ready(gx)
     compile_s = time.perf_counter() - t0
     err = float(jnp.max(jnp.abs(gx - gx_ref)))
@@ -109,20 +117,43 @@ def main() -> None:
         help="comma set: 'test' = unit-test shapes, 'chip' = flagship "
         "transformer/trunk shapes",
     )
+    ap.add_argument(
+        "--interpret", action="store_true",
+        help="run under the Pallas interpreter (CPU rehearsal of this "
+        "harness; rehearses numerics but NOT Mosaic lowering — the "
+        "chip run must stay interpret=False). Verified: a CPU run "
+        "without this flag fails cleanly per-case ('Only interpret "
+        "mode is supported on CPU backend') and still prints the "
+        "verdict line, which is the behavior a Mosaic lowering "
+        "failure would produce on chip day.",
+    )
     args = ap.parse_args()
     sizes = set(args.sizes.split(","))
+    itp = args.interpret
 
     backend = jax.default_backend()
     cases = []
     if "test" in sizes:
-        cases.append(("attn-test", lambda: attention_case(2, 12, 4, 16, 8)))
-        cases.append(("pool-test", lambda: pool_case((2, 21, 21, 32))))
+        cases.append(
+            ("attn-test",
+             lambda: attention_case(2, 12, 4, 16, 8, interpret=itp))
+        )
+        cases.append(
+            ("pool-test",
+             lambda: pool_case((2, 21, 21, 32), interpret=itp))
+        )
     if "chip" in sizes:
         # Flagship shapes: the transformer's RL-unroll attention
         # (models/transformer.py defaults) and the deep trunk's stage-1
         # pool (84x84 Atari, 32 channels).
-        cases.append(("attn-chip", lambda: attention_case(8, 20, 4, 64, 40)))
-        cases.append(("pool-chip", lambda: pool_case((8, 84, 84, 32))))
+        cases.append(
+            ("attn-chip",
+             lambda: attention_case(8, 20, 4, 64, 40, interpret=itp))
+        )
+        cases.append(
+            ("pool-chip",
+             lambda: pool_case((8, 84, 84, 32), interpret=itp))
+        )
 
     results, failures = [], []
     for name, fn in cases:
@@ -144,7 +175,8 @@ def main() -> None:
     print(json.dumps({
         "bench": "pallas_smoke",
         "backend": backend,
-        "mosaic": backend == "tpu",
+        "interpret": args.interpret,
+        "mosaic": backend == "tpu" and not args.interpret,
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "ok": not failures,
         "failures": failures,

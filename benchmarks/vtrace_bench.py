@@ -26,15 +26,13 @@ import sys
 import time
 from pathlib import Path
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    # Forced-CPU runs must also flip the config: the axon sitecustomize
-    # registers the remote backend by config, not just env (memory:
-    # round-3 profile_step.py hung on exactly this).
-    import jax
+import jax
 
-    jax.config.update("jax_platforms", "cpu")
-else:
-    import jax
+if "JAX_PLATFORMS" in os.environ:
+    # Any env override must also flip the config: the axon
+    # sitecustomize registers the remote backend by config, not just
+    # env (round-3 profile_step.py hung on exactly this).
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import jax.numpy as jnp
 

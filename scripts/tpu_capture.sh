@@ -47,7 +47,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # Sequential vs associative V-trace at T in {80, 1000, 4000}: the
     # O(log T) depth claim in --vtrace_impl's help text is decided by
     # this chip row (CPU rows only bound overhead).
-    timeout 300 python benchmarks/vtrace_bench.py \
+    # --no_artifact: this script's contract is that nothing lands in
+    # benchmarks/artifacts except bench.py's last_tpu refresh; the row
+    # is recoverable from $OUT/vtrace_bench.json.
+    timeout 300 python benchmarks/vtrace_bench.py --no_artifact \
       > "$OUT/vtrace_bench.json" 2> "$OUT/vtrace_bench.err"
     echo "vtrace bench rc=$?" >> "$OUT/watch.log"
     echo "=== profile bf16 ===" >> "$OUT/watch.log"

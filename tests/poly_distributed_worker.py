@@ -41,7 +41,7 @@ def main():
         "--coordinator_address", f"127.0.0.1:{port}",
         "--num_servers", "2",
         # Global batch; 2 local rows per host either way.
-        "--batch_size", "8" if mode == "dp_pod" else "4",
+        "--batch_size", "8" if mode.startswith("dp_pod") else "4",
         "--unroll_length", "5",
         "--total_steps", str(total_steps),
         "--savedir", savedir,
@@ -56,6 +56,17 @@ def main():
         # servers/actors/inference group (the pod story of reference
         # README.md:10 / polybeast_learner.py:436-444 address fan-out).
         argv += ["--model", "mlp", "--num_learner_devices", "8"]
+    elif mode == "dp_pod_tp":
+        # Composite pod: (data=4 x model=2) across 4 processes — the
+        # cross-host data axis carries the grad all-reduce while the
+        # host-local model axis runs the Megatron-paired transformer
+        # shardings; the multi-host generalization of the 2-process
+        # dp_tp mode above.
+        argv += [
+            "--model", "transformer",
+            "--num_learner_devices", "4",
+            "--tensor_parallel", "2",
+        ]
     elif mode == "dp_ep":
         # Composite (data=2 x expert=2) global mesh ACROSS the two
         # processes: collective updates carry both the grad all-reduce

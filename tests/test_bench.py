@@ -281,6 +281,14 @@ def test_marker_outside_traceback_block_does_not_attribute():
     )
     assert bench._is_transport_connection_error(stderr) is True
 
+    # C++/glog-surfaced transport failure: no Python traceback at all;
+    # the source file on the line is the attribution.
+    stderr = (
+        "E0730 12:34:56.789012 123 tcp_posix.cc:123] recvmsg: "
+        "Connection reset by peer\n"
+    )
+    assert bench._is_transport_connection_error(stderr) is True
+
 
 def test_unattributed_connection_error_is_code_not_infra(
     monkeypatch, capsys

@@ -1,0 +1,75 @@
+"""Contract tests for the capture-queue benchmark scripts: each must
+print exactly one machine-readable JSON verdict line on a CPU rehearsal
+(chip day consumes these outputs unattended — a format drift or import
+error must surface here, not mid-capture)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    proc = subprocess.run(
+        [sys.executable, *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO,
+    )
+    return proc
+
+
+def test_pallas_smoke_interpret_rehearsal(tmp_path):
+    proc = _run([
+        "benchmarks/pallas_smoke.py", "--interpret", "--sizes", "test",
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "pallas_smoke"
+    assert out["ok"] is True and out["failures"] == []
+    assert out["interpret"] is True
+    # A CPU interpreter pass must NOT claim the Mosaic box is checked.
+    assert out["mosaic"] is False
+    assert {c["case"] for c in out["cases"]} == {"attn-test", "pool-test"}
+
+
+def test_pallas_smoke_compiled_cpu_fails_cleanly():
+    """interpret=False on CPU cannot lower — the contract is a clean
+    per-case failure with the verdict line still printed and rc=1 (the
+    exact behavior a Mosaic lowering failure must produce on chip)."""
+    proc = _run(["benchmarks/pallas_smoke.py", "--sizes", "test"])
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is False
+    assert set(out["failures"]) == {"attn-test", "pool-test"}
+    for case in out["cases"]:
+        assert "error" in case and "traceback" in case
+
+
+def test_vtrace_bench_emits_rows(tmp_path):
+    out_md = tmp_path / "vtrace.md"
+    proc = _run([
+        "benchmarks/vtrace_bench.py", "--steps", "3", "--batch", "4",
+        "--out", str(out_md),
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "vtrace_scan"
+    assert [r["T"] for r in out["rows"]] == [80, 1000, 4000]
+    for r in out["rows"]:
+        assert r["sequential_ms"] > 0 and r["associative_ms"] > 0
+        assert r["assoc_speedup"] > 0
+    # CPU rows must carry the "chip row decides" caveat; the artifact
+    # table is appended with the platform in the header.
+    assert out["caveat"] is not None
+    assert "| 4000 |" in out_md.read_text()
