@@ -76,10 +76,6 @@ PROBE_SCHEDULE = ((60, 15), (90, 30), (120, 0))
 _TRANSPORT_MARKERS = (
     "jaxlib", "jax.errors", "xlaruntimeerror", "pjrt", "axon",
     "grpc", "xla_bridge", "libtpu",
-    # C++/glog-surfaced transport failures carry the source file or
-    # syscall instead of a Python module path (e.g. "E0730 ...
-    # tcp_posix.cc:123] recvmsg: Connection reset by peer").
-    "tcp_posix", "recvmsg", "tsl/", "socket_utils",
 )
 
 _CONNECTION_SIGNATURES = (
@@ -103,6 +99,18 @@ def _is_transport_connection_error(stderr: str) -> bool:
     """
     block = None  # lines of the currently-open traceback block
     for line in stderr.splitlines():
+        # glog FATAL lines ("F0730 12:34:56... ] Socket closed") kill
+        # the process from inside the C++ transport — no Python
+        # traceback exists, so the F-line itself attributes. E-level
+        # glog lines deliberately do NOT: grpc/TSL log benign
+        # "recvmsg: Connection reset by peer" noise during ordinary
+        # channel teardown, and attributing those would let any code
+        # crash whose shutdown emits one replay stale chip numbers.
+        if (
+            len(line) > 5 and line[0] == "F" and line[1:5].isdigit()
+            and any(sig in line for sig in _CONNECTION_SIGNATURES)
+        ):
+            return True
         if line.startswith("Traceback (most recent call last):"):
             block = [line]
             continue

@@ -281,13 +281,22 @@ def test_marker_outside_traceback_block_does_not_attribute():
     )
     assert bench._is_transport_connection_error(stderr) is True
 
-    # C++/glog-surfaced transport failure: no Python traceback at all;
-    # the source file on the line is the attribution.
+    # C++/glog FATAL transport failure: the process died inside the
+    # transport, no Python traceback exists — the F-line attributes.
+    stderr = (
+        "F0730 12:34:56.789012 123 tcp_posix.cc:123] "
+        "Socket closed\n"
+    )
+    assert bench._is_transport_connection_error(stderr) is True
+
+    # E-level glog connection noise is AMBIENT (grpc/TSL log it during
+    # ordinary channel teardown); it must not turn a code crash into a
+    # stale-chip-number replay.
     stderr = (
         "E0730 12:34:56.789012 123 tcp_posix.cc:123] recvmsg: "
         "Connection reset by peer\n"
     )
-    assert bench._is_transport_connection_error(stderr) is True
+    assert bench._is_transport_connection_error(stderr) is False
 
 
 def test_unattributed_connection_error_is_code_not_infra(

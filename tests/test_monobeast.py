@@ -152,6 +152,40 @@ def test_lstm_solves_memory_env(tmp_path):
     assert ff_stats.get("mean_episode_return", 1.0) < 0.5
 
 
+@pytest.mark.slow
+def test_transformer_solves_memory_env(tmp_path):
+    """Attention-as-memory: the transformer policy (no LSTM) solves the
+    Memory probe because its segment-masked attention over the KV cache
+    retrieves the cue frame at the query step — the same differential
+    the LSTM test pins, carried by the OTHER memory mechanism. This
+    functionally exercises the acting-path cache (the cue enters the
+    cache at t=0 and must survive, segment-masked, to t=length-1) and
+    the learner's full-attention replay.
+
+    Hyperparameters matter here: at lr 1e-3 roughly 1 run in 3 locks
+    into the inverted-answer trap (the policy READS the cue — proof
+    attention works — but saturates on the wrong answer while the
+    value head learns to predict the −1 exactly, zeroing the
+    advantage). lr 5e-4 + entropy 0.02 escaped in 8/8 pilot reps by
+    150k steps (benchmarks/artifacts/lstm_learning.md §4)."""
+    flags = monobeast.make_parser().parse_args([
+        "--env", "Memory",
+        "--model", "transformer",
+        "--num_actors", "16",
+        "--batch_size", "16",
+        "--unroll_length", "20",
+        "--total_steps", "150000",
+        "--serial_envs",
+        "--learning_rate", "5e-4",
+        "--entropy_cost", "0.02",
+        "--savedir", str(tmp_path),
+        "--xpid", "mem-transformer",
+        "--checkpoint_interval_s", "100000",
+    ])
+    stats = monobeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.6
+
+
 def test_trunk_channels_validation(tmp_path):
     with pytest.raises(ValueError, match="deep only"):
         monobeast.train(

@@ -168,6 +168,28 @@ def test_poly_lstm_solves_memory_env(tmp_path):
     assert stats.get("mean_episode_return", -1.0) > 0.6
 
 
+@pytest.mark.slow
+def test_poly_transformer_solves_memory_env(tmp_path):
+    """Attention-as-memory through the ASYNC stack: the transformer's
+    incremental KV cache rides per-actor through the DynamicBatcher
+    into jitted inference and back (the same route the LSTM state takes
+    in test_poly_lstm_solves_memory_env), and must deliver the t=0 cue,
+    segment-masked, to the query step. Hyperparameters are the
+    saturation-safe pair from the mono twin (lr 5e-4, entropy 0.02 —
+    see tests/test_monobeast.py::test_transformer_solves_memory_env);
+    pilot sustained 1.0 through 150k at ~960 SPS
+    (benchmarks/artifacts/lstm_learning.md §4)."""
+    flags = make_flags(
+        tmp_path, xpid="poly-mem-transformer", env="Memory",
+        model="transformer", num_servers="8", num_actors="16",
+        batch_size="16", unroll_length="20", total_steps="150000",
+        learning_rate="5e-4", entropy_cost="0.02",
+        max_inference_batch_size="16",
+    )
+    stats = polybeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.6
+
+
 def test_failed_validation_reaps_servers(tmp_path):
     """A post-spawn failure (here: a flag-validation raise) must reap
     the just-spawned env-server group — terminate-without-join used to
