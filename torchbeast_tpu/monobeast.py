@@ -170,6 +170,12 @@ def make_parser():
                              "depth, so either mode is stricter than "
                              "the reference).")
     parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--env_seed", type=int, default=None,
+                        help="Base seed for stochastic envs; env i draws "
+                             "from env_seed+i, so actors stay decorrelated "
+                             "but the run reproduces (with --serial_envs "
+                             "and a fixed --seed, end-to-end). Default: OS "
+                             "entropy per env.")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600,
                         help="Seconds between checkpoints (reference: 10min).")
     # Loss settings.
@@ -214,8 +220,13 @@ def hparams_from_flags(flags) -> learner_lib.HParams:
 def _make_pool(flags, num_envs):
     # functools.partial (not a lambda): ProcessEnvPool pickles the factory
     # into spawn-context workers.
+    env_seed = getattr(flags, "env_seed", None)
     env_fns = [
-        functools.partial(create_env, flags.env) for _ in range(num_envs)
+        functools.partial(
+            create_env, flags.env,
+            seed=None if env_seed is None else env_seed + i,
+        )
+        for i in range(num_envs)
     ]
     if flags.serial_envs:
         return SerialEnvPool(env_fns)
@@ -867,7 +878,11 @@ def test(flags):
 
     from torchbeast_tpu.envs.environment import Environment
 
-    env = Environment(create_env(flags.env))
+    # Same seed contract as training: --env_seed pins the eval env's
+    # draw stream so repeated evaluations of a checkpoint reproduce.
+    env = Environment(
+        create_env(flags.env, seed=getattr(flags, "env_seed", None))
+    )
     act = jax.jit(
         lambda p, inputs, state: model.apply(
             p, inputs, state, sample_action=False

@@ -94,6 +94,11 @@ def make_parser():
                              "16/32/32). See monobeast and "
                              "benchmarks/mfu_ablation.py.")
     parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--env_seed", type=int, default=None,
+                        help="Base seed for stochastic envs (see "
+                             "polybeast_env --env_seed). Multi-host runs "
+                             "offset it per host so no two hosts share a "
+                             "stream. Default: OS entropy per env.")
     parser.add_argument("--num_inference_threads", type=int, default=2)
     parser.add_argument("--native_runtime", action="store_true",
                         help="Use the C++ queues/batcher/actor-pool "
@@ -280,10 +285,24 @@ def train(flags):
     server_procs = []
     try:
         if flags.start_servers:
+            env_seed = getattr(flags, "env_seed", None)
+            if env_seed is not None:
+                # Per-host offset past every seed server i on one host
+                # can derive (i*1000 + stream): hosts share --env_seed
+                # but never a stream.
+                env_seed += proc_id * flags.num_servers * 1000
             server_procs = polybeast_env.start_servers(
-                flags, pipes_basename=pipes_basename
+                flags, pipes_basename=pipes_basename, env_seed=env_seed
             )
             time.sleep(0.5)
+        elif getattr(flags, "env_seed", None) is not None:
+            log.warning(
+                "--env_seed has no effect with --no_start_servers: env "
+                "seeding lives in the server processes. Pass --env_seed "
+                "to each external polybeast_env launch instead (use a "
+                "distinct value per host; this driver cannot offset "
+                "servers it did not start)."
+            )
 
         hp = hparams_from_flags(flags)
         num_actions, frame_shape, frame_dtype = _probe_env_via_server(

@@ -8,6 +8,7 @@ Run:  python -m torchbeast_tpu.polybeast_env --num_servers 4 --env Mock
 
 import argparse
 import functools
+import itertools
 import logging
 import multiprocessing as mp
 import time
@@ -30,6 +31,12 @@ def make_parser():
     parser.add_argument("--num_servers", type=int, default=4)
     parser.add_argument("--env", type=str, default="PongNoFrameskip-v4",
                         help="Gym environment (or Mock / Counting).")
+    parser.add_argument("--env_seed", type=int, default=None,
+                        help="Base seed for stochastic envs. Server i "
+                             "seeds its streams from env_seed + i*1000 "
+                             "+ stream index: every env instance draws a "
+                             "distinct deterministic stream. Default: OS "
+                             "entropy per env.")
     parser.add_argument("--native_server", action="store_true",
                         help="Serve with the C++ EnvServer (_tbt_core): "
                              "socket I/O and wire codec run GIL-free, the "
@@ -60,11 +67,23 @@ def host_scoped_basename(pipes_basename: str, process_id: int,
     return f"{host}:{int(port) + process_id * num_servers}"
 
 
-def _serve(env_name: str, address: str, native: bool = False):
+def _serve(env_name: str, address: str, native: bool = False,
+           seed_base=None):
     # Child process body. Import here: workers must never inherit JAX state.
     from torchbeast_tpu.envs import create_env
 
-    env_init = functools.partial(create_env, env_name)
+    if seed_base is None:
+        env_init = functools.partial(create_env, env_name)
+    else:
+        # Fresh env per actor stream (both server impls call env_init
+        # once per connection): stream s draws seed_base + s. The
+        # counter is GIL-guarded — the native server, too, invokes
+        # env_init holding the GIL. Reproducible seed SET; which stream
+        # gets which seed follows connection order.
+        counter = itertools.count()
+
+        def env_init():
+            return create_env(env_name, seed=seed_base + next(counter))
     if native:
         from torchbeast_tpu.runtime.native import import_native
 
@@ -81,15 +100,20 @@ def _serve(env_name: str, address: str, native: bool = False):
     EnvServer(env_init, address).run()
 
 
-def start_servers(flags, ctx_name: str = "spawn", pipes_basename=None):
+def start_servers(flags, ctx_name: str = "spawn", pipes_basename=None,
+                  env_seed=None):
     basename = pipes_basename or flags.pipes_basename
     native = getattr(flags, "native_server", False)
+    if env_seed is None:
+        env_seed = getattr(flags, "env_seed", None)
     ctx = mp.get_context(ctx_name)
     processes = []
     for i in range(flags.num_servers):
         address = server_address(basename, i)
+        seed_base = None if env_seed is None else env_seed + i * 1000
         p = ctx.Process(
-            target=_serve, args=(flags.env, address, native), daemon=True
+            target=_serve, args=(flags.env, address, native, seed_base),
+            daemon=True,
         )
         p.start()
         processes.append(p)
