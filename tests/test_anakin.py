@@ -171,3 +171,23 @@ def test_anakin_lstm_solves_memory(tmp_path):
         learning_rate="1e-3", log_interval_updates="100",
     )
     assert ff.get("mean_episode_return", 1.0) < 0.5
+
+
+@pytest.mark.slow
+def test_anakin_transformer_solves_memory(tmp_path):
+    """Attention-as-memory INSIDE the fused on-device program: the
+    transformer's KV cache rides the acting lax.scan as carry (where
+    the LSTM test's hidden state rides), so the t=0 cue must survive
+    on-device cache updates + segment masking to the query step.
+    Completes the {LSTM, transformer} x {mono, poly, anakin} Memory
+    matrix. Deterministic: anakin is pure-jax PRNG (fixed --seed).
+    Pilot: 1.0 from the second log point (~61k steps), sustained
+    through 2M (benchmarks/artifacts/lstm_learning.md §4); lr 5e-4 +
+    entropy 0.02 per the saturation-trap note there."""
+    stats = run_anakin(
+        tmp_path, total_steps=600_000, xpid="anakin-mem-transformer",
+        env="Memory", model="transformer", batch_size="64",
+        unroll_length="12", learning_rate="5e-4", entropy_cost="0.02",
+        log_interval_updates="100",
+    )
+    assert stats.get("mean_episode_return", -1.0) > 0.6
