@@ -115,6 +115,18 @@ def test_anakin_data_parallel(tmp_path):
 
 
 class TestMemoryJax:
+    def test_parameterized_corridor_id(self):
+        """create_jax_env reads the same Memory-L<n> ids as the host
+        create_env, so anakin's --env flag accepts them too."""
+        import pytest
+
+        from torchbeast_tpu.envs.jax_env import create_jax_env
+
+        env = create_jax_env("Memory-L41")
+        assert env.env.length == 41
+        with pytest.raises(ValueError, match="length must be >= 6"):
+            create_jax_env("Memory-L5")
+
     def test_parity_with_host_env(self):
         """MemoryChainJax is a rule-for-rule twin of the host
         MemoryChainEnv: identical frames, rewards, and done flags for

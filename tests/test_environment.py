@@ -130,6 +130,14 @@ def test_create_env_seed_plumbing():
     assert cues(7) == cues(7)
     assert cues(7) != cues(8)  # 2^-12 false-failure odds
 
+    # Parameterized corridor ids: "Memory-L<n>" sets the length (same
+    # >= 6 floor as the bare constructor).
+    assert create_env("Memory-L41").length == 41
+    import pytest
+
+    with pytest.raises(ValueError, match="length must be >= 6"):
+        create_env("Memory-L5")
+
     def catch_frames(seed):
         env = create_env("Catch", seed=seed)
         return [env.reset().tobytes() for _ in range(8)]

@@ -167,14 +167,15 @@ def test_transformer_solves_memory_env(tmp_path):
     the learner's full-attention replay.
 
     Hyperparameters matter here: at lr 1e-3 roughly 1 run in 3 locks
-    into the inverted-answer trap (the policy READS the cue — proof
-    attention works — but saturates on the wrong answer while the
-    value head learns to predict the −1 exactly, zeroing the
-    advantage). lr 5e-4 + entropy 0.02 escaped in 8/8 pilot reps by
-    150k steps (benchmarks/artifacts/lstm_learning.md §4); --env_seed 1
-    (verified passing) + serial envs + the fixed model seed make this
-    run deterministic, so the residual trap odds cannot flake the
-    test."""
+    into a query-compliance collapse — the corridor penalty's
+    "always forward" habit generalizes to the query frame, the
+    deterministic −1 there is predicted exactly by the value head, and
+    the zeroed advantage freezes the policy (checkpoint rollouts show
+    query_action=2 every episode; lstm_learning.md §4 has the
+    corrected analysis). lr 5e-4 + entropy 0.02 escaped in 8/8 pilot
+    reps by 150k steps; --env_seed 1 (verified passing) + serial envs
+    + the fixed model seed make this run deterministic, so the
+    residual trap odds cannot flake the test."""
     flags = monobeast.make_parser().parse_args([
         "--env", "Memory",
         "--model", "transformer",

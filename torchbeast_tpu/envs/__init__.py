@@ -39,6 +39,14 @@ def create_env(name: str, seed=None, **kwargs):
         return CatchEnv(seed=seed, **kwargs)
     if name == "Memory":
         return MemoryChainEnv(seed=seed, **kwargs)
+    if name.startswith("Memory-L"):
+        # Parameterized corridor: "Memory-L41" = length-41 probe (cue
+        # 40 steps before the query). Id-encoded like gym's
+        # "-v4"-style suffixes so every driver gets it through the
+        # existing --env flag.
+        return MemoryChainEnv(
+            length=int(name[len("Memory-L"):]), seed=seed, **kwargs
+        )
     from torchbeast_tpu.envs.atari import create_atari_env
 
     return create_atari_env(name, seed=seed, **kwargs)

@@ -207,6 +207,13 @@ _JAX_ENVS = {
 
 
 def create_jax_env(name: str, **kwargs) -> JaxEnvironment:
+    if name.startswith("Memory-L"):
+        # Same parameterized-corridor ids as the host-side create_env
+        # ("Memory-L41" = length-41 probe), so every driver including
+        # anakin reads them from the one --env flag.
+        return JaxEnvironment(
+            MemoryChainJax(length=int(name[len("Memory-L"):]), **kwargs)
+        )
     try:
         cls = _JAX_ENVS[name]
     except KeyError:
