@@ -131,12 +131,15 @@ def test_create_env_seed_plumbing():
     assert cues(7) != cues(8)  # 2^-12 false-failure odds
 
     # Parameterized corridor ids: "Memory-L<n>" sets the length (same
-    # >= 6 floor as the bare constructor).
+    # >= 6 floor as the bare constructor); malformed suffixes get the
+    # grammar error, not a bare int() failure.
     assert create_env("Memory-L41").length == 41
     import pytest
 
     with pytest.raises(ValueError, match="length must be >= 6"):
         create_env("Memory-L5")
+    with pytest.raises(ValueError, match="Bad Memory id"):
+        create_env("Memory-Lstm")
 
     def catch_frames(seed):
         env = create_env("Catch", seed=seed)

@@ -135,3 +135,34 @@ def test_rmsprop_matches_torch_semantics():
     ow = optax.apply_updates(ow, updates)
 
     np.testing.assert_allclose(ow, tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_entropy_schedule_anneal_and_constant():
+    """entropy_schedule shares the LR decay's update clock: linear from
+    entropy_cost to entropy_cost_final over total_steps frames, clamped
+    past the horizon; None final = constant (returns None so
+    compute_loss uses hp.entropy_cost untouched)."""
+    import optax.tree_utils as otu
+
+    from torchbeast_tpu import learner as learner_lib
+
+    hp = learner_lib.HParams(
+        entropy_cost=0.2, entropy_cost_final=0.0,
+        total_steps=1000, unroll_length=10, batch_size=10,
+    )  # 10 updates to anneal over
+    opt = learner_lib.make_optimizer(hp)
+    state = opt.init({"w": jnp.zeros(3)})
+    at = learner_lib.entropy_schedule(hp)
+
+    def with_count(n):
+        return otu.tree_set(state, count=jnp.asarray(n, jnp.int32))
+
+    np.testing.assert_allclose(float(at(with_count(0))), 0.2)
+    np.testing.assert_allclose(float(at(with_count(5))), 0.1)
+    np.testing.assert_allclose(float(at(with_count(10))), 0.0)
+    np.testing.assert_allclose(float(at(with_count(20))), 0.0)  # clamped
+
+    constant = learner_lib.entropy_schedule(
+        hp._replace(entropy_cost_final=None)
+    )
+    assert constant(state) is None

@@ -196,6 +196,34 @@ def test_transformer_solves_memory_env(tmp_path):
 
 
 @pytest.mark.slow
+def test_entropy_anneal_cracks_long_corridor(tmp_path):
+    """--entropy_cost_final turns the L41 Memory corridor from
+    unsolvable (0/6 constant-entropy configs, lstm_learning.md §4b)
+    into solved (3/3 pilot seeds, first crossing ~479k steps): early
+    high entropy keeps answer actions sampled at the query until the
+    +2 advantage takes hold, and the anneal removes the tax before
+    convergence. Deterministic via env_seed + serial envs."""
+    flags = monobeast.make_parser().parse_args([
+        "--env", "Memory-L41",
+        "--model", "transformer",
+        "--num_actors", "16",
+        "--batch_size", "16",
+        "--unroll_length", "47",
+        "--total_steps", "1000000",
+        "--serial_envs",
+        "--learning_rate", "5e-4",
+        "--entropy_cost", "0.2",
+        "--entropy_cost_final", "0.01",
+        "--env_seed", "1",
+        "--savedir", str(tmp_path),
+        "--xpid", "anneal41",
+        "--checkpoint_interval_s", "100000",
+    ])
+    stats = monobeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.6
+
+
+@pytest.mark.slow
 def test_env_seed_makes_runs_reproducible(tmp_path):
     """--env_seed + --serial_envs + fixed --seed = bit-reproducible
     training: the only OS entropy in the sync driver is the env draw

@@ -258,3 +258,37 @@ def test_transformer_tp_rejects_indivisible_heads():
     )
     with pytest.raises(ValueError, match="num_heads"):
         transformer_tp_shardings(mesh, params)
+
+
+def test_parallel_update_applies_entropy_anneal(setup):
+    """The mesh-sharded update must run the SAME loss-side schedule as
+    the single-device one (they share learner.update_body): with an
+    entropy anneal armed, the entropy_loss stat at the half-horizon
+    count is half the count-0 value on identical params/batch."""
+    import optax.tree_utils as otu
+
+    model, params, state, hp, _ = setup
+    hp = hp._replace(
+        entropy_cost=1.0, entropy_cost_final=0.0,
+        total_steps=10 * T * B,  # 10-update horizon
+    )
+    optimizer = learner_lib.make_optimizer(hp)
+    mesh = create_mesh(8)
+    step = make_parallel_update_step(
+        model, optimizer, hp, mesh, donate=False
+    )
+    batch = make_batch()
+    opt_state = optimizer.init(params)
+    p = replicate(mesh, params)
+    o = replicate(mesh, optimizer.init(params))
+    b, s = shard_batch(mesh, batch, state)
+
+    _, _, stats0 = step(p, o, b, s)
+    o5 = replicate(
+        mesh, otu.tree_set(opt_state, count=jnp.asarray(5, jnp.int32))
+    )
+    _, _, stats5 = step(p, o5, b, s)
+    e0 = float(stats0["entropy_loss"])
+    e5 = float(stats5["entropy_loss"])
+    assert e0 != 0.0
+    np.testing.assert_allclose(e5, 0.5 * e0, rtol=1e-5)

@@ -89,6 +89,9 @@ def make_parser():
     parser.add_argument("--log_interval_updates", type=int, default=20)
     # Loss/optimizer knobs (reference defaults).
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
+    parser.add_argument("--entropy_cost_final", type=float, default=None,
+                        help="Linearly anneal entropy cost to this over "
+                             "total_steps (default: constant).")
     parser.add_argument("--baseline_cost", type=float, default=0.5)
     parser.add_argument("--discounting", type=float, default=0.99)
     parser.add_argument("--reward_clipping", default="abs_one",
@@ -158,7 +161,8 @@ def make_train_step(env, model, optimizer, hp: learner_lib.HParams, mesh=None):
 
         grads, stats = jax.grad(
             lambda p: learner_lib.compute_loss(
-                model, p, batch, initial_agent_state, hp
+                model, p, batch, initial_agent_state, hp,
+                entropy_cost=learner_lib.entropy_schedule(hp)(opt_state),
             ),
             has_aux=True,
         )(params)
@@ -241,6 +245,7 @@ def train(flags):
         discounting=flags.discounting,
         baseline_cost=flags.baseline_cost,
         entropy_cost=flags.entropy_cost,
+        entropy_cost_final=getattr(flags, "entropy_cost_final", None),
         reward_clipping=flags.reward_clipping,
         learning_rate=flags.learning_rate,
         rmsprop_alpha=flags.alpha,

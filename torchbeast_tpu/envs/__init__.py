@@ -14,6 +14,7 @@ from torchbeast_tpu.envs.mock import (  # noqa: F401
     CountingEnv,
     MemoryChainEnv,
     MockEnv,
+    parse_memory_id,
 )
 
 
@@ -37,16 +38,13 @@ def create_env(name: str, seed=None, **kwargs):
         return CountingEnv(**kwargs)  # deterministic; nothing to seed
     if name == "Catch":
         return CatchEnv(seed=seed, **kwargs)
-    if name == "Memory":
-        return MemoryChainEnv(seed=seed, **kwargs)
-    if name.startswith("Memory-L"):
-        # Parameterized corridor: "Memory-L41" = length-41 probe (cue
-        # 40 steps before the query). Id-encoded like gym's
-        # "-v4"-style suffixes so every driver gets it through the
-        # existing --env flag.
-        return MemoryChainEnv(
-            length=int(name[len("Memory-L"):]), seed=seed, **kwargs
-        )
+    # Parameterized corridor ids: "Memory" (default length) or
+    # "Memory-L41" (cue 40 steps before the query) — id-encoded like
+    # gym's "-v4"-style suffixes so every driver reads them from the
+    # one --env flag (parse shared with the jittable twin).
+    memory_length = parse_memory_id(name)
+    if memory_length is not None:
+        return MemoryChainEnv(length=memory_length, seed=seed, **kwargs)
     from torchbeast_tpu.envs.atari import create_atari_env
 
     return create_atari_env(name, seed=seed, **kwargs)

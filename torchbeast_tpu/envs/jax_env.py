@@ -207,13 +207,14 @@ _JAX_ENVS = {
 
 
 def create_jax_env(name: str, **kwargs) -> JaxEnvironment:
-    if name.startswith("Memory-L"):
-        # Same parameterized-corridor ids as the host-side create_env
-        # ("Memory-L41" = length-41 probe), so every driver including
-        # anakin reads them from the one --env flag.
-        return JaxEnvironment(
-            MemoryChainJax(length=int(name[len("Memory-L"):]), **kwargs)
-        )
+    from torchbeast_tpu.envs.mock import parse_memory_id
+
+    # Same parameterized-corridor ids as the host-side create_env
+    # (ONE grammar, envs/mock.py:parse_memory_id), so every driver
+    # including anakin reads them from the one --env flag.
+    memory_length = parse_memory_id(name)
+    if memory_length is not None:
+        return JaxEnvironment(MemoryChainJax(length=memory_length, **kwargs))
     try:
         cls = _JAX_ENVS[name]
     except KeyError:

@@ -10,6 +10,24 @@ tests/core_agent_state_env.py: frame counts steps, episode ends every
 import numpy as np
 
 
+def parse_memory_id(name: str):
+    """Memory-probe env ids -> corridor length, or None if `name` is not
+    a Memory id. "Memory" = default length; "Memory-L41" = length 41.
+    ONE grammar shared by the host create_env and the jittable
+    create_jax_env so the id set cannot drift between drivers."""
+    if name == "Memory":
+        return MemoryChainEnv.__init__.__defaults__[0]  # default length
+    if name.startswith("Memory-L"):
+        suffix = name[len("Memory-L"):]
+        if not suffix.isdigit():
+            raise ValueError(
+                f"Bad Memory id {name!r}: expected Memory-L<n> with a "
+                "positive integer length (e.g. Memory-L41)"
+            )
+        return int(suffix)
+    return None
+
+
 class MockEnv:
     """Fixed-length episodes, constant reward, zero frames."""
 

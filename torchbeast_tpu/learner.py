@@ -36,6 +36,12 @@ class HParams(NamedTuple):
     discounting: float = 0.99
     baseline_cost: float = 0.5
     entropy_cost: float = 0.0006
+    # Optional linear anneal: entropy cost moves from entropy_cost to
+    # entropy_cost_final over total_steps env frames (None = constant,
+    # the reference behavior). High-early/low-late escapes the Memory
+    # probe's query-compliance collapse (lstm_learning.md §4/4b)
+    # without paying a permanent entropy tax at convergence.
+    entropy_cost_final: float = None
     reward_clipping: str = "abs_one"  # or "none"
     learning_rate: float = 4.8e-4
     rmsprop_alpha: float = 0.99
@@ -50,6 +56,13 @@ class HParams(NamedTuple):
     vtrace_impl: str = "sequential"
 
 
+def updates_horizon(hp: HParams) -> int:
+    """Optimizer updates in a run: total_steps env frames at T*B frames
+    per update. The ONE schedule clock — the LR decay and the entropy
+    anneal both divide by this, so they cannot drift apart."""
+    return max(1, hp.total_steps // (hp.unroll_length * hp.batch_size))
+
+
 def make_optimizer(hp: HParams) -> optax.GradientTransformation:
     """torch.optim.RMSprop semantics + grad clip + linear LR decay.
 
@@ -58,11 +71,10 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
     frames; each optimizer step consumes T*B frames (the reference's
     LambdaLR closure, monobeast.py:395-398).
     """
-    frames_per_update = hp.unroll_length * hp.batch_size
     schedule = optax.linear_schedule(
         init_value=hp.learning_rate,
         end_value=0.0,
-        transition_steps=max(1, hp.total_steps // frames_per_update),
+        transition_steps=updates_horizon(hp),
     )
     return optax.chain(
         optax.clip_by_global_norm(hp.grad_norm_clipping),
@@ -77,7 +89,8 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
 
 
 def compute_loss(
-    model, params, batch: Dict[str, jnp.ndarray], initial_agent_state, hp: HParams
+    model, params, batch: Dict[str, jnp.ndarray], initial_agent_state,
+    hp: HParams, entropy_cost=None,
 ):
     """Forward the full [T+1, B] batch and build the IMPALA loss.
 
@@ -129,7 +142,11 @@ def compute_loss(
     baseline_loss = hp.baseline_cost * compute_baseline_loss(
         vtrace_returns.vs - values
     )
-    entropy_loss = hp.entropy_cost * compute_entropy_loss(target_logits)
+    # entropy_cost may be a traced scalar (the annealed schedule from
+    # make_update_step); None = the constant from hp.
+    if entropy_cost is None:
+        entropy_cost = hp.entropy_cost
+    entropy_loss = entropy_cost * compute_entropy_loss(target_logits)
     total_loss = pg_loss + baseline_loss + entropy_loss + aux_loss
 
     # Episode stats: fixed-shape aggregates (a boolean-mask gather would be
@@ -177,23 +194,48 @@ def donate_argnums_for(donate) -> tuple:
     return (0, 1) if donate else ()
 
 
-def make_update_step(
-    model, optimizer: optax.GradientTransformation, hp: HParams,
-    donate=True,
-):
-    """Build the jitted learner step.
+def entropy_schedule(hp: HParams):
+    """opt_state -> entropy cost for this update (None = constant).
+
+    When `entropy_cost_final` is set, reuses the LR schedule's clock —
+    the optimizer state's `count` ticks once per update — to anneal
+    linearly over the same frames horizon as the reference's LR decay,
+    so no extra step argument threads through driver signatures.
+    """
+    if hp.entropy_cost_final is None:
+        return lambda opt_state: None
+    total_updates = updates_horizon(hp)
+
+    def entropy_cost_at(opt_state):
+        count = optax.tree_utils.tree_get(opt_state, "count")
+        frac = jnp.minimum(count.astype(jnp.float32) / total_updates, 1.0)
+        return hp.entropy_cost + frac * (
+            hp.entropy_cost_final - hp.entropy_cost
+        )
+
+    return entropy_cost_at
+
+
+def update_body(model, optimizer: optax.GradientTransformation, hp: HParams):
+    """The UNJITTED learner step:
 
     (params, opt_state, batch, initial_agent_state) ->
         (new_params, new_opt_state, stats)
 
-    `donate` is a policy understood by donate_argnums_for: True (donate
-    params+opt, single-threaded drivers), "opt_only" (async drivers —
-    the shared params stay undonated), or False.
+    One definition shared by the single-device jit (make_update_step)
+    and the mesh-sharded jit (parallel/dp.make_parallel_update_step) —
+    a loss-side knob added here (e.g. the entropy anneal) reaches every
+    learner path or none, never one of the two.
     """
+    entropy_cost_at = entropy_schedule(hp)
 
     def update_step(params, opt_state, batch, initial_agent_state):
+        ecost = entropy_cost_at(opt_state)
         grad_fn = jax.grad(
-            lambda p: compute_loss(model, p, batch, initial_agent_state, hp),
+            lambda p: compute_loss(
+                model, p, batch, initial_agent_state, hp,
+                entropy_cost=ecost,
+            ),
             has_aux=True,
         )
         grads, stats = grad_fn(params)
@@ -202,7 +244,23 @@ def make_update_step(
         stats["grad_norm"] = optax.global_norm(grads)
         return params, opt_state, stats
 
-    return jax.jit(update_step, donate_argnums=donate_argnums_for(donate))
+    return update_step
+
+
+def make_update_step(
+    model, optimizer: optax.GradientTransformation, hp: HParams,
+    donate=True,
+):
+    """Build the jitted learner step (see update_body for the contract).
+
+    `donate` is a policy understood by donate_argnums_for: True (donate
+    params+opt, single-threaded drivers), "opt_only" (async drivers —
+    the shared params stay undonated), or False.
+    """
+    return jax.jit(
+        update_body(model, optimizer, hp),
+        donate_argnums=donate_argnums_for(donate),
+    )
 
 
 def act_body(model, params, rng, env_output, agent_state):

@@ -180,6 +180,13 @@ def make_parser():
                         help="Seconds between checkpoints (reference: 10min).")
     # Loss settings.
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
+    parser.add_argument("--entropy_cost_final", type=float, default=None,
+                        help="Linearly anneal the entropy cost from "
+                             "--entropy_cost to this value over "
+                             "total_steps (default: constant). "
+                             "High-early/low-late exploration escapes "
+                             "compliance traps like the Memory probe's "
+                             "(lstm_learning.md 4/4b).")
     parser.add_argument("--baseline_cost", type=float, default=0.5)
     parser.add_argument("--discounting", type=float, default=0.99)
     parser.add_argument("--reward_clipping", default="abs_one",
@@ -204,6 +211,7 @@ def hparams_from_flags(flags) -> learner_lib.HParams:
         discounting=flags.discounting,
         baseline_cost=flags.baseline_cost,
         entropy_cost=flags.entropy_cost,
+        entropy_cost_final=getattr(flags, "entropy_cost_final", None),
         reward_clipping=flags.reward_clipping,
         learning_rate=flags.learning_rate,
         rmsprop_alpha=flags.alpha,

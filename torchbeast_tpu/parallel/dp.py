@@ -20,7 +20,6 @@ from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
-import optax
 
 from torchbeast_tpu import learner as learner_lib
 from torchbeast_tpu.parallel import mesh as mesh_lib
@@ -81,17 +80,9 @@ def make_parallel_update_step(
     ssh = mesh_lib.state_sharding(mesh)
     psh = repl if param_shardings is None else param_shardings
 
-    def update_step(params, opt_state, batch, initial_agent_state):
-        grads, stats = jax.grad(
-            lambda p: learner_lib.compute_loss(
-                model, p, batch, initial_agent_state, hp
-            ),
-            has_aux=True,
-        )(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        stats["grad_norm"] = optax.global_norm(grads)
-        return params, opt_state, stats
+    # The exact single-device update body (incl. the entropy-anneal
+    # schedule); only the jit wrapping — shardings + donation — differs.
+    update_step = learner_lib.update_body(model, optimizer, hp)
 
     # A single NamedSharding acts as a pytree prefix: it applies to every
     # leaf of the batch dict (all leaves are [T+1, B, ...]). Optimizer

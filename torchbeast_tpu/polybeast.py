@@ -185,6 +185,10 @@ def make_parser():
     parser.add_argument("--checkpoint_interval_s", type=int, default=600)
     # Loss / optimizer (same knobs as monobeast).
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
+    parser.add_argument("--entropy_cost_final", type=float, default=None,
+                        help="Linearly anneal entropy cost to this over "
+                             "total_steps (default: constant). See "
+                             "monobeast --entropy_cost_final.")
     parser.add_argument("--baseline_cost", type=float, default=0.5)
     parser.add_argument("--discounting", type=float, default=0.99)
     parser.add_argument("--reward_clipping", default="abs_one",
