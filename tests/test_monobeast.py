@@ -196,6 +196,36 @@ def test_transformer_solves_memory_env(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("sp_strategy", ["ring", "ulysses"])
+def test_sequence_parallel_solves_memory_env(tmp_path, sp_strategy):
+    """Memory under sequence-parallel attention on a 4-way `seq` mesh:
+    the learner shards the 19-step unroll over time, so cue-to-query
+    attention routinely crosses shard boundaries — through the ppermute
+    ring, or through ulysses' head-sharding all-to-alls — a LEARNING
+    proof for the sequence-parallel path, beyond its existing
+    gradient-parity pins. Pilot: 1.0 by <48k steps for both."""
+    flags = monobeast.make_parser().parse_args([
+        "--env", "Memory",
+        "--model", "transformer",
+        "--sequence_parallel", "4",
+        "--sp_strategy", sp_strategy,
+        "--num_actors", "16",
+        "--batch_size", "16",
+        "--unroll_length", "19",  # T+1 = 20 divisible by the seq axis
+        "--total_steps", "60000",
+        "--serial_envs",
+        "--learning_rate", "5e-4",
+        "--entropy_cost", "0.02",
+        "--env_seed", "1",
+        "--savedir", str(tmp_path),
+        "--xpid", f"mem-sp-{sp_strategy}",
+        "--checkpoint_interval_s", "100000",
+    ])
+    stats = monobeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.6
+
+
+@pytest.mark.slow
 def test_entropy_anneal_cracks_long_corridor(tmp_path):
     """--entropy_cost_final turns the L41 Memory corridor from
     unsolvable (0/6 constant-entropy configs, lstm_learning.md §4b)
