@@ -193,6 +193,74 @@ def test_poly_transformer_solves_memory_env(tmp_path):
     assert stats.get("mean_episode_return", -1.0) > 0.6
 
 
+@pytest.mark.slow
+def test_server_supervisor_restarts_dead_server(tmp_path):
+    """Chaos: SIGKILL one env server mid-train. The supervisor must
+    respawn it on the same address, the affected actors must bridge the
+    gap through their reconnect budget, and training must reach
+    total_steps. The reference's env driver only LOGS a death — a dead
+    gRPC server takes its slot down for good."""
+    import multiprocessing as mp
+    import threading
+    import time as time_lib
+
+    flags = make_flags(
+        tmp_path, xpid="supervised", env="Mock", model="mlp",
+        num_servers="2", num_actors="4", batch_size="4",
+        unroll_length="10", total_steps="40000",
+        max_actor_reconnects="10",
+    )
+    before = {p.pid for p in mp.active_children()}
+    killed = {}
+    train_done = threading.Event()
+
+    def killer():
+        deadline = time_lib.monotonic() + 30
+        while time_lib.monotonic() < deadline and not killed:
+            victims = [
+                p for p in mp.active_children() if p.pid not in before
+            ]
+            if victims:
+                time_lib.sleep(3)  # let training get underway first
+                if train_done.is_set():
+                    return  # too late — a no-op kill must not count
+                victim = victims[0]
+                victim.kill()
+                killed["pid"] = victim.pid
+                # Direct evidence of supervision: a NEW child pid
+                # (neither pre-existing nor the victim) must appear
+                # while training continues — this is the respawn, and
+                # observing it here removes the end-of-run race where a
+                # kill lands correctly but train finishes before the
+                # supervisor's next poll.
+                respawn_deadline = time_lib.monotonic() + 30
+                while time_lib.monotonic() < respawn_deadline:
+                    fresh = [
+                        p for p in mp.active_children()
+                        if p.pid not in before and p.pid != victim.pid
+                        and p.is_alive()
+                    ]
+                    if len(fresh) >= flags.num_servers:
+                        killed["respawned"] = True
+                        return
+                    time_lib.sleep(0.2)
+                return
+            time_lib.sleep(0.2)
+
+    t = threading.Thread(target=killer)
+    t.start()
+    stats = polybeast.train(flags)
+    train_done.set()
+    t.join()
+    assert killed, (
+        "killer never landed mid-train (train finished first or no "
+        "server appeared); raise total_steps if machines got faster"
+    )
+    assert killed.get("respawned"), "no respawned server observed"
+    assert stats["step"] >= 40000
+    assert stats.get("server_restarts", 0) >= 1
+
+
 def test_failed_validation_reaps_servers(tmp_path):
     """A post-spawn failure (here: a flag-validation raise) must reap
     the just-spawned env-server group — terminate-without-join used to

@@ -108,6 +108,10 @@ def make_parser():
                         help="Serve environments with the C++ EnvServer "
                              "(GIL-free socket I/O; combined-launcher "
                              "mode only).")
+    parser.add_argument("--max_server_restarts", type=int, default=10,
+                        help="Supervision budget for spawned env servers "
+                             "(see polybeast_env --max_server_restarts); "
+                             "0 disables restarts.")
     parser.add_argument("--sequence_parallel", type=int, default=0,
                         help="Shard the transformer's unroll (time) axis "
                              "over N devices (ring attention over a `seq` "
@@ -178,10 +182,19 @@ def make_parser():
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
-    parser.add_argument("--max_actor_reconnects", type=int, default=0,
+    parser.add_argument("--max_actor_reconnects", type=int, default=None,
                         help="Elastic actors: reconnect up to N times per "
-                             "actor on env-server transport failure "
-                             "(0 = fail fast like the reference).")
+                             "actor on env-server transport failure; the "
+                             "budget refills after a full recovered "
+                             "unroll. Default: 3 when this launcher "
+                             "supervises its own servers (a respawned "
+                             "server is useless if its actors already "
+                             "failed fast), 0 — fail fast, like the "
+                             "reference — with --no_start_servers, where "
+                             "nobody restarts a dead external server and "
+                             "reconnect attempts would only delay the "
+                             "error. App-level env errors are never "
+                             "absorbed either way.")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600)
     # Loss / optimizer (same knobs as monobeast).
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
@@ -203,17 +216,10 @@ def make_parser():
 
 
 def _reap_servers(procs):
-    """Terminate, join (bounded), then kill a spawned env-server group.
-    Terminate-without-join strands spawn-context children when SIGTERM
-    lands mid-bootstrap (observed: orphaned `spawn_main` processes after
-    validation-failure runs) and leaves zombies otherwise."""
-    for p in procs:
-        p.terminate()
-    for p in procs:
-        p.join(timeout=5)
-        if p.is_alive():
-            p.kill()
-            p.join(timeout=5)
+    """One reap implementation for every caller: polybeast_env owns it
+    (the standalone CLI needs it too, without importing this module's
+    jax surface)."""
+    polybeast_env.reap_group(procs)
 
 
 def train(flags):
@@ -287,6 +293,7 @@ def train(flags):
     # spawn-context children after validation-failure tests. Even a
     # KeyboardInterrupt during the settle sleep reaps them.
     server_procs = []
+    server_supervisor = None
     try:
         if flags.start_servers:
             env_seed = getattr(flags, "env_seed", None)
@@ -295,9 +302,14 @@ def train(flags):
                 # can derive (i*1000 + stream): hosts share --env_seed
                 # but never a stream.
                 env_seed += proc_id * flags.num_servers * 1000
-            server_procs = polybeast_env.start_servers(
-                flags, pipes_basename=pipes_basename, env_seed=env_seed
+            server_supervisor = polybeast_env.ServerSupervisor(
+                flags, pipes_basename=pipes_basename, env_seed=env_seed,
+                max_restarts=getattr(flags, "max_server_restarts", 10),
             )
+            # Live list: the supervisor replaces members in place, so
+            # the reap paths below always terminate the CURRENT group.
+            server_procs = server_supervisor.processes
+            server_supervisor.start_watch()
             time.sleep(0.5)
         elif getattr(flags, "env_seed", None) is not None:
             log.warning(
@@ -646,6 +658,18 @@ def train(flags):
             for i in range(flags.num_inference_threads)
         ]
 
+        max_reconnects = flags.max_actor_reconnects
+        if max_reconnects is None:
+            # Supervision-aware default: reconnects only help when
+            # someone restarts the dead server. With external servers
+            # (--no_start_servers) a reconnect would retry against a
+            # dead address for the full connect deadline — fail fast
+            # instead, like the reference.
+            supervised = (
+                flags.start_servers
+                and getattr(flags, "max_server_restarts", 10) > 0
+            )
+            max_reconnects = 3 if supervised else 0
         pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
         actors = pool_cls(
             unroll_length=flags.unroll_length,
@@ -653,7 +677,7 @@ def train(flags):
             inference_batcher=inference_batcher,
             env_server_addresses=addresses,
             initial_agent_state=model.initial_state(1),
-            max_reconnects=flags.max_actor_reconnects,
+            max_reconnects=max_reconnects,
         )
         actor_thread = threading.Thread(
             target=actors.run, daemon=True, name="actorpool"
@@ -773,6 +797,8 @@ def train(flags):
             target=learner_loop, daemon=True, name="learner"
         )
     except BaseException:
+        if server_supervisor is not None:
+            server_supervisor.stop()  # before terminate: no resurrect-mid-reap
         _reap_servers(server_procs)
         raise
     # From the first thread start onward, the main try/finally below owns
@@ -871,9 +897,15 @@ def train(flags):
                     stats=state["stats"],
                 )
         plogger.close(successful=successful)
+        if server_supervisor is not None:
+            server_supervisor.stop()  # before terminate: no resurrect-mid-reap
         _reap_servers(server_procs)
     log.info("Learning finished after %d steps.", state["step"])
-    return state["stats"]
+    stats = dict(state["stats"])
+    stats["server_restarts"] = (
+        server_supervisor.restarts if server_supervisor is not None else 0
+    )
+    return stats
 
 
 def _probe_env_via_server(flags, address, timeout_s: float = 60.0):

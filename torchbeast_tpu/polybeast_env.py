@@ -11,6 +11,7 @@ import functools
 import itertools
 import logging
 import multiprocessing as mp
+import threading
 import time
 
 logging.basicConfig(
@@ -37,6 +38,12 @@ def make_parser():
                              "+ stream index: every env instance draws a "
                              "distinct deterministic stream. Default: OS "
                              "entropy per env.")
+    parser.add_argument("--max_server_restarts", type=int, default=10,
+                        help="Supervision budget: dead env servers are "
+                             "respawned on their address up to this many "
+                             "times per group (actors bridge the gap "
+                             "with their reconnect budget). 0 disables "
+                             "restarts.")
     parser.add_argument("--native_server", action="store_true",
                         help="Serve with the C++ EnvServer (_tbt_core): "
                              "socket I/O and wire codec run GIL-free, the "
@@ -100,41 +107,154 @@ def _serve(env_name: str, address: str, native: bool = False,
     EnvServer(env_init, address).run()
 
 
-def start_servers(flags, ctx_name: str = "spawn", pipes_basename=None,
-                  env_seed=None):
-    basename = pipes_basename or flags.pipes_basename
-    native = getattr(flags, "native_server", False)
-    if env_seed is None:
-        env_seed = getattr(flags, "env_seed", None)
-    ctx = mp.get_context(ctx_name)
-    processes = []
-    for i in range(flags.num_servers):
-        address = server_address(basename, i)
-        seed_base = None if env_seed is None else env_seed + i * 1000
-        p = ctx.Process(
-            target=_serve, args=(flags.env, address, native, seed_base),
+def reap_group(procs):
+    """Terminate, join (bounded), then kill a spawned env-server group.
+    Terminate-without-join strands spawn-context children when SIGTERM
+    lands mid-bootstrap (observed: orphaned `spawn_main` processes after
+    validation-failure runs) and leaves zombies otherwise."""
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=5)
+
+
+class ServerSupervisor:
+    """Owns an env-server process group and restarts members that die.
+
+    The actor side has elastic reconnects (ActorPool's max_reconnects
+    budget, runtime/actor_pool.py); this is the missing other half —
+    someone to bring a dead server BACK. A member is respawned on its
+    original address with its original seed base, so in-flight actors
+    resume through their reconnect budget instead of exhausting it
+    against a dead socket. `max_restarts` (per group, cumulative) caps
+    crash-looping a deterministically broken env. The reference has no
+    supervision at all: its env driver only LOGS a death
+    (/root/reference/torchbeast/polybeast_env.py:61-75 serve loop; the
+    gRPC server dying takes the slot down for good).
+    """
+
+    def __init__(self, flags, ctx_name: str = "spawn",
+                 pipes_basename=None, env_seed=None, max_restarts=10,
+                 poll_interval_s=1.0):
+        self._env_name = flags.env
+        self._native = getattr(flags, "native_server", False)
+        self._basename = pipes_basename or flags.pipes_basename
+        if env_seed is None:
+            env_seed = getattr(flags, "env_seed", None)
+        self._env_seed = env_seed
+        self._ctx = mp.get_context(ctx_name)
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self._poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread = None
+        self._budget_logged = set()  # indices already error-logged
+        # The group list is MUTATED IN PLACE on restart so callers that
+        # captured it (the driver's reap paths) always see the current
+        # members.
+        self.processes = []
+        try:
+            for i in range(flags.num_servers):
+                self.processes.append(self._spawn(i))
+        except BaseException:
+            # A partial group must not outlive a failed construction —
+            # the caller never gets a handle to reap.
+            reap_group(self.processes)
+            raise
+        log.info("Starting %d supervised env servers on %s",
+                 len(self.processes), self._basename)
+
+    def _spawn(self, i):
+        address = server_address(self._basename, i)
+        seed_base = (
+            None if self._env_seed is None else self._env_seed + i * 1000
+        )
+        p = self._ctx.Process(
+            target=_serve,
+            args=(self._env_name, address, self._native, seed_base),
             daemon=True,
         )
         p.start()
-        processes.append(p)
-    log.info("Starting %d env servers on %s", len(processes),
-             flags.pipes_basename)
-    return processes
+        return p
+
+    def start_watch(self):
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name="server-supervisor"
+        )
+        self._thread.start()
+
+    def _watch(self):
+        while not self._stop.wait(self._poll_interval_s):
+            for i, p in enumerate(self.processes):
+                if p.is_alive() or self._stop.is_set():
+                    continue
+                if self.restarts >= self.max_restarts:
+                    if i not in self._budget_logged:
+                        log.error(
+                            "Env server %d died (exit %s) and the "
+                            "restart budget (%d) is exhausted; leaving "
+                            "this slot down.",
+                            i, p.exitcode, self.max_restarts,
+                        )
+                        self._budget_logged.add(i)
+                    continue
+                self.restarts += 1
+                log.warning(
+                    "Env server %d died (exit %s); restarting on its "
+                    "address (restart %d/%d).",
+                    i, p.exitcode, self.restarts, self.max_restarts,
+                )
+                try:
+                    replacement = self._spawn(i)
+                except Exception:
+                    # Spawn failure (fd/pid pressure is exactly when
+                    # servers die) must not kill the watcher thread —
+                    # that would END supervision silently. Refund the
+                    # attempt and retry next poll.
+                    self.restarts -= 1
+                    log.exception(
+                        "Respawn of env server %d failed; retrying on "
+                        "the next poll.", i,
+                    )
+                    continue
+                if self._stop.is_set():
+                    # stop() landed while we were spawning: the reap may
+                    # already have iterated the group, so this member
+                    # must die here, not serve forever unreaped.
+                    reap_group([replacement])
+                    return
+                self.processes[i] = replacement
+
+    def stop(self):
+        """Stop restarting. Call BEFORE terminating the group, or the
+        watcher resurrects members mid-reap."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                log.error(
+                    "server-supervisor watcher did not stop within 10s "
+                    "(a respawn may still be in flight); its in-flight "
+                    "member reaps itself on insert."
+                )
 
 
 def main(flags):
-    processes = start_servers(flags)
+    supervisor = ServerSupervisor(
+        flags, max_restarts=getattr(flags, "max_server_restarts", 10)
+    )
+    supervisor.start_watch()
     try:
         while True:
             time.sleep(10)
-            for i, p in enumerate(processes):
-                if not p.is_alive():
-                    log.error("Env server %d died (exit %s)", i, p.exitcode)
     except KeyboardInterrupt:
         pass
     finally:
-        for p in processes:
-            p.terminate()
+        supervisor.stop()
+        reap_group(supervisor.processes)
 
 
 def cli():
