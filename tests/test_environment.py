@@ -146,3 +146,105 @@ def test_create_env_seed_plumbing():
         return [env.reset().tobytes() for _ in range(8)]
 
     assert catch_frames(3) == catch_frames(3)
+
+
+class _CrashOnceEnv:
+    """Crashes the WORKER PROCESS (os._exit) on its 3rd step unless the
+    flag file exists — the revived instance finds the file (the crashing
+    instance creates it just before dying) and runs clean."""
+
+    def __init__(self, flag_path):
+        self._flag_path = flag_path
+        self._inner = CountingEnv(episode_length=4)
+        self.num_actions = self._inner.num_actions
+        self._steps = 0
+
+    def reset(self):
+        return self._inner.reset()
+
+    def step(self, action):
+        import os
+
+        self._steps += 1
+        if self._steps == 3 and not os.path.exists(self._flag_path):
+            open(self._flag_path, "w").close()
+            os._exit(1)  # simulate a segfault/OOM-kill of the worker
+        return self._inner.step(action)
+
+
+def test_process_pool_revives_crashed_worker(tmp_path):
+    """ProcessEnvPool supervision: a worker hard-crash mid-step must
+    respawn with a fresh env, with the crashed slot emitting the
+    episode-boundary output (done=True, reward 0) and every other slot
+    unaffected; subsequent steps run normally. Budget 0 = fail fast."""
+    import functools
+    import pytest
+
+    from torchbeast_tpu.envs.vec import ProcessEnvPool
+
+    flag = str(tmp_path / "crashed-once")
+    fns = [
+        functools.partial(_CrashOnceEnv, flag),
+        functools.partial(CountingEnv, episode_length=4),
+    ]
+    pool = ProcessEnvPool(fns)
+    try:
+        pool.initial()
+        pool.step([0, 0])
+        pool.step([0, 0])
+        out = pool.step([0, 0])  # slot 0's worker dies here
+        assert pool.restarts == 1
+        assert bool(out["done"][0]) is True  # boundary substitution
+        assert out["reward"][0] == 0.0
+        assert out["episode_step"][0] == 0
+        # Slot 1 was unaffected (its real step-3 output).
+        assert out["episode_step"][1] == 3
+        # The revived worker serves normally afterwards.
+        out = pool.step([0, 0])
+        assert out["episode_step"][0] == 1
+        assert out["episode_step"][1] == 4
+    finally:
+        pool.close()
+
+    # Exhausted budget fails loudly, chaining the transport error.
+    flag2 = str(tmp_path / "never-created-two")
+    pool = ProcessEnvPool(
+        [functools.partial(_CrashOnceEnv, flag2 + "x")],
+        max_restarts=0,
+    )
+    try:
+        pool.initial()
+        pool.step([0])
+        pool.step([0])
+        with pytest.raises(RuntimeError, match="restart budget"):
+            pool.step([0])
+    finally:
+        pool.close()
+
+
+class _AlwaysCrashEnv:
+    """Constructor kills the worker process outright — every revival
+    dies too (the deterministic-crash case)."""
+
+    num_actions = 2
+
+    def __init__(self):
+        import os
+
+        os._exit(1)
+
+
+def test_process_pool_revival_loop_respects_budget(tmp_path):
+    """A replacement that also dies must consume the budget and end in
+    the documented RuntimeError — not escape as a raw EOFError."""
+    import pytest
+
+    from torchbeast_tpu.envs.vec import ProcessEnvPool
+
+    pool = ProcessEnvPool([_AlwaysCrashEnv], max_restarts=3)
+    try:
+        with pytest.raises(RuntimeError, match="restart budget"):
+            pool.initial()
+        assert pool.restarts == 3  # all budget consumed by revivals
+    finally:
+        pool.close()

@@ -14,12 +14,15 @@ Two implementations:
   with the native runtime.
 """
 
+import logging
 import multiprocessing as mp
 from typing import Callable, Dict, List
 
 import numpy as np
 
 from torchbeast_tpu.envs.environment import Environment
+
+log = logging.getLogger(__name__)
 
 
 def _stack(outputs: List[Dict]) -> Dict[str, np.ndarray]:
@@ -67,32 +70,109 @@ def _env_worker(conn, env_fn):
 
 
 class ProcessEnvPool:
-    def __init__(self, env_fns: List[Callable], ctx: str = "spawn"):
-        mp_ctx = mp.get_context(ctx)
-        self._parents = []
-        self._procs = []
-        for fn in env_fns:
-            parent, child = mp_ctx.Pipe()
-            proc = mp_ctx.Process(
-                target=_env_worker, args=(child, fn), daemon=True
+    """One OS process per env, with worker SUPERVISION: a crashed
+    worker (env segfault, OOM-kill) is respawned with a fresh env and
+    its slot emits that env's `initial()` — which IS the boundary-step
+    convention (done=True, reward 0), so the learner sees a normal
+    episode boundary and resets the slot's agent state. `max_restarts`
+    (cumulative, 0 = fail fast) caps crash-looping; exhaustion raises
+    with the transport error chained. A revived seeded env restarts
+    its draw stream (crash recovery trades a replayed stream for the
+    run surviving)."""
+
+    def __init__(self, env_fns: List[Callable], ctx: str = "spawn",
+                 max_restarts: int = 10):
+        self._ctx = mp.get_context(ctx)
+        self._env_fns = list(env_fns)
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        n = len(self._env_fns)
+        self._parents = [None] * n
+        self._procs = [None] * n
+        for i in range(n):
+            self._spawn(i)
+
+    def _spawn(self, i: int) -> None:
+        parent, child = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=_env_worker, args=(child, self._env_fns[i]),
+            daemon=True,
+        )
+        proc.start()
+        child.close()
+        self._parents[i] = parent
+        self._procs[i] = proc
+
+    def _revive(self, i: int, cause: BaseException) -> Dict:
+        # The revival is supervised by the SAME budget: a replacement
+        # that dies before answering its first "initial" (deterministic
+        # constructor crash, immediate re-OOM) consumes another restart
+        # and retries, and exhaustion always raises the documented
+        # RuntimeError with the transport error chained.
+        while True:
+            if self.restarts >= self.max_restarts:
+                raise RuntimeError(
+                    f"env worker {i} died and the restart budget "
+                    f"({self.max_restarts}) is exhausted"
+                ) from cause
+            self.restarts += 1
+            log.warning(
+                "env worker %d died (%s); respawning with a fresh env "
+                "(restart %d/%d) — its slot emits an episode boundary.",
+                i, cause, self.restarts, self.max_restarts,
             )
-            proc.start()
-            child.close()
-            self._parents.append(parent)
-            self._procs.append(proc)
+            old = self._procs[i]
+            self._parents[i].close()
+            old.kill()
+            old.join(timeout=5)
+            self._spawn(i)
+            try:
+                self._parents[i].send(("initial", None))
+                return self._parents[i].recv()
+            except (BrokenPipeError, EOFError, OSError) as e:
+                cause = e
 
     def __len__(self):
         return len(self._procs)
 
     def initial(self) -> Dict[str, np.ndarray]:
-        for p in self._parents:
-            p.send(("initial", None))
-        return _stack([p.recv() for p in self._parents])
+        # Two-phase like step(): send to every live worker first so all
+        # B env resets run concurrently (a serialized send+recv loop
+        # would multiply reset latency by the pool size).
+        dead = {}
+        for i, p in enumerate(self._parents):
+            try:
+                p.send(("initial", None))
+            except (BrokenPipeError, OSError) as e:
+                dead[i] = e
+        outs = []
+        for i, p in enumerate(self._parents):
+            if i in dead:
+                outs.append(self._revive(i, dead[i]))
+                continue
+            try:
+                outs.append(p.recv())
+            except (EOFError, OSError) as e:
+                outs.append(self._revive(i, e))
+        return _stack(outs)
 
     def step(self, actions) -> Dict[str, np.ndarray]:
-        for p, a in zip(self._parents, actions):
-            p.send(("step", int(a)))
-        return _stack([p.recv() for p in self._parents])
+        dead = {}
+        for i, (p, a) in enumerate(zip(self._parents, actions)):
+            try:
+                p.send(("step", int(a)))
+            except (BrokenPipeError, OSError) as e:
+                dead[i] = e
+        outs = []
+        for i, p in enumerate(self._parents):
+            if i in dead:
+                outs.append(self._revive(i, dead[i]))
+                continue
+            try:
+                outs.append(p.recv())
+            except (EOFError, OSError) as e:
+                outs.append(self._revive(i, e))
+        return _stack(outs)
 
     def close(self):
         for p in self._parents:

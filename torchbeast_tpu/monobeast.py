@@ -176,6 +176,12 @@ def make_parser():
                              "but the run reproduces (with --serial_envs "
                              "and a fixed --seed, end-to-end). Default: OS "
                              "entropy per env.")
+    parser.add_argument("--max_env_restarts", type=int, default=10,
+                        help="Supervision budget for process-pool env "
+                             "workers: a crashed worker respawns with a "
+                             "fresh env, its slot emitting an episode "
+                             "boundary. 0 = fail fast. (--serial_envs "
+                             "has no workers to supervise.)")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600,
                         help="Seconds between checkpoints (reference: 10min).")
     # Loss settings.
@@ -238,7 +244,7 @@ def _make_pool(flags, num_envs):
     ]
     if flags.serial_envs:
         return SerialEnvPool(env_fns)
-    return ProcessEnvPool(env_fns)
+    return ProcessEnvPool(env_fns, max_restarts=flags.max_env_restarts)
 
 
 def dummy_env_outputs(t, batch_size, frame_shape, frame_dtype):
