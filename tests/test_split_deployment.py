@@ -52,7 +52,9 @@ def _free_port_base(n: int) -> int:
     raise RuntimeError("could not find a free port range")
 
 
-def _wait_listening(ports, timeout_s=60.0):
+def _wait_ports(ports, want_open, timeout_s=60.0):
+    """Until every port matches `want_open` (True = accepting, False =
+    closed), or timeout. Returns True on success."""
     deadline = time.monotonic() + timeout_s
     remaining = set(ports)
     while remaining and time.monotonic() < deadline:
@@ -61,12 +63,51 @@ def _wait_listening(ports, timeout_s=60.0):
                 s.settimeout(0.5)
                 try:
                     s.connect(("127.0.0.1", p))
+                    is_open = True
                 except OSError:
-                    continue
+                    is_open = False
+            if is_open == want_open:
                 remaining.discard(p)
         if remaining:
             time.sleep(0.3)
     return not remaining
+
+
+def _wait_listening(ports, timeout_s=60.0):
+    return _wait_ports(ports, want_open=True, timeout_s=timeout_s)
+
+
+def _launch_group(base_port):
+    """The env group through its REAL CLI, as a separate process tree.
+    stdout goes to DEVNULL: nothing reads the pipe, and a filled pipe
+    would block the launcher's logging during teardown."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",  # the env CLI must never touch the tunnel
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "torchbeast_tpu.polybeast_env",
+            "--env", "Mock",
+            "--num_servers", str(NUM_SERVERS),
+            "--pipes_basename", f"127.0.0.1:{base_port}",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _stop_group(group):
+    """terminate -> bounded wait -> kill escalation (the launcher's own
+    SIGTERM reap joins its children for up to ~20 s worst-case)."""
+    group.terminate()
+    try:
+        group.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        group.kill()
+        group.wait(timeout=10)
 
 
 def _learner_flags(tmp_path, base_port, total_steps):
@@ -87,27 +128,35 @@ def _learner_flags(tmp_path, base_port, total_steps):
     ])
 
 
+def test_env_group_cli_sigterm_reaps_its_servers():
+    """Killing the group launcher must take its server children with it.
+    SIGTERM used to bypass the CLI's finally (Python's default handler
+    skips finally/atexit), orphaning daemonic servers that kept their
+    ports open forever — every run of the split test leaked a pair.
+    The CLI now converts SIGTERM to SystemExit so its reap runs; the
+    observable contract is that the ports STOP accepting."""
+    base_port = _free_port_base(NUM_SERVERS)
+    group = _launch_group(base_port)
+    ports = [base_port + i for i in range(NUM_SERVERS)]
+    try:
+        assert _wait_listening(ports), "group never came up"
+        _stop_group(group)
+        # Orphaned servers would keep accepting; reaped ones close.
+        assert _wait_ports(ports, want_open=False, timeout_s=30), (
+            "ports still accepting after SIGTERM — the group leaked "
+            "orphaned server children"
+        )
+    finally:
+        if group.poll() is None:
+            group.kill()
+            group.wait(timeout=10)
+
+
 def test_split_deployment_external_tcp_servers_train_and_resume(
     tmp_path, caplog
 ):
     base_port = _free_port_base(NUM_SERVERS)
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",  # the env CLI must never touch the tunnel
-        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
-    )
-    group = subprocess.Popen(
-        [
-            sys.executable, "-m", "torchbeast_tpu.polybeast_env",
-            "--env", "Mock",
-            "--num_servers", str(NUM_SERVERS),
-            "--pipes_basename", f"127.0.0.1:{base_port}",
-        ],
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-    )
+    group = _launch_group(base_port)
     try:
         assert _wait_listening(
             [base_port + i for i in range(NUM_SERVERS)]
@@ -139,9 +188,4 @@ def test_split_deployment_external_tcp_servers_train_and_resume(
         assert stats["step"] >= 120
         assert np.isfinite(stats["total_loss"])
     finally:
-        group.terminate()
-        try:
-            group.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            group.kill()
-            group.wait(timeout=10)
+        _stop_group(group)

@@ -243,6 +243,18 @@ class ServerSupervisor:
 
 
 def main(flags):
+    # SIGTERM must run the finally below: Python's default handler kills
+    # the process without atexit/finally, orphaning the daemonic server
+    # children (ppid 1, still serving their ports) — exactly what
+    # `kill <group-launcher>` or a supervisor teardown sends. Observed:
+    # every split-deployment test run leaked its server pair this way.
+    import signal
+
+    def _graceful_term(signum, frame):
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _graceful_term)
+
     supervisor = ServerSupervisor(
         flags, max_restarts=getattr(flags, "max_server_restarts", 10)
     )
