@@ -75,16 +75,23 @@ def _reference(q, k_all, v_all, seg, cache_valid, no_done, rel_bias,
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
 
 
-def _kernel(q_ref, k_ref, v_ref, seg_ref, valid_ref, nodone_ref, bias_ref,
-            out_ref, *, memory_len):
+def _kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, validk_ref,
+            nodone_ref, bias_ref, out_ref, *, memory_len):
     M = memory_len
-    q = q_ref[0, :, 0, :].astype(jnp.float32)        # [T, D]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)        # [K, D] (K = M+T)
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
-    seg = seg_ref[0, :]                              # [T] int32
-    valid = valid_ref[0, :]                          # [M] f32 (0/1)
-    nodone = nodone_ref[0, :]                        # [T] bool
-    bias = bias_ref[0, :, :]                         # [T, K] f32 (per-head
+    # Mosaic block rule: the last two dims of every block must be
+    # divisible by (8, 128) or equal the full array dims. All inputs are
+    # therefore laid out with the grid axes (b, h) LEADING and the full
+    # (T/K, D) extents trailing, and the per-key metadata is padded to
+    # length K outside the kernel so the body is pure 2-D tile algebra
+    # (no 1-D pads/concats, which Mosaic may not lower).
+    q = q_ref[0, 0].astype(jnp.float32)              # [T, D]
+    k = k_ref[0, 0].astype(jnp.float32)              # [K, D] (K = M+T)
+    v = v_ref[0, 0].astype(jnp.float32)
+    seg_q = segq_ref[0]                              # [T, 1] int32
+    seg_k = segk_ref[0]                              # [1, K] int32
+    valid_k = validk_ref[0]                          # [1, K] f32 (0/1)
+    nodone = nodone_ref[0]                           # [T, 1] f32 (0/1)
+    bias = bias_ref[0]                               # [T, K] f32 (per-head
     # rel-bias table expanded OUTSIDE the kernel: it is batch-independent,
     # so the HBM cost is [H, T, K] once, not per (b, h) cell)
     T, D = q.shape
@@ -103,14 +110,10 @@ def _kernel(q_ref, k_ref, v_ref, seg_ref, valid_ref, nodone_ref, bias_ref,
     offsets = t_idx - (k_idx - M)
     band = (offsets >= 0) & (offsets <= M)
 
-    # Per-key metadata rows, padded to length K so plain broadcasting
-    # replaces gathers.
-    seg_k = jnp.pad(seg, (M, 0))[None, :]            # [1, K]
-    valid_k = jnp.pad(valid, (0, T), constant_values=1.0)[None, :] > 0.5
-    same = seg[:, None] == jnp.broadcast_to(seg_k, (T, K))
+    same = seg_q == seg_k                            # [T,1]==[1,K] → [T,K]
     mask = jnp.where(
         is_cache,
-        band & valid_k & nodone[:, None],
+        band & (valid_k > 0.5) & (nodone > 0.5),
         band & same,
     )
 
@@ -120,7 +123,7 @@ def _kernel(q_ref, k_ref, v_ref, seg_ref, valid_ref, nodone_ref, bias_ref,
         weights, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    out_ref[0, :, 0, :] = out.astype(out_ref.dtype)
+    out_ref[0, 0] = out.astype(out_ref.dtype)
 
 
 def _pallas_forward(q, k_all, v_all, seg, cache_valid, no_done, rel_bias,
@@ -144,23 +147,39 @@ def _pallas_forward(q, k_all, v_all, seg, cache_valid, no_done, rel_bias,
     offsets = jnp.clip(t_idx - (k_idx - M), 0, M)
     bias_full = rel_bias[:, offsets]                  # [H, T, K]
 
+    # Mosaic layout prep (cheap XLA transposes/pads of small tensors):
+    # grid axes lead, full extents trail, per-key metadata pre-padded to
+    # K, bool→f32 — see the block rule note in _kernel.
+    q_bh = jnp.transpose(q, (0, 2, 1, 3))             # [B, H, T, D]
+    k_bh = jnp.transpose(k_all, (0, 2, 1, 3))         # [B, H, K, D]
+    v_bh = jnp.transpose(v_all, (0, 2, 1, 3))
+    seg_q = seg[:, :, None]                           # [B, T, 1] i32
+    seg_k = jnp.pad(seg, ((0, 0), (M, 0)))[:, None, :]  # [B, 1, K] i32
+    valid_k = jnp.pad(
+        cache_valid.astype(jnp.float32), ((0, 0), (0, T)),
+        constant_values=1.0,
+    )[:, None, :]                                     # [B, 1, K] f32
+    nodone = no_done.astype(jnp.float32)[:, :, None]  # [B, T, 1] f32
+
     grid = (B, H)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(_kernel, memory_len=memory_len),
-        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, T, 1, D), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, K, 1, D), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, K, 1, D), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, T), lambda b, h: (b, 0)),
-            pl.BlockSpec((1, memory_len), lambda b, h: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, h: (b, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, K, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, K, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, h: (b, 0, 0)),
+            pl.BlockSpec((1, 1, K), lambda b, h: (b, 0, 0)),
+            pl.BlockSpec((1, 1, K), lambda b, h: (b, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, h: (b, 0, 0)),
             pl.BlockSpec((1, T, K), lambda b, h: (h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, T, 1, D), lambda b, h: (b, 0, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, T, D), lambda b, h: (b, h, 0, 0)),
         interpret=interpret,
-    )(q, k_all, v_all, seg, cache_valid, no_done, bias_full)
+    )(q_bh, k_bh, v_bh, seg_q, seg_k, valid_k, nodone, bias_full)
+    return jnp.transpose(out, (0, 2, 1, 3))           # [B, T, H, D]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))

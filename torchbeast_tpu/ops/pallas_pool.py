@@ -94,17 +94,40 @@ def _doubled_grid(a, H_pad_value):
     return up.reshape(N, 2 * Ho + 2, (2 * Wo + 2) * C)
 
 
+# Per-block VMEM budget for choosing block_n. Mosaic's scoped-vmem
+# limit is 16 MB and the pipeline double-buffers every block, so the
+# live footprint is ~2x the block buffers plus elementwise temporaries;
+# 5 MB of single-buffered block bytes keeps the trunk stage-1 shape
+# (found OOM at 50.7 MB scoped with block_n=8 on a v5e — see
+# benchmarks/artifacts/tpu_capture_raw/pallas_smoke pre-fix) inside it.
+_VMEM_BLOCK_BUDGET = 5 * 1024 * 1024
+
+
+def _auto_block_n(H, WC, Ho, WoC2):
+    """Largest batch rows per block whose buffers fit the VMEM budget.
+
+    Bytes per batch row: x + gx ([H, WC] f32 each) and the doubled
+    y + g grids ([2Ho+2, WoC2] f32 each).
+    """
+    per_n = 4 * (2 * H * WC + 2 * (2 * Ho + 2) * WoC2)
+    return max(1, _VMEM_BLOCK_BUDGET // per_n)
+
+
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
-def pool_bwd(x, y, g, block_n: int = 4, interpret: bool = False):
+def pool_bwd(x, y, g, block_n: int | None = None, interpret: bool = False):
     """Gradient of `reduce_window(max, 3x3, stride 2, pad 1)` wrt x.
 
     x: [N, H, W, C] pool input; y: pooled output; g: cotangent of y.
+    block_n: batch rows per grid cell; None picks the largest that fits
+    the scoped-VMEM budget (big trunk shapes tile down to 1).
     """
     from jax.experimental import pallas as pl
 
     N, H, W, C = x.shape
     _, Ho, Wo, _ = y.shape
     WC = W * C
+    if block_n is None:
+        block_n = min(N, _auto_block_n(H, WC, Ho, (2 * Wo + 2) * C))
 
     y_d = _doubled_grid(y, jnp.inf)
     g_d = _doubled_grid(g, 0)
