@@ -42,6 +42,19 @@ from torchbeast_tpu.ops import vtrace  # noqa: E402
 
 
 def time_impl(impl: str, t: int, b: int, steps: int) -> float:
+    """ms per V-trace solve, measured as ONE device dispatch that chains
+    `steps` solves with a data dependence (each iteration's vs feeds the
+    next solve's values).
+
+    Why not a host loop of identical calls: the axon remote backend
+    serves repeat dispatches of the same (executable, args) from a
+    result cache, so 30 identical calls measured 1 execution + 29 hits —
+    the round-5 chip capture recorded sequential T=4000 at 0.024 ms/step
+    (a 4000-iteration serial scan in 24 us is physically impossible) and
+    sequential times DECREASING with T. The fori_loop chain is immune to
+    both that cache and the tunnel RTT, and is what a chained learner
+    step sees anyway.
+    """
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 5)
     log_rhos = jax.random.normal(ks[0], (t, b)) * 0.1
@@ -50,16 +63,46 @@ def time_impl(impl: str, t: int, b: int, steps: int) -> float:
     values = jax.random.normal(ks[2], (t, b))
     bootstrap = jax.random.normal(ks[3], (b,))
 
-    fn = jax.jit(
-        lambda *a: vtrace.from_importance_weights(*a, scan_impl=impl)
-    )
-    out = fn(log_rhos, discounts, rewards, values, bootstrap)
+    @jax.jit
+    def chained(values):
+        def body(_, vals):
+            out = vtrace.from_importance_weights(
+                log_rhos, discounts, rewards, vals, bootstrap,
+                scan_impl=impl,
+            )
+            return out.vs
+        return jax.lax.fori_loop(0, steps, body, values)
+
+    out = chained(values)  # compile + warm
     jax.block_until_ready(out)
+    # Perturb the timed call's input so it can never be an identical
+    # (executable, args) repeat of the warm-up — which the result cache
+    # would serve without executing.
+    values2 = values + 1.0
+    jax.block_until_ready(values2)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(log_rhos, discounts, rewards, values, bootstrap)
-    jax.block_until_ready(out)
+    jax.block_until_ready(chained(values2))
     return (time.perf_counter() - t0) / steps * 1e3
+
+
+def marginal_ms(impl: str, t: int, b: int, steps: int) -> float:
+    """Per-solve ms with the fixed per-dispatch floor eliminated.
+
+    Even the chained instrument carries a constant per-call cost (RTT +
+    program launch — ~65 ms on the round-5 tunnel, swamping a T=80
+    solve). Two-point elimination: total(3s) - total(s) contains no
+    fixed cost, so dividing by 2s gives the marginal device time per
+    solve — the number a learner step actually pays when the solve sits
+    inside a bigger jitted program.
+    """
+    lo = time_impl(impl, t, b, steps) * steps
+    hi = time_impl(impl, t, b, 3 * steps) * 3 * steps
+    if hi > lo:
+        return (hi - lo) / (2 * steps)
+    # Timing noise can put total(3s) under total(s) on fast hosts with
+    # tiny T; fall back to the amortized per-solve time (an upper bound
+    # on the marginal cost, and always positive — the bench contract).
+    return hi / (3 * steps)
 
 
 def main() -> None:
@@ -75,13 +118,13 @@ def main() -> None:
     platform = jax.devices()[0].platform
     rows = []
     for t in (80, 1000, 4000):
-        seq = time_impl("sequential", t, args.batch, args.steps)
-        aso = time_impl("associative", t, args.batch, args.steps)
+        seq = marginal_ms("sequential", t, args.batch, args.steps)
+        aso = marginal_ms("associative", t, args.batch, args.steps)
         rows.append({
             "T": t,
             "sequential_ms": round(seq, 3),
             "associative_ms": round(aso, 3),
-            "assoc_speedup": round(seq / aso, 2),
+            "assoc_speedup": round(seq / aso, 2) if aso > 0 else None,
         })
     result = {
         "bench": "vtrace_scan",

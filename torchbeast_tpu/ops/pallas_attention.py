@@ -111,10 +111,12 @@ def _kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, validk_ref,
     band = (offsets >= 0) & (offsets <= M)
 
     same = seg_q == seg_k                            # [T,1]==[1,K] → [T,K]
-    mask = jnp.where(
-        is_cache,
-        band & (valid_k > 0.5) & (nodone > 0.5),
-        band & same,
+    # Pure i1 algebra, not jnp.where(bool, bool, bool): a boolean select
+    # lowers to an i8→i1 vector trunci that Mosaic rejects ("Unsupported
+    # target bitwidth for truncation" — hit on the first live chip run).
+    cache_ok = (valid_k > 0.5) & (nodone > 0.5)
+    mask = band & (
+        (is_cache & cache_ok) | (jnp.logical_not(is_cache) & same)
     )
 
     scores = jnp.where(mask, scores + bias, BIG_NEG)
