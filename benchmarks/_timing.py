@@ -1,0 +1,34 @@
+"""Shared marginal-device-time estimation for chip benchmarks.
+
+The axon tunnel adds a large fixed cost to every dispatch (~65 ms
+observed round 5: RTT + program launch) and serves REPEAT dispatches of
+an identical (executable, args) pair from a result cache. Benchmarks
+that need true per-op device time therefore (a) chain `steps`
+iterations inside ONE jitted dispatch with a data dependence, (b)
+perturb the timed call's input vs the warm-up call's, and (c) run at
+`steps` and `3*steps` and difference the totals so the fixed floor
+cancels. This module owns step (c); the chaining closures stay in each
+bench (their data-feedback shapes differ).
+
+Used by benchmarks/vtrace_bench.py and benchmarks/pallas_attn_bench.py;
+the failure modes this design answers are documented in
+benchmarks/artifacts/vtrace_scan_bench.md (instrument notes).
+"""
+
+from __future__ import annotations
+
+
+def marginal_from_totals(
+    lo_total_ms: float, hi_total_ms: float, steps: int
+) -> tuple[float, bool]:
+    """Per-iteration ms from totals at `steps` and `3*steps` chains.
+
+    Returns (ms, floor_contaminated): the two-point marginal when the
+    totals are ordered sanely, else the amortized hi total — a positive
+    UPPER BOUND that still contains the per-dispatch floor, flagged so
+    callers can mark the row instead of publishing it as a clean
+    marginal.
+    """
+    if hi_total_ms > lo_total_ms:
+        return (hi_total_ms - lo_total_ms) / (2 * steps), False
+    return hi_total_ms / (3 * steps), True

@@ -95,14 +95,14 @@ def marginal_ms(impl: str, t: int, b: int, steps: int) -> float:
     solve — the number a learner step actually pays when the solve sits
     inside a bigger jitted program.
     """
+    from benchmarks._timing import marginal_from_totals
+
     lo = time_impl(impl, t, b, steps) * steps
     hi = time_impl(impl, t, b, 3 * steps) * 3 * steps
-    if hi > lo:
-        return (hi - lo) / (2 * steps)
-    # Timing noise can put total(3s) under total(s) on fast hosts with
-    # tiny T; fall back to the amortized per-solve time (an upper bound
-    # on the marginal cost, and always positive — the bench contract).
-    return hi / (3 * steps)
+    # On noisy hosts with tiny T the fallback (floor-contaminated
+    # amortized upper bound) keeps the bench contract (positive rows).
+    ms, _contaminated = marginal_from_totals(lo, hi, steps)
+    return ms
 
 
 def main() -> None:

@@ -19,6 +19,13 @@ guard rejects shapes whose score tile would not fit. The backward pass
 recomputes through the reference jnp implementation (flash-style
 tiled backward is not needed at these T).
 
+Measured on a v5e (benchmarks/artifacts/pallas_attn_chip.md): forward
+PARITY with the dense XLA path at T=128/256 shapes and slightly slower
+at the tiny RL-unroll shape — XLA already tiles these sizes well, so
+the kernel earns its keep as validated fusion headroom near the VMEM
+guard, not as a demonstrated speedup; `--attention_impl` defaults to
+`dense` accordingly.
+
 On CPU/interpret (tests, no-TPU dev) the kernel runs under the Pallas
 interpreter; on a real TPU it compiles with Mosaic.
 """
