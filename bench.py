@@ -584,6 +584,24 @@ def run_bench(child_deadline: float):
         ),
         "anakin_sps": round(anakin_sps, 1) if anakin_sps else None,
     })
+    # Acting-path regression visibility: the delta vs the last committed
+    # chip artifact's inference number (the metric the device-resident
+    # state table / lag-1 dispatch work moves). Cross-platform deltas
+    # are meaningless, so a CPU run reports the previous chip number
+    # with delta null.
+    last = _load_last_tpu()
+    prev_result = last.get("result") if last else None
+    prev_inference = (
+        prev_result.get("inference_steps_per_sec")
+        if isinstance(prev_result, dict)
+        else None
+    )
+    result["inference_steps_per_sec_prev"] = prev_inference
+    result["inference_steps_per_sec_delta_pct"] = (
+        round(100.0 * (inference_sps - prev_inference) / prev_inference, 1)
+        if inference_sps and prev_inference and on_accel
+        else None
+    )
     if not on_accel:
         # A CPU fallback is close to worthless as a TPU benchmark — say
         # so, and point at the last recorded real-TPU measurement so the

@@ -30,6 +30,12 @@ LOG_RE = re.compile(
     r"Learner queue size: (\d+)\."
 )
 
+# polybeast logs its acting-path wire accounting once at startup:
+# "Acting path: agent_state=device_table per-step bytes up=N down=M"
+ACTING_RE = re.compile(
+    r"Acting path: agent_state=(\w+) per-step bytes up=(\d+) down=(\d+)"
+)
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -42,6 +48,10 @@ def main():
     ap.add_argument("--env", default="Mock")
     ap.add_argument("--native", action="store_true",
                     help="C++ queues/pool + C++ env server")
+    ap.add_argument("--no_device_agent_state", action="store_true",
+                    help="Legacy acting path (agent state rides every "
+                         "inference request/reply) — for before/after "
+                         "comparison against the device-resident table.")
     ap.add_argument("--out", default="/tmp/tbt_e2e.log")
     ap.add_argument("--timeout_s", type=int, default=1500)
     args = ap.parse_args()
@@ -62,6 +72,8 @@ def main():
     ]
     if args.native:
         cmd += ["--native_runtime", "--native_server"]
+    if args.no_device_agent_state:
+        cmd += ["--no_device_agent_state"]
 
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
@@ -82,11 +94,20 @@ def main():
     wall = time.time() - t0
 
     rows = []
+    acting = None
     with open(args.out) as f:
         for line in f:
             m = LOG_RE.search(line)
             if m:
                 rows.append(tuple(float(x) for x in m.groups()))
+                continue
+            m = ACTING_RE.search(line)
+            if m:
+                acting = {
+                    "agent_state": m.group(1),
+                    "bytes_per_step_up": int(m.group(2)),
+                    "bytes_per_step_down": int(m.group(3)),
+                }
     if not rows:
         print(json.dumps({
             "error": f"no telemetry rows parsed (rc={rc}, "
@@ -103,7 +124,7 @@ def main():
             k: getattr(args, k)
             for k in ("env", "model", "num_servers", "num_actors",
                       "batch_size", "unroll_length", "total_steps",
-                      "native")
+                      "native", "no_device_agent_state")
         },
         "rc": rc,
         "timed_out": timed_out,
@@ -112,6 +133,9 @@ def main():
         "steady_sps_max": round(max(sps), 1),
         "inference_q_mean": round(sum(inf_q) / len(inf_q), 2),
         "learner_q_mean": round(sum(lrn_q) / len(lrn_q), 2),
+        # Acting-path wire accounting parsed from polybeast's startup
+        # line: which side holds agent state and what crosses per step.
+        "acting_path": acting,
         "n_telemetry_rows": len(rows),
         "log": args.out,
     }))

@@ -158,16 +158,44 @@ class CountingPolicyServer:
         return outputs, state
 
 
-def run_pool(server_address, num_rollouts=6):
+def make_counting_state_table(num_slots=1):
+    """DeviceStateTable running the counting policy ON DEVICE (the CPU
+    backend stands in for the chip): same spec model as
+    CountingPolicyServer, but state lives in the table and requests carry
+    slot ids — the device-resident acting path end to end."""
+    import jax.numpy as jnp
+
+    from torchbeast_tpu.runtime.state_table import DeviceStateTable
+
+    def act_fn(ctx, env_outputs, agent_state):
+        done = env_outputs["done"]  # [1, B]
+        state = jnp.where(done, 0, agent_state) + 1  # [1, B]
+        outputs = {
+            "action": jnp.zeros_like(done, dtype=jnp.int32),
+            "policy_logits": state[..., None].astype(jnp.float32),
+            "baseline": state.astype(jnp.float32),
+        }
+        return outputs, state
+
+    return DeviceStateTable(
+        np.zeros((1, 1), np.int64),
+        num_slots=num_slots,
+        act_fn=act_fn,
+        batch_dim=1,
+    )
+
+
+def run_pool(server_address, num_rollouts=6, state_table=False):
     learner_queue = BatchingQueue(
         batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
     )
     batcher = DynamicBatcher(batch_dim=1, timeout_ms=20)
-    policy = CountingPolicyServer()
+    table = make_counting_state_table() if state_table else None
 
     inf_thread = threading.Thread(
         target=inference_loop,
-        args=(batcher, policy, 8),
+        args=(batcher, None if state_table else CountingPolicyServer(), 8),
+        kwargs={"state_table": table},
         daemon=True,
     )
     inf_thread.start()
@@ -178,6 +206,7 @@ def run_pool(server_address, num_rollouts=6):
         inference_batcher=batcher,
         env_server_addresses=[server_address],
         initial_agent_state=np.zeros((1, 1), np.int64),
+        state_table=table,
     )
     pool_thread = threading.Thread(target=pool.run, daemon=True)
     pool_thread.start()
@@ -193,8 +222,9 @@ def run_pool(server_address, num_rollouts=6):
     return items
 
 
-def test_actor_pool_invariants(server_address):
-    items = run_pool(server_address)
+@pytest.mark.parametrize("state_table", [False, True])
+def test_actor_pool_invariants(server_address, state_table):
+    items = run_pool(server_address, state_table=state_table)
     prev = None
     for item in items:
         batch = item["batch"]

@@ -248,3 +248,63 @@ def test_process_pool_revival_loop_respects_budget(tmp_path):
         assert pool.restarts == 3  # all budget consumed by revivals
     finally:
         pool.close()
+
+
+def test_process_pool_split_step_matches_step():
+    """step_async/step_wait (the lag-1 collector's overlap window) must
+    equal the fused step(), phase order enforced."""
+    import functools
+    import pytest
+
+    from torchbeast_tpu.envs.vec import ProcessEnvPool
+
+    fns = [functools.partial(CountingEnv, episode_length=4)] * 2
+    fused, split = ProcessEnvPool(fns), ProcessEnvPool(fns)
+    try:
+        fused.initial(), split.initial()
+        with pytest.raises(RuntimeError, match="without step_async"):
+            split.step_wait()
+        for _ in range(5):
+            out_fused = fused.step([0, 0])
+            split.step_async([0, 0])
+            with pytest.raises(RuntimeError, match="in flight"):
+                split.step_async([0, 0])
+            out_split = split.step_wait()
+            for key in out_fused:
+                np.testing.assert_array_equal(
+                    out_fused[key], out_split[key]
+                )
+    finally:
+        fused.close()
+        split.close()
+
+
+def test_process_pool_split_step_revives_crashed_worker(tmp_path):
+    """A worker dying inside the async window still gets the boundary
+    substitution + revival in step_wait — supervision is phase-split
+    like the step itself."""
+    import functools
+
+    from torchbeast_tpu.envs.vec import ProcessEnvPool
+
+    flag = str(tmp_path / "crashed-async")
+    fns = [
+        functools.partial(_CrashOnceEnv, flag),
+        functools.partial(CountingEnv, episode_length=4),
+    ]
+    pool = ProcessEnvPool(fns)
+    try:
+        pool.initial()
+        for _ in range(2):
+            pool.step_async([0, 0])
+            pool.step_wait()
+        pool.step_async([0, 0])  # slot 0's worker dies in this step
+        out = pool.step_wait()
+        assert pool.restarts == 1
+        assert bool(out["done"][0]) is True
+        assert out["episode_step"][1] == 3
+        pool.step_async([0, 0])
+        out = pool.step_wait()
+        assert out["episode_step"][0] == 1
+    finally:
+        pool.close()

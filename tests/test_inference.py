@@ -9,7 +9,15 @@ import threading
 import numpy as np
 import pytest
 
-from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.inference import (
+    bucket_size,
+    default_buckets,
+    inference_loop,
+    pad_advance,
+    pad_slots,
+    pad_to,
+    slice_to,
+)
 from torchbeast_tpu.runtime.queues import DynamicBatcher
 
 
@@ -109,3 +117,95 @@ def test_sparse_single_request_not_held(sparse_timeout_s=10):
     )
     batcher.close()
     server.join(timeout=10)
+
+
+class TestBuckets:
+    """Edge cases for the power-of-two bucket schedule."""
+
+    def test_default_buckets_exact_power_of_two(self):
+        assert default_buckets(8) == [1, 2, 4, 8]
+        assert default_buckets(1) == [1]
+
+    def test_default_buckets_non_power_of_two_max(self):
+        # The true max batch size caps the schedule even off-power-of-two
+        # (a 48-actor run must not pad every full batch up to 64).
+        assert default_buckets(48) == [1, 2, 4, 8, 16, 32, 48]
+
+    def test_bucket_size_rounds_up_within_schedule(self):
+        buckets = default_buckets(8)
+        assert bucket_size(1, buckets) == 1
+        assert bucket_size(3, buckets) == 4
+        assert bucket_size(8, buckets) == 8
+
+    def test_bucket_size_overflow_raises(self):
+        with pytest.raises(ValueError, match="exceeds largest bucket"):
+            bucket_size(9, default_buckets(8))
+
+
+class TestPadSlice:
+    """pad_to repeats the LAST row (np.pad mode="edge") — pinned here so
+    the module docstring and the code can't drift apart again — and
+    slice_to inverts it exactly."""
+
+    def _tree(self, n):
+        return {
+            "frame": np.arange(n, dtype=np.float32).reshape(1, n, 1) + 1,
+            "nested": {"r": np.arange(n, dtype=np.float32)[None] * 10},
+        }
+
+    def test_pad_repeats_last_row_not_row_zero(self):
+        padded = pad_to(self._tree(3), 8, batch_dim=1)
+        assert padded["frame"].shape == (1, 8, 1)
+        # Rows 3..7 repeat row 2 (value 3.0) — NOT row 0 (value 1.0).
+        np.testing.assert_array_equal(
+            padded["frame"][0, :, 0],
+            np.asarray([1, 2, 3, 3, 3, 3, 3, 3], np.float32),
+        )
+        np.testing.assert_array_equal(
+            padded["nested"]["r"][0],
+            np.asarray([0, 10, 20, 20, 20, 20, 20, 20], np.float32),
+        )
+
+    @pytest.mark.parametrize("n,bucket", [(1, 1), (3, 4), (4, 4), (1, 8)])
+    def test_pad_slice_round_trip(self, n, bucket):
+        """slice_to(pad_to(x)) == x, including the n == bucket identity
+        and the n == 1 single-row edge."""
+        tree = self._tree(n)
+        padded = pad_to(tree, bucket, batch_dim=1)
+        for leaf in (padded["frame"], padded["nested"]["r"]):
+            assert leaf.shape[1] == bucket
+        back = slice_to(padded, n, batch_dim=1)
+        np.testing.assert_array_equal(back["frame"], tree["frame"])
+        np.testing.assert_array_equal(
+            back["nested"]["r"], tree["nested"]["r"]
+        )
+
+    def test_pad_to_exact_size_is_identity_object(self):
+        tree = self._tree(4)
+        padded = pad_to(tree, 4, batch_dim=1)
+        # No copy when nothing pads: the hot path hands the same arrays on.
+        assert padded["frame"] is tree["frame"]
+
+
+class TestSlotPadding:
+    """State-table framing helpers: padding must target the trash slot
+    with advance=False — an edge-repeated real id would make the padded
+    row's scatter race the real row's (last-writer-wins)."""
+
+    def test_pad_slots_uses_trash_not_edge(self):
+        padded = pad_slots(np.asarray([3, 5], np.int32), 4, trash_slot=7)
+        np.testing.assert_array_equal(
+            padded, np.asarray([3, 5, 7, 7], np.int32)
+        )
+
+    def test_pad_slots_exact_size_identity(self):
+        slots = np.asarray([1, 2], np.int32)
+        np.testing.assert_array_equal(
+            pad_slots(slots, 2, trash_slot=9), slots
+        )
+
+    def test_pad_advance_pads_false(self):
+        padded = pad_advance(np.asarray([True, True]), 5)
+        np.testing.assert_array_equal(
+            padded, np.asarray([True, True, False, False, False])
+        )

@@ -115,24 +115,32 @@ def test_lr_schedule_decays_to_zero():
     assert schedule(20) == 0.0  # stays at zero past the horizon
 
 
-def test_rmsprop_matches_torch_semantics():
-    """One optax rmsprop step vs torch.optim.RMSprop on the same tensors."""
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_rmsprop_matches_torch_semantics(momentum):
+    """Multi-step _rmsprop_torch (the learner's version-portable
+    torch-RMSprop: upstream eps_in_sqrt=False where available, composed
+    primitives on optax 0.2.3) vs torch.optim.RMSprop on the same
+    tensors, with and without momentum."""
     torch = pytest.importorskip("torch")
     rng = np.random.default_rng(0)
     w = rng.standard_normal(5).astype(np.float32)
-    g = rng.standard_normal(5).astype(np.float32)
     lr, alpha, eps = 0.01, 0.99, 0.01
 
     tw = torch.nn.Parameter(torch.tensor(w))
-    opt = torch.optim.RMSprop([tw], lr=lr, alpha=alpha, eps=eps)
-    tw.grad = torch.tensor(g)
-    opt.step()
-
+    opt = torch.optim.RMSprop(
+        [tw], lr=lr, alpha=alpha, eps=eps, momentum=momentum
+    )
     ow = jnp.asarray(w)
-    optax_opt = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=False)
+    optax_opt = learner_lib._rmsprop_torch(
+        lr, decay=alpha, eps=eps, momentum=momentum
+    )
     state = optax_opt.init(ow)
-    updates, _ = optax_opt.update(jnp.asarray(g), state, ow)
-    ow = optax.apply_updates(ow, updates)
+    for step in range(3):  # multi-step: exercises nu/momentum carry
+        g = rng.standard_normal(5).astype(np.float32)
+        tw.grad = torch.tensor(g)
+        opt.step()
+        updates, state = optax_opt.update(jnp.asarray(g), state, ow)
+        ow = optax.apply_updates(ow, updates)
 
     np.testing.assert_allclose(ow, tw.detach().numpy(), rtol=1e-5, atol=1e-6)
 
@@ -166,3 +174,19 @@ def test_entropy_schedule_anneal_and_constant():
         hp._replace(entropy_cost_final=None)
     )
     assert constant(state) is None
+
+
+def test_donate_argnums_policy_table():
+    """Donation policy -> argnums for the (params, opt_state, batch,
+    state) signature, incl. the donate_batch extension and the typo'd-
+    policy guard (falling through to params donation would be unsafe
+    for async drivers whose inference threads hold params refs)."""
+    f = learner_lib.donate_argnums_for
+    assert f(True) == (0, 1)
+    assert f(False) == ()
+    assert f("opt_only") == (1,)
+    assert f(True, donate_batch=True) == (0, 1, 2, 3)
+    assert f("opt_only", donate_batch=True) == (1, 2, 3)
+    assert f(False, donate_batch=True) == (2, 3)
+    with pytest.raises(ValueError, match="donation policy"):
+        f("opt-only")

@@ -283,3 +283,83 @@ def _swallow(fn, *args):
         fn(*args)
     except Exception:
         pass
+
+
+class TestDevicePrefetcher:
+    """Double-buffered host->device staging (runtime/queues.py). The
+    place_fn is injected, so these tests run device-free; the polybeast
+    integration places with jax.device_put."""
+
+    def _make(self, items, place_fn=None, depth=2):
+        from torchbeast_tpu.runtime import DevicePrefetcher
+
+        return DevicePrefetcher(
+            iter(items), place_fn or (lambda x: x), depth=depth
+        )
+
+    def test_depth_validated(self):
+        with pytest.raises(ValueError, match="depth"):
+            self._make([], depth=0)
+
+    def test_items_staged_in_order_through_place_fn(self):
+        placed = []
+
+        def place(x):
+            placed.append(x)
+            return ("staged", x)
+
+        pf = self._make([1, 2, 3], place_fn=place).start()
+        got = [pf.get(timeout=5) for _ in range(3)]
+        assert got == [("staged", 1), ("staged", 2), ("staged", 3)]
+        assert placed == [1, 2, 3]
+
+    def test_end_of_stream_contract(self):
+        """No end sentinel: exhaustion = get() raising Empty while
+        is_alive() is False, with every live item still delivered."""
+        import queue as stdlib_queue
+
+        pf = self._make([1, 2]).start()
+        out = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                out.append(pf.get(timeout=0.1))
+            except stdlib_queue.Empty:
+                if not pf.is_alive():
+                    break
+        assert out == [1, 2]
+        pf.join(timeout=5)
+
+    def test_iterator_protocol(self):
+        pf = self._make(["a", "b", "c"]).start()
+        assert list(pf) == ["a", "b", "c"]
+
+    def test_place_fn_error_recorded_and_stream_ends(self):
+        def bad_place(x):
+            raise RuntimeError("device full")
+
+        pf = self._make([1], place_fn=bad_place).start()
+        assert list(pf) == []  # stream ends cleanly, no raise to consumer
+        pf.join(timeout=5)
+        assert isinstance(pf.error, RuntimeError)
+
+    def test_backpressure_bounded_by_depth(self):
+        """The staging thread never runs ahead of depth + 1 items (depth
+        queued + one in hand) — the double-buffer property that bounds
+        device memory held by staged batches."""
+        placed = []
+        pf = self._make(
+            list(range(10)),
+            place_fn=lambda x: placed.append(x) or x,
+            depth=2,
+        ).start()
+        time.sleep(0.5)  # let it run ahead as far as it can
+        assert len(placed) <= 3
+        assert list(pf) == list(range(10))
+
+    def test_close_unblocks_staging_thread(self):
+        pf = self._make(list(range(10)), depth=1).start()
+        assert pf.get(timeout=5) == 0
+        pf.close()
+        pf.join(timeout=5)
+        assert not pf.is_alive()

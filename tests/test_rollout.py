@@ -3,18 +3,29 @@
 test (tests/core_agent_state_test.py): a deterministic counting env + a
 'model' that increments its state every forward and resets it where done,
 asserting (a) rollout overlap-by-one, (b) initial_agent_state equals the
-state entering each rollout, (c) boundary steps carry reset frames."""
+state entering each rollout, (c) boundary steps carry reset frames.
+
+Every invariant runs against BOTH schedules: the synchronous
+RolloutCollector and the lag-1 PipelinedRolloutCollector (which must
+produce bit-identical batches — the lag is in when the host retrieves
+results, never in what the policy saw)."""
 
 import numpy as np
+import pytest
 
 from torchbeast_tpu.envs import CountingEnv
 from torchbeast_tpu.envs.vec import SerialEnvPool
-from torchbeast_tpu.rollout import RolloutCollector
+from torchbeast_tpu.rollout import (
+    PipelinedRolloutCollector,
+    RolloutCollector,
+)
 from torchbeast_tpu.types import AgentOutput
 
 B = 2
 EPISODE_LEN = 5
 T = 3  # deliberately not a divisor of EPISODE_LEN: boundaries move around
+
+COLLECTORS = [RolloutCollector, PipelinedRolloutCollector]
 
 
 def counting_policy(env_output, agent_state):
@@ -30,17 +41,18 @@ def counting_policy(env_output, agent_state):
     return out, state
 
 
-def make_collector():
+def make_collector(collector_cls=RolloutCollector):
     pool = SerialEnvPool(
         [lambda: CountingEnv(episode_length=EPISODE_LEN) for _ in range(B)]
     )
-    return RolloutCollector(
+    return collector_cls(
         pool, counting_policy, np.zeros(B, np.int64), unroll_length=T
     )
 
 
-def test_overlap_by_one():
-    collector = make_collector()
+@pytest.mark.parametrize("collector_cls", COLLECTORS)
+def test_overlap_by_one(collector_cls):
+    collector = make_collector(collector_cls)
     prev, _ = collector.collect()
     for _ in range(4):
         batch, _ = collector.collect()
@@ -52,8 +64,9 @@ def test_overlap_by_one():
         prev = batch
 
 
-def test_initial_agent_state_is_rollout_entry_state():
-    collector = make_collector()
+@pytest.mark.parametrize("collector_cls", COLLECTORS)
+def test_initial_agent_state_is_rollout_entry_state(collector_cls):
+    collector = make_collector(collector_cls)
     for k in range(6):
         batch, initial_state = collector.collect()
         # The counting policy writes its post-increment state into
@@ -61,12 +74,13 @@ def test_initial_agent_state_is_rollout_entry_state():
         # consistent: first forward consumes slot 0's env output, so
         # baseline[1] == (0 if done[0] else initial_state) + 1.
         done0 = batch["done"][0]
-        expected_first = np.where(done0, 0, initial_state) + 1
+        expected_first = np.where(done0, 0, np.asarray(initial_state)) + 1
         np.testing.assert_array_equal(batch["baseline"][1], expected_first)
 
 
-def test_boundary_frames_are_reset_frames():
-    collector = make_collector()
+@pytest.mark.parametrize("collector_cls", COLLECTORS)
+def test_boundary_frames_are_reset_frames(collector_cls):
+    collector = make_collector(collector_cls)
     for _ in range(8):
         batch, _ = collector.collect()
         done = batch["done"]
@@ -76,8 +90,9 @@ def test_boundary_frames_are_reset_frames():
         assert (frames[done] == 0).all()
 
 
-def test_frames_count_within_episode():
-    collector = make_collector()
+@pytest.mark.parametrize("collector_cls", COLLECTORS)
+def test_frames_count_within_episode(collector_cls):
+    collector = make_collector(collector_cls)
     batch, _ = collector.collect()
     # CountingEnv frames equal episode_step (0 after reset).
     np.testing.assert_array_equal(
@@ -86,11 +101,99 @@ def test_frames_count_within_episode():
     )
 
 
-def test_action_pairing():
+@pytest.mark.parametrize("collector_cls", COLLECTORS)
+def test_action_pairing(collector_cls):
     """The action stored at slot i was computed from slot i-1's env output
     and equals slot i's last_action input."""
-    collector = make_collector()
+    collector = make_collector(collector_cls)
     batch, _ = collector.collect()
     np.testing.assert_array_equal(
         batch["action"][1:], batch["last_action"][1:]
     )
+
+
+def test_pipelined_batches_bit_identical_to_sync():
+    """Lag-1 is a retrieval schedule, not a data change: both collectors
+    over identical env/policy sequences emit identical batches and
+    initial states, rollout after rollout."""
+    sync = make_collector(RolloutCollector)
+    lag1 = make_collector(PipelinedRolloutCollector)
+    for _ in range(6):
+        b_sync, s_sync = sync.collect()
+        b_lag, s_lag = lag1.collect()
+        assert set(b_sync) == set(b_lag)
+        for key in b_sync:
+            np.testing.assert_array_equal(
+                b_sync[key], b_lag[key], err_msg=f"batch key {key}"
+            )
+        np.testing.assert_array_equal(
+            np.asarray(s_sync), np.asarray(s_lag)
+        )
+
+
+def test_pipelined_falls_back_without_split_step():
+    """A pool exposing only step() (no step_async/step_wait) degrades to
+    the synchronous phase order with the same results."""
+
+    class StepOnlyPool:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def initial(self):
+            return self._inner.initial()
+
+        def step(self, actions):
+            return self._inner.step(actions)
+
+    pool = SerialEnvPool(
+        [lambda: CountingEnv(episode_length=EPISODE_LEN) for _ in range(B)]
+    )
+    lag1 = PipelinedRolloutCollector(
+        StepOnlyPool(pool),
+        counting_policy,
+        np.zeros(B, np.int64),
+        unroll_length=T,
+    )
+    sync = make_collector(RolloutCollector)
+    for _ in range(3):
+        b_sync, _ = sync.collect()
+        b_lag, _ = lag1.collect()
+        for key in b_sync:
+            np.testing.assert_array_equal(b_sync[key], b_lag[key])
+
+
+class TestSplitStepContract:
+    """The step_async/step_wait split phase the lag-1 collector overlaps
+    against (envs/vec.py)."""
+
+    def make_pool(self):
+        return SerialEnvPool(
+            [lambda: CountingEnv(episode_length=EPISODE_LEN)
+             for _ in range(B)]
+        )
+
+    def test_async_then_wait_equals_step(self):
+        a = self.make_pool()
+        b = self.make_pool()
+        a.initial(), b.initial()
+        actions = np.zeros(B, np.int64)
+        for _ in range(4):
+            out_sync = a.step(actions)
+            b.step_async(actions)
+            out_split = b.step_wait()
+            for key in out_sync:
+                np.testing.assert_array_equal(out_sync[key], out_split[key])
+
+    def test_double_async_raises(self):
+        pool = self.make_pool()
+        pool.initial()
+        pool.step_async(np.zeros(B, np.int64))
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.step_async(np.zeros(B, np.int64))
+        pool.step_wait()
+
+    def test_wait_without_async_raises(self):
+        pool = self.make_pool()
+        pool.initial()
+        with pytest.raises(RuntimeError, match="without step_async"):
+            pool.step_wait()

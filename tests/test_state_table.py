@@ -1,0 +1,404 @@
+"""Device-resident agent-state table (runtime/state_table.py): the
+gather -> act -> merge-by-advance -> scatter step, slot isolation
+(including the trash slot bucket padding scatters to), reset/read_slot,
+the inference_loop integration (slot-framed requests, state-free
+replies), and the transfer-guard regression test pinning the tentpole
+property: agent state performs ZERO host round trips per env step.
+
+Everything here runs on the CPU backend (conftest forces
+JAX_PLATFORMS=cpu) — the CPU device is the "fake device" standing in for
+the chip, so tier-1 covers the whole device-resident path without TPU
+access.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchbeast_tpu.runtime.inference import (
+    inference_loop,
+    pad_advance,
+    pad_slots,
+    pad_to,
+)
+from torchbeast_tpu.runtime.queues import DynamicBatcher
+from torchbeast_tpu.runtime.state_table import DeviceStateTable
+
+H = 2  # state feature width
+
+
+def _act_fn(ctx, env_outputs, agent_state):
+    """outputs = frame + state (so outputs prove WHICH state each row
+    gathered), new_state = state + 1 (so persistence is observable)."""
+    frame = env_outputs["frame"]  # [1, B, H]
+    state = agent_state["h"]  # [1, B, H]
+    return {"out": frame + state}, {"h": state + 1}
+
+
+def make_table(num_slots=4, context_fn=None):
+    return DeviceStateTable(
+        {"h": np.zeros((1, 1, H), np.float32)},
+        num_slots=num_slots,
+        act_fn=_act_fn,
+        context_fn=context_fn,
+        batch_dim=1,
+    )
+
+
+def _env(values):
+    """env nest for len(values) rows, frame row i == values[i]."""
+    v = np.asarray(values, np.float32)
+    return {"frame": np.tile(v[None, :, None], (1, 1, H))}
+
+
+def _step_out(table, slots, advance, env):
+    out = table.step(
+        np.asarray(slots, np.int32), np.asarray(advance, bool), env
+    )
+    return np.asarray(jax.device_get(out["out"]))
+
+
+def slot_state(table, slot):
+    return np.asarray(table.read_slot(slot)["h"]).reshape(H)
+
+
+class TestDeviceStateTable:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="num_slots"):
+            make_table(num_slots=0)
+        with pytest.raises(ValueError, match="non-empty"):
+            DeviceStateTable(
+                {}, num_slots=2, act_fn=_act_fn, batch_dim=1
+            )
+        with pytest.raises(ValueError, match="size 1 along"):
+            DeviceStateTable(
+                {"h": np.zeros((1, 3, H), np.float32)},
+                num_slots=2,
+                act_fn=_act_fn,
+                batch_dim=1,
+            )
+
+    def test_step_advances_only_requested_slots(self):
+        table = make_table()
+        # Slots 0 and 2 step (advance), slots 1 and 3 untouched.
+        out = _step_out(table, [0, 2], [True, True], _env([10.0, 20.0]))
+        # All slots start at state 0: outputs == frames.
+        np.testing.assert_array_equal(out[0, 0], np.full(H, 10.0))
+        np.testing.assert_array_equal(out[0, 1], np.full(H, 20.0))
+        np.testing.assert_array_equal(slot_state(table, 0), np.full(H, 1.0))
+        np.testing.assert_array_equal(slot_state(table, 1), np.zeros(H))
+        np.testing.assert_array_equal(slot_state(table, 2), np.full(H, 1.0))
+        # Second step for slot 0 only: output reflects the advanced state.
+        out = _step_out(table, [0], [True], _env([5.0]))
+        np.testing.assert_array_equal(out[0, 0], np.full(H, 6.0))
+        np.testing.assert_array_equal(slot_state(table, 0), np.full(H, 2.0))
+
+    def test_advance_false_computes_without_persisting(self):
+        """The actor pool's priming call: outputs from the CURRENT state,
+        state NOT advanced (reference monobeast.py advance=False path)."""
+        table = make_table()
+        _step_out(table, [1], [True], _env([0.0]))  # slot 1 -> state 1
+        out = _step_out(table, [1], [False], _env([7.0]))
+        np.testing.assert_array_equal(out[0, 0], np.full(H, 8.0))  # 7 + 1
+        np.testing.assert_array_equal(slot_state(table, 1), np.full(H, 1.0))
+
+    def test_input_filter_drops_extra_leaves_without_recompile(self):
+        """polybeast's prewarm builds dummy envs from the 4-key model
+        schema while real actor traffic carries the full 6-key nest
+        (episode stats included). The host-side input_filter must make
+        both hit ONE compiled signature — and keep the ignored leaves
+        out of the dispatch entirely."""
+        traces = []
+
+        def counting_act(ctx, env_outputs, agent_state):
+            traces.append(sorted(env_outputs))
+            return _act_fn(ctx, env_outputs, agent_state)
+
+        table = DeviceStateTable(
+            {"h": np.zeros((1, 1, H), np.float32)},
+            num_slots=2,
+            act_fn=counting_act,
+            batch_dim=1,
+            input_filter=lambda env: {"frame": env["frame"]},
+        )
+        slots = np.asarray([0], np.int32)
+        advance = np.ones(1, bool)
+        # Prewarm-shaped (model schema only)...
+        out1 = table.step(slots, advance, _env([3.0]))
+        # ...then wire-shaped (extra leaves the model never reads).
+        wire_env = dict(
+            _env([4.0]), episode_step=np.zeros((1, 1), np.int32)
+        )
+        out2 = table.step(slots, advance, wire_env)
+        assert traces == [["frame"]]  # one trace; filtered nest only
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(out2["out"]))[0, 0],
+            np.full(H, 5.0),  # frame 4 + advanced state 1
+        )
+        del out1
+
+    def test_failed_step_poisons_table(self):
+        """The table buffer is donated into every step dispatch, so a
+        step that raises may have consumed it — the table must refuse
+        further use (use-after-free would serve garbage state) instead
+        of letting the serving loop retry per-batch."""
+
+        def bad_ctx():
+            raise RuntimeError("params fetch exploded")
+
+        table = make_table(context_fn=bad_ctx)
+        with pytest.raises(RuntimeError, match="params fetch exploded"):
+            table.step(
+                np.zeros(1, np.int32), np.ones(1, bool), _env([1.0])
+            )
+        # context_fn runs before the donating dispatch, so the table
+        # survives a context failure...
+        assert not table.poisoned
+        table._context_fn = None
+
+        real_jit = table._step_jit
+
+        def exploding_jit(*args, **kwargs):
+            raise RuntimeError("dispatch died")
+
+        table._step_jit = exploding_jit
+        with pytest.raises(RuntimeError, match="dispatch died"):
+            table.step(
+                np.zeros(1, np.int32), np.ones(1, bool), _env([1.0])
+            )
+        # ...but a failure of the dispatch itself poisons it for every
+        # entry point, with a diagnosable error.
+        assert table.poisoned
+        table._step_jit = real_jit
+        for call in (
+            lambda: table.step(
+                np.zeros(1, np.int32), np.ones(1, bool), _env([1.0])
+            ),
+            lambda: table.read_slot(0),
+            lambda: table.reset([0]),
+        ):
+            with pytest.raises(RuntimeError, match="poisoned"):
+                call()
+
+    def test_trash_slot_padding_never_disturbs_real_slots(self):
+        table = make_table(num_slots=2)
+        trash = table.trash_slot
+        assert trash == 2
+        # A padded batch: one real row + three trash rows, advance padded
+        # False — exactly what inference_loop builds for bucket padding.
+        slots = pad_slots(np.asarray([0]), 4, trash)
+        advance = pad_advance(np.asarray([True]), 4)
+        out = _step_out(table, slots, advance, _env([1.0, 9.0, 9.0, 9.0]))
+        np.testing.assert_array_equal(out[0, 0], np.full(H, 1.0))
+        np.testing.assert_array_equal(slot_state(table, 0), np.full(H, 1.0))
+        np.testing.assert_array_equal(slot_state(table, 1), np.zeros(H))
+        # Even an ADVANCING trash row (duplicate ids, last-writer-wins)
+        # only ever writes the trash slot.
+        _step_out(
+            table,
+            np.asarray([trash, trash], np.int32),
+            np.asarray([True, True]),
+            _env([3.0, 4.0]),
+        )
+        np.testing.assert_array_equal(slot_state(table, 0), np.full(H, 1.0))
+        np.testing.assert_array_equal(slot_state(table, 1), np.zeros(H))
+
+    def test_reset_restores_initial_state(self):
+        table = make_table()
+        for _ in range(3):
+            _step_out(table, [0, 1], [True, True], _env([0.0, 0.0]))
+        table.reset([0])
+        np.testing.assert_array_equal(slot_state(table, 0), np.zeros(H))
+        np.testing.assert_array_equal(slot_state(table, 1), np.full(H, 3.0))
+
+    def test_read_slot_shape_matches_initial_state(self):
+        table = make_table()
+        piece = table.read_slot(3)
+        assert np.shape(piece["h"]) == (1, 1, H)
+
+    def test_context_fn_threads_fresh_ctx_without_recompile(self):
+        calls = []
+
+        def context_fn():
+            calls.append(None)
+            return jnp.float32(len(calls))
+
+        def act_with_ctx(ctx, env_outputs, agent_state):
+            return (
+                {"out": env_outputs["frame"] + ctx},
+                {"h": agent_state["h"]},
+            )
+
+        table = DeviceStateTable(
+            {"h": np.zeros((1, 1, H), np.float32)},
+            num_slots=2,
+            act_fn=act_with_ctx,
+            context_fn=context_fn,
+            batch_dim=1,
+        )
+        out1 = np.asarray(
+            jax.device_get(
+                table.step(
+                    np.asarray([0], np.int32),
+                    np.asarray([True]),
+                    _env([0.0]),
+                )["out"]
+            )
+        )
+        out2 = np.asarray(
+            jax.device_get(
+                table.step(
+                    np.asarray([0], np.int32),
+                    np.asarray([True]),
+                    _env([0.0]),
+                )["out"]
+            )
+        )
+        # ctx is traced, not baked in: the second call sees ctx=2.
+        np.testing.assert_array_equal(out1[0, 0], np.full(H, 1.0))
+        np.testing.assert_array_equal(out2[0, 0], np.full(H, 2.0))
+
+
+class TestInferenceLoopIntegration:
+    def test_slot_framed_requests_route_and_replies_carry_no_state(self):
+        table = make_table(num_slots=8)
+        batcher = DynamicBatcher(
+            batch_dim=1, minimum_batch_size=1, maximum_batch_size=8,
+            timeout_ms=5,
+        )
+        server = threading.Thread(
+            target=inference_loop,
+            args=(batcher, None, 8),
+            kwargs={"state_table": table},
+            daemon=True,
+        )
+        server.start()
+
+        results, errors = {}, []
+
+        def producer(i):
+            try:
+                for _ in range(3):  # 3 advancing steps per slot
+                    out = batcher.compute(
+                        {
+                            "env": {
+                                "frame": np.full((1, 1, H), float(i),
+                                                 np.float32)
+                            },
+                            "slot": np.full((1, 1), i, np.int32),
+                            "advance": np.full((1, 1), True, bool),
+                        }
+                    )
+                results[i] = out
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=producer, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert len(results) == 8
+        for i, out in results.items():
+            # Reply framing: outputs only — no agent-state leaves.
+            assert set(out.keys()) == {"outputs"}
+            # Third step saw state 2: out = frame + 2.
+            np.testing.assert_array_equal(
+                np.asarray(out["outputs"]["out"]),
+                np.full((1, 1, H), float(i) + 2.0, np.float32),
+            )
+            np.testing.assert_array_equal(
+                slot_state(table, i), np.full(H, 3.0)
+            )
+        batcher.close()
+        server.join(timeout=10)
+        assert not server.is_alive()
+
+
+class TestTransferGuard:
+    def test_state_never_crosses_host_boundary_per_step(self):
+        """The tentpole regression test: a full padded unroll of table
+        steps under jax.transfer_guard("disallow") — only the EXPLICIT
+        device_put of observations/ids (inside DeviceStateTable.step) and
+        the EXPLICIT device_get of outputs (fetch) are allowed; any
+        agent-state leaf crossing the boundary would be an implicit
+        transfer and raise."""
+        table = make_table(num_slots=4)
+        # Warm the compile caches outside the guard (compilation itself
+        # may transfer constants; the guarded property is the per-step
+        # hot path, not the one-time compile).
+        slots = pad_slots(np.asarray([0, 1]), 4, table.trash_slot)
+        advance = pad_advance(np.asarray([True, True]), 4)
+        env = pad_to(_env([1.0, 2.0]), 4, batch_dim=1)
+        out = table.step(slots, advance, env)
+        table.fetch(out, 2)
+        table.read_slot(0)
+
+        with jax.transfer_guard("disallow"):
+            for t in range(5):  # one unroll's worth of acting steps
+                out = table.step(slots, advance, env)
+                fetched = table.fetch(out, 2)
+            # Rollout-boundary state read: one explicit fetch per unroll.
+            boundary = table.read_slot(0)
+        # Warmup advanced slot 0 once; guarded steps 1..5 saw states
+        # 1..5, so the last output is frame + 5 and the boundary state 6.
+        np.testing.assert_array_equal(
+            np.asarray(fetched["out"])[0, 0], np.full(H, 1.0 + 5.0)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(boundary["h"]).reshape(H), np.full(H, 6.0)
+        )
+
+    def test_pipelined_unroll_state_stays_on_device(self):
+        """Lag-1 collector variant of the guard test: a device-side
+        policy's recurrent state flows device -> device across a whole
+        collect() with implicit transfers disallowed; only the action
+        fetch and the end-of-unroll bulk fetch cross, explicitly."""
+        from torchbeast_tpu.envs import CountingEnv
+        from torchbeast_tpu.envs.vec import SerialEnvPool
+        from torchbeast_tpu.rollout import PipelinedRolloutCollector
+        from torchbeast_tpu.types import AgentOutput
+
+        B = 2
+
+        @jax.jit
+        def policy_step(done, state):
+            state = jnp.where(done, 0, state) + 1
+            out = AgentOutput(
+                action=jnp.zeros(done.shape, jnp.int32),
+                policy_logits=state.astype(jnp.float32)[..., None],
+                baseline=state.astype(jnp.float32),
+            )
+            return out, state
+
+        def policy(env_output, agent_state):
+            done = jax.device_put(np.asarray(env_output["done"]))
+            out, state = policy_step(done, agent_state)
+            assert isinstance(state, jax.Array)  # never left the device
+            return out, state
+
+        pool = SerialEnvPool(
+            [lambda: CountingEnv(episode_length=5) for _ in range(B)]
+        )
+        state0 = jax.device_put(np.zeros(B, np.int64))
+        # Warm the compile outside the guard.
+        policy_step(jnp.zeros(B, bool), state0)
+
+        collector = PipelinedRolloutCollector(
+            pool, policy, state0, unroll_length=3
+        )
+        with jax.transfer_guard("disallow"):
+            for _ in range(3):
+                batch, initial_state = collector.collect()
+        assert isinstance(initial_state, jax.Array)
+        # Invariants still hold under the guard (spot check: the policy
+        # writes its post-increment state into baseline).
+        done0 = batch["done"][0]
+        expected = np.where(done0, 0, np.asarray(initial_state)) + 1
+        np.testing.assert_array_equal(batch["baseline"][1], expected)

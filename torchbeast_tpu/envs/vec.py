@@ -34,6 +34,7 @@ def _stack(outputs: List[Dict]) -> Dict[str, np.ndarray]:
 class SerialEnvPool:
     def __init__(self, env_fns: List[Callable]):
         self._envs = [Environment(fn()) for fn in env_fns]
+        self._pending = None
 
     def __len__(self):
         return len(self._envs)
@@ -45,6 +46,21 @@ class SerialEnvPool:
         return _stack(
             [e.step(int(a)) for e, a in zip(self._envs, actions)]
         )
+
+    # step_async/step_wait: the split-phase contract the lag-1 pipelined
+    # collector overlaps against (rollout.py). Serially there is nothing
+    # to overlap — the step runs inside step_async — but the API holds,
+    # so collectors need no pool-type branches.
+    def step_async(self, actions) -> None:
+        if self._pending is not None:
+            raise RuntimeError("step_async called with a step in flight")
+        self._pending = self.step(actions)
+
+    def step_wait(self) -> Dict[str, np.ndarray]:
+        if self._pending is None:
+            raise RuntimeError("step_wait without step_async")
+        out, self._pending = self._pending, None
+        return out
 
     def close(self):
         for e in self._envs:
@@ -86,6 +102,7 @@ class ProcessEnvPool:
         self._env_fns = list(env_fns)
         self.max_restarts = max_restarts
         self.restarts = 0
+        self._inflight = None  # step_async's send-phase death record
         n = len(self._env_fns)
         self._parents = [None] * n
         self._procs = [None] * n
@@ -157,12 +174,30 @@ class ProcessEnvPool:
         return _stack(outs)
 
     def step(self, actions) -> Dict[str, np.ndarray]:
+        self.step_async(actions)
+        return self.step_wait()
+
+    def step_async(self, actions) -> None:
+        """Send phase only: every live worker starts stepping and the
+        caller gets control back while the envs run — the overlap window
+        the lag-1 pipelined collector uses to materialize the previous
+        tick's device results (rollout.py). Send-side deaths are
+        recorded and revived in step_wait."""
+        if self._inflight is not None:
+            raise RuntimeError("step_async called with a step in flight")
         dead = {}
         for i, (p, a) in enumerate(zip(self._parents, actions)):
             try:
                 p.send(("step", int(a)))
             except (BrokenPipeError, OSError) as e:
                 dead[i] = e
+        self._inflight = dead
+
+    def step_wait(self) -> Dict[str, np.ndarray]:
+        """Receive phase: blocks for every worker's step result."""
+        if self._inflight is None:
+            raise RuntimeError("step_wait without step_async")
+        dead, self._inflight = self._inflight, None
         outs = []
         for i, p in enumerate(self._parents):
             if i in dead:

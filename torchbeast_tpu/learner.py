@@ -63,13 +63,68 @@ def updates_horizon(hp: HParams) -> int:
     return max(1, hp.total_steps // (hp.unroll_length * hp.batch_size))
 
 
+def _scale_by_rms_torch(
+    decay: float, eps: float
+) -> optax.GradientTransformation:
+    """optax.scale_by_rms with TORCH denominator semantics:
+    g / (sqrt(v) + eps), not g / sqrt(v + eps). Used on optax < 0.2.4,
+    where rmsprop has no eps_in_sqrt knob (the two differ materially at
+    this model's eps=0.01; see google-deepmind/optax#532). Pinned
+    against torch.optim.RMSprop by test_rmsprop_matches_torch_semantics.
+    """
+
+    def init_fn(params):
+        return optax.ScaleByRmsState(
+            nu=jax.tree_util.tree_map(jnp.zeros_like, params)
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        nu = jax.tree_util.tree_map(
+            lambda g, n: decay * n + (1.0 - decay) * jnp.square(g),
+            updates,
+            state.nu,
+        )
+        updates = jax.tree_util.tree_map(
+            lambda g, n: g / (jnp.sqrt(n) + eps), updates, nu
+        )
+        return updates, optax.ScaleByRmsState(nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _rmsprop_torch(
+    learning_rate, decay: float, eps: float, momentum
+) -> optax.GradientTransformation:
+    """torch.optim.RMSprop as an optax chain. Prefers the upstream
+    rmsprop(eps_in_sqrt=False) (optax >= 0.2.4); otherwise composes the
+    identical transform from primitives that exist on 0.2.3: torch-
+    denominator RMS scaling, then momentum as a plain accumulator trace
+    (torch: buf = m*buf + update; param -= lr*buf), then LR."""
+    try:
+        return optax.rmsprop(
+            learning_rate=learning_rate,
+            decay=decay,
+            eps=eps,
+            eps_in_sqrt=False,
+            momentum=momentum or None,
+        )
+    except TypeError:
+        pass
+    parts = [_scale_by_rms_torch(decay, eps)]
+    if momentum:
+        parts.append(optax.trace(decay=momentum, nesterov=False))
+    parts.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*parts)
+
+
 def make_optimizer(hp: HParams) -> optax.GradientTransformation:
     """torch.optim.RMSprop semantics + grad clip + linear LR decay.
 
-    torch RMSProp divides by (sqrt(v) + eps) — optax expresses that with
-    eps_in_sqrt=False. The LR decays linearly to 0 over total_steps env
-    frames; each optimizer step consumes T*B frames (the reference's
-    LambdaLR closure, monobeast.py:395-398).
+    torch RMSProp divides by (sqrt(v) + eps) — _rmsprop_torch expresses
+    that on every installed optax. The LR decays linearly to 0 over
+    total_steps env frames; each optimizer step consumes T*B frames (the
+    reference's LambdaLR closure, monobeast.py:395-398).
     """
     schedule = optax.linear_schedule(
         init_value=hp.learning_rate,
@@ -78,12 +133,11 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
     )
     return optax.chain(
         optax.clip_by_global_norm(hp.grad_norm_clipping),
-        optax.rmsprop(
+        _rmsprop_torch(
             learning_rate=schedule,
             decay=hp.rmsprop_alpha,
             eps=hp.rmsprop_eps,
-            eps_in_sqrt=False,
-            momentum=hp.rmsprop_momentum or None,
+            momentum=hp.rmsprop_momentum,
         ),
     )
 
@@ -168,7 +222,7 @@ def compute_loss(
     return total_loss, stats
 
 
-def donate_argnums_for(donate) -> tuple:
+def donate_argnums_for(donate, donate_batch: bool = False) -> tuple:
     """Donation policy -> donate_argnums for the update step's
     (params, opt_state, batch, initial_agent_state) signature.
 
@@ -178,20 +232,32 @@ def donate_argnums_for(donate) -> tuple:
       inference threads hold live references to params (donating them
       would invalidate an in-flight act dispatch), but nothing else reads
       the optimizer state, so its buffers alias the new opt_state output
-      in place. (The batch/agent-state inputs have no matching output to
-      alias, so donating them would buy nothing — XLA donation is strictly
-      input-output buffer aliasing.) Callers must serialize update
-      dispatch with any host read of opt_state (checkpointing).
+      in place. Callers must serialize update dispatch with any host read
+      of opt_state (checkpointing).
     - False: donate nothing.
+
+    donate_batch additionally donates the batch + initial_agent_state
+    args (2, 3). XLA donation is STRICTLY input-output buffer aliasing:
+    this only pays off for a jitted computation that emits batch-shaped
+    outputs for those buffers to alias. The stock update_body does not
+    (its outputs are params/opt_state/stats), so the drivers leave this
+    False — enabling it there frees nothing and XLA warns "Some donated
+    buffers were not usable" on every update. The knob exists for
+    derived update steps that DO return batch-shaped values (e.g.
+    auxiliary reconstructions or per-step priorities); such callers must
+    also never re-read a consumed batch (true for the
+    runtime/queues.DevicePrefetcher staging contract).
     """
     if donate == "opt_only":
-        return (1,)
-    if not isinstance(donate, bool):
+        base = (1,)
+    elif not isinstance(donate, bool):
         # A typo'd policy string must not fall through to the params-
         # donating default — that is the one unsafe option for async
         # drivers whose inference threads hold live params references.
         raise ValueError(f"Unknown donation policy {donate!r}")
-    return (0, 1) if donate else ()
+    else:
+        base = (0, 1) if donate else ()
+    return base + ((2, 3) if donate_batch else ())
 
 
 def entropy_schedule(hp: HParams):
@@ -249,17 +315,19 @@ def update_body(model, optimizer: optax.GradientTransformation, hp: HParams):
 
 def make_update_step(
     model, optimizer: optax.GradientTransformation, hp: HParams,
-    donate=True,
+    donate=True, donate_batch: bool = False,
 ):
     """Build the jitted learner step (see update_body for the contract).
 
     `donate` is a policy understood by donate_argnums_for: True (donate
     params+opt, single-threaded drivers), "opt_only" (async drivers —
-    the shared params stay undonated), or False.
+    the shared params stay undonated), or False. `donate_batch` also
+    donates the staged batch/agent-state inputs (prefetched drivers
+    where nothing re-reads a consumed batch).
     """
     return jax.jit(
         update_body(model, optimizer, hp),
-        donate_argnums=donate_argnums_for(donate),
+        donate_argnums=donate_argnums_for(donate, donate_batch),
     )
 
 

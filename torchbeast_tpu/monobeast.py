@@ -28,7 +28,10 @@ from torchbeast_tpu import learner as learner_lib
 from torchbeast_tpu.envs import create_env
 from torchbeast_tpu.envs.vec import ProcessEnvPool, SerialEnvPool
 from torchbeast_tpu.models import create_model
-from torchbeast_tpu.rollout import RolloutCollector
+from torchbeast_tpu.rollout import (
+    PipelinedRolloutCollector,
+    RolloutCollector,
+)
 from torchbeast_tpu.utils import (
     FileWriter,
     Timings,
@@ -169,6 +172,20 @@ def make_parser():
                              "(the reference's actors lag by queue "
                              "depth, so either mode is stricter than "
                              "the reference).")
+    parser.add_argument("--pipelined_collect", dest="pipelined_collect",
+                        action="store_true", default=True,
+                        help="Lag-1 pipelined rollout collection "
+                             "(default): per env step only the action "
+                             "crosses device->host; logits/baseline "
+                             "materialize one tick behind (overlapped "
+                             "with env stepping) and agent state never "
+                             "leaves the device. Identical batches to "
+                             "the synchronous schedule.")
+    parser.add_argument("--no_pipelined_collect", dest="pipelined_collect",
+                        action="store_false",
+                        help="Synchronous collection: materialize every "
+                             "policy result on host before stepping "
+                             "envs (debugging / host-policy baselines).")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--env_seed", type=int, default=None,
                         help="Base seed for stochastic envs; env i draws "
@@ -682,10 +699,19 @@ def train(flags):
         place_sub = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
         log.info("Sync learner data-parallel over %d devices", n_dev)
     else:
+        # No donate_batch: update_body emits no batch-shaped outputs to
+        # alias, so donating the staged batch frees nothing (see
+        # learner.donate_argnums_for).
         update_step = learner_lib.make_update_step(
             model, optimizer, hp, donate=donate
         )
-        place_sub = lambda b, s: (b, s)  # noqa: E731
+        # Explicit (async) placement: donation needs committed device
+        # buffers — a host-numpy arg reaches the jit as an undonatable
+        # transfer (and a warning); device_put also starts the H2D copy
+        # before dispatch instead of inside it.
+        place_sub = lambda b, s: (  # noqa: E731
+            jax.device_put(b), jax.device_put(s)
+        )
     act_step = learner_lib.make_act_step(model)
 
     pool = _make_pool(flags, B)
@@ -698,6 +724,7 @@ def train(flags):
 
         # Mutable cell so the policy closure always samples with fresh rng.
         rng_cell = [rng]
+        pipelined = getattr(flags, "pipelined_collect", True)
 
         def policy(env_output, agent_state):
             rng_cell[0], key = jax.random.split(rng_cell[0])
@@ -706,10 +733,18 @@ def train(flags):
                 for k in ("frame", "reward", "done", "last_action")
             }
             out, new_state = act_step(params_cell[0], key, model_inputs, agent_state)
+            if pipelined:
+                # The lag-1 collector owns materialization: it fetches
+                # the action per step and everything else one tick
+                # behind; state stays on device end-to-end.
+                return out, new_state
             return jax.device_get(out), new_state
 
         params_cell = [params]
-        collector = RolloutCollector(
+        collector_cls = (
+            PipelinedRolloutCollector if pipelined else RolloutCollector
+        )
+        collector = collector_cls(
             pool, policy, model.initial_state(B), unroll_length=T
         )
 

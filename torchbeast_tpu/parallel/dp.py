@@ -60,6 +60,7 @@ def make_parallel_update_step(
     model, optimizer, hp: learner_lib.HParams, mesh, donate=True,
     param_shardings: Optional[Any] = None,
     opt_shardings: Optional[Any] = None,
+    donate_batch: bool = False,
 ):
     """Data/tensor-parallel version of learner.make_update_step.
 
@@ -68,7 +69,10 @@ def make_parallel_update_step(
     batch == the reference's single-learner loss over the full batch).
     `donate` is a policy understood by learner.donate_argnums_for: True
     (params+opt, single-threaded drivers), "opt_only" (async drivers —
-    the shared params stay undonated), or False.
+    the shared params stay undonated), or False. `donate_batch` donates
+    the staged batch/agent-state args too (prefetched drivers; the
+    staged shards must be placed with the SAME bsh/ssh shardings —
+    shard_batch does — since donation requires input placement to match).
 
     param_shardings (optional): a params-pytree of NamedShardings (see
     parallel/tp.py) to shard weights over the mesh's `model` axis;
@@ -95,11 +99,23 @@ def make_parallel_update_step(
         opt_sh = opt_shardings
     else:
         opt_sh = repl if param_shardings is None else None
+    donate_args = learner_lib.donate_argnums_for(donate, donate_batch)
+    if opt_sh is None and 1 in donate_args:
+        # Donation aliases the input buffer to the output, which requires
+        # input placement == output sharding. With opt placement left to
+        # the compiler, the output sharding it picks can disagree with
+        # wherever the caller staged opt_state (XLA then fails with an
+        # aliased-size mismatch at dispatch), so skip donating it.
+        log.warning(
+            "opt_state sharding left to the compiler with sharded params; "
+            "disabling opt_state donation (pass opt_shardings to donate)."
+        )
+        donate_args = tuple(a for a in donate_args if a != 1)
     return jax.jit(
         update_step,
         in_shardings=(psh, opt_sh, bsh, ssh),
         out_shardings=(psh, opt_sh, repl),
-        donate_argnums=learner_lib.donate_argnums_for(donate),
+        donate_argnums=donate_args,
     )
 
 

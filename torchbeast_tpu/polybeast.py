@@ -35,7 +35,11 @@ from torchbeast_tpu.monobeast import (
 )
 from torchbeast_tpu.runtime.actor_pool import ActorPool
 from torchbeast_tpu.runtime.inference import default_buckets, inference_loop
-from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
+from torchbeast_tpu.runtime.queues import (
+    BatchingQueue,
+    DevicePrefetcher,
+    DynamicBatcher,
+)
 from torchbeast_tpu.utils import (
     FileWriter,
     Timings,
@@ -172,6 +176,20 @@ def make_parser():
                              "(host:port); also reads "
                              "TORCHBEAST_COORDINATOR / _NUM_PROCESSES / "
                              "_PROCESS_ID env vars.")
+    parser.add_argument("--device_agent_state", dest="device_agent_state",
+                        action="store_true", default=True,
+                        help="Keep recurrent agent state in a device-"
+                             "resident slot table (default): requests "
+                             "carry slot ids, state gathers/advances/"
+                             "scatters inside the jitted acting step, "
+                             "and per-env-step host traffic shrinks to "
+                             "obs-down/action-up. Ignored for stateless "
+                             "models and with --native_runtime (the C++ "
+                             "pool speaks the legacy state framing).")
+    parser.add_argument("--no_device_agent_state",
+                        dest="device_agent_state", action="store_false",
+                        help="Legacy acting path: agent state rides "
+                             "every inference request/reply.")
     parser.add_argument("--prewarm_inference", action="store_true",
                         help="Compile every inference bucket (powers of "
                              "two up to max_inference_batch_size) before "
@@ -616,6 +634,86 @@ def train(flags):
             }
             return out, new_state
 
+        # Device-resident agent-state table (runtime/state_table.py):
+        # recurrent state lives in a [.., num_actors+1, ..] on-device
+        # pytree keyed by actor slot; the jitted acting step gathers,
+        # advances, and scatters it in ONE dispatch, so per-env-step
+        # host traffic shrinks to obs-down / action-up. Stateless
+        # models have nothing to keep resident, and the C++ pool
+        # speaks the legacy state framing — both fall back.
+        state_table = None
+        if (
+            getattr(flags, "device_agent_state", True)
+            and not flags.native_runtime
+            and jax.tree_util.tree_leaves(act_model.initial_state(1))
+        ):
+            from torchbeast_tpu.runtime.state_table import DeviceStateTable
+
+            def _table_ctx():
+                with state_lock:
+                    params_now = state["infer_params"]
+                    state["rng"], key = jax.random.split(state["rng"])
+                return params_now, key
+
+            _MODEL_KEYS = ("frame", "reward", "done", "last_action")
+
+            def _table_act(ctx, env_outputs, agent_state):
+                params_now, key = ctx
+                # act_body consumes [B, ...] (adds T=1 itself); batcher
+                # nests are [1, B, ...]; reply framing restores [1, B].
+                model_inputs = {
+                    k: env_outputs[k][0] for k in _MODEL_KEYS
+                }
+                out, new_state = learner_lib.act_body(
+                    act_model, params_now, key, model_inputs, agent_state
+                )
+                outputs = {
+                    "action": out.action[None],
+                    "policy_logits": out.policy_logits[None],
+                    "baseline": out.baseline[None],
+                }
+                return outputs, new_state
+
+            state_table = DeviceStateTable(
+                act_model.initial_state(1),
+                num_slots=num_actors,
+                act_fn=_table_act,
+                context_fn=_table_ctx,
+                batch_dim=1,
+                # Host-side subset to the model's inputs BEFORE
+                # device_put: actor traffic carries the full _ENV_KEYS
+                # nest (episode_step/episode_return included), which the
+                # model never reads — without the filter those leaves
+                # transfer every dispatch AND the 4-key prewarm dummy
+                # compiles a signature real 6-key traffic misses.
+                input_filter=lambda env: {
+                    k: env[k] for k in _MODEL_KEYS
+                },
+            )
+
+        # Per-env-step wire accounting for the acting path (parsed by
+        # benchmarks/tpu_e2e_async.py; the state table's whole point is
+        # making the state term vanish from both directions).
+        env_up = (
+            int(np.prod(frame_shape)) * np.dtype(frame_dtype).itemsize
+            + 4 + 1 + 4 + 4 + 4  # reward, done, episode_step/return, last_action
+        )
+        state_bytes = sum(
+            int(np.asarray(leaf).nbytes)
+            for leaf in jax.tree_util.tree_leaves(act_model.initial_state(1))
+        )
+        out_down = 4 + 4 * num_actions + 4  # action, logits, baseline
+        if state_table is not None:
+            bytes_up, bytes_down = env_up + 4 + 1, out_down
+        else:
+            bytes_up = env_up + state_bytes
+            bytes_down = out_down + state_bytes
+        log.info(
+            "Acting path: agent_state=%s per-step bytes up=%d down=%d",
+            "device_table" if state_table is not None else "host",
+            bytes_up, bytes_down,
+        )
+
         # No global inference lock (unlike reference polybeast_learner.py:269):
         # act_fn is a pure jitted call whose shared state access is already
         # synchronized, so concurrent threads overlap their host-side pad/
@@ -627,10 +725,19 @@ def train(flags):
             buckets = default_buckets(flags.max_inference_batch_size)
             for b in buckets:
                 dummy_env = dummy_env_outputs(1, b, frame_shape, frame_dtype)
-                dummy_state = jax.tree_util.tree_map(
-                    np.asarray, act_model.initial_state(b)
-                )
-                act_fn(dummy_env, dummy_state, b)
+                if state_table is not None:
+                    # Compile the table step per bucket: all-trash slots,
+                    # advance=False — no real slot is disturbed.
+                    state_table.step(
+                        np.full(b, state_table.trash_slot, np.int32),
+                        np.zeros(b, bool),
+                        dummy_env,
+                    )
+                else:
+                    dummy_state = jax.tree_util.tree_map(
+                        np.asarray, act_model.initial_state(b)
+                    )
+                    act_fn(dummy_env, dummy_state, b)
             log.info(
                 "Prewarmed %d inference buckets in %.1fs",
                 len(buckets), time.time() - t0,
@@ -651,6 +758,7 @@ def train(flags):
                 kwargs={
                     "lock": None,
                     "pipelined": flags.num_inference_threads == 1,
+                    "state_table": state_table,
                 },
                 daemon=True,
                 name=f"inference-{i}",
@@ -671,6 +779,9 @@ def train(flags):
             )
             max_reconnects = 3 if supervised else 0
         pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
+        pool_kwargs = {}
+        if state_table is not None:
+            pool_kwargs["state_table"] = state_table
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
@@ -678,6 +789,7 @@ def train(flags):
             env_server_addresses=addresses,
             initial_agent_state=model.initial_state(1),
             max_reconnects=max_reconnects,
+            **pool_kwargs,
         )
         actor_thread = threading.Thread(
             target=actors.run, daemon=True, name="actorpool"
@@ -685,43 +797,26 @@ def train(flags):
 
         timings = Timings()
 
-        # Host->HBM prefetch (SURVEY §7 hard part #3): a double-buffered stage
-        # between the learner queue and the learner thread. device_put (and
-        # the DP shard placement) is async, so by the time the learner pulls
-        # an item its transfer is already riding behind the previous update's
-        # compute instead of stalling dispatch.
-        prefetch_q = stdlib_queue.Queue(maxsize=2)
+        # Host->HBM prefetch (SURVEY §7 hard part #3): the double-buffered
+        # staging thread between the learner queue and the learner thread
+        # (runtime/queues.DevicePrefetcher). device_put (and the DP shard
+        # placement) is async, so by the time the learner pulls an item its
+        # transfer is already riding behind the previous update's compute
+        # instead of stalling dispatch; a consumed batch's buffers free
+        # when its update's last use drops the reference (no donation —
+        # update_body has no batch-shaped outputs to alias, see
+        # learner.donate_argnums_for).
+        def _place(item):
+            batch = item["batch"]
+            initial_agent_state = item["initial_agent_state"]
+            if shard is not None:
+                return shard(batch, initial_agent_state)
+            return (
+                jax.device_put(batch),
+                jax.device_put(initial_agent_state),
+            )
 
-        def prefetch_loop():
-            try:
-                for item in learner_queue:
-                    batch = item["batch"]
-                    initial_agent_state = item["initial_agent_state"]
-                    if shard is not None:
-                        batch, initial_agent_state = shard(
-                            batch, initial_agent_state
-                        )
-                    else:
-                        batch = jax.device_put(batch)
-                        initial_agent_state = jax.device_put(initial_agent_state)
-                    entry = (batch, initial_agent_state)
-                    while True:
-                        try:
-                            prefetch_q.put(entry, timeout=1.0)
-                            break
-                        except stdlib_queue.Full:
-                            with state_lock:
-                                if state["done"]:
-                                    return
-            except Exception:
-                log.exception("Prefetch thread failed")
-            # No end-sentinel put: the queue may be full of live items the
-            # learner still wants; the learner detects the end by this thread
-            # having exited with the queue drained.
-
-        prefetch_thread = threading.Thread(
-            target=prefetch_loop, daemon=True, name="prefetch"
-        )
+        prefetcher = DevicePrefetcher(learner_queue, _place, depth=2)
 
         def learner_loop():
             try:
@@ -755,9 +850,9 @@ def train(flags):
                 # for a prefetched batch (actor starvation shows up here).
                 timings.reset()
                 try:
-                    batch, initial_agent_state = prefetch_q.get(timeout=1.0)
+                    batch, initial_agent_state = prefetcher.get(timeout=1.0)
                 except stdlib_queue.Empty:
-                    if not prefetch_thread.is_alive():
+                    if not prefetcher.is_alive():
                         break
                     continue
                 timings.time("dequeue")
@@ -809,7 +904,7 @@ def train(flags):
         for t in inference_threads:
             t.start()
         actor_thread.start()
-        prefetch_thread.start()
+        prefetcher.start()
         learner_thread.start()
 
         if flags.profile_dir:
@@ -884,7 +979,8 @@ def train(flags):
             except RuntimeError:
                 pass
         actor_thread.join(timeout=10)
-        prefetch_thread.join(timeout=10)
+        prefetcher.close()
+        prefetcher.join(timeout=10)
         learner_thread.join(timeout=10)
         if is_lead:
             with donation_lock, state_lock:

@@ -11,5 +11,6 @@ from torchbeast_tpu.runtime.queues import (  # noqa: F401
     Batch,
     BatchingQueue,
     ClosedBatchingQueue,
+    DevicePrefetcher,
     DynamicBatcher,
 )

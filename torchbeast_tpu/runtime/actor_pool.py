@@ -58,6 +58,7 @@ class ActorPool:
         initial_agent_state: Any,
         connect_timeout_s: float = 600,
         max_reconnects: int = 0,
+        state_table=None,
     ):
         self._unroll_length = unroll_length
         self._learner_queue = learner_queue
@@ -65,6 +66,19 @@ class ActorPool:
         self._addresses = list(env_server_addresses)
         self._initial_agent_state = initial_agent_state
         self._connect_timeout_s = connect_timeout_s
+        # Device-resident agent state (runtime/state_table.py): actor i
+        # owns table slot i; requests carry {"slot", "advance"} instead
+        # of agent_state, replies carry outputs only, and the rollout-
+        # boundary initial_agent_state comes from a once-per-unroll
+        # read_slot fetch instead of riding every reply.
+        self._state_table = state_table
+        if state_table is not None and state_table.num_slots < len(
+            self._addresses
+        ):
+            raise ValueError(
+                f"state table has {state_table.num_slots} slots for "
+                f"{len(self._addresses)} actors"
+            )
         # Elastic actors (beyond the reference's fail-fast): on a TRANSPORT
         # failure (env-server death / stream cut), an actor may reconnect
         # up to max_reconnects times with a fresh env + reset agent state
@@ -209,18 +223,26 @@ class ActorPool:
 
     def _loop(self, index: int, address: str, progress=None):
         progress = progress if progress is not None else [0]
+        table = self._state_table
         sock = self._connect(address)
         try:
+            if table is not None:
+                # Fresh stream => fresh recurrent state. This also covers
+                # reconnects: the partial rollout was discarded, so the
+                # slot must restart from the initial state.
+                table.reset([index])
+                initial_agent_state = table.initial_state_host
+            else:
+                initial_agent_state = self._initial_agent_state
             env_outputs = self._env_outputs(wire.recv_message(sock))
             agent_state = self._initial_agent_state
             agent_outputs, agent_state = self._compute(
-                env_outputs, agent_state, advance=False
+                index, env_outputs, agent_state, advance=False
             )
             rollout = [(env_outputs, agent_outputs)]
-            initial_agent_state = self._initial_agent_state
             while True:
                 agent_outputs, agent_state = self._compute(
-                    env_outputs, agent_state, advance=True
+                    index, env_outputs, agent_state, advance=True
                 )
                 action = int(np.asarray(agent_outputs["action"]).reshape(()))
                 wire.send_message(
@@ -234,11 +256,29 @@ class ActorPool:
                 if len(rollout) == self._unroll_length + 1:
                     self._enqueue_rollout(rollout, initial_agent_state)
                     rollout = [rollout[-1]]  # overlap-by-one
-                    initial_agent_state = agent_state
+                    # Boundary state for the NEXT rollout: with a state
+                    # table, one read_slot fetch per unroll (the only
+                    # time agent state crosses the host boundary);
+                    # legacy mode carries it from the last reply.
+                    if table is not None:
+                        initial_agent_state = table.read_slot(index)
+                    else:
+                        initial_agent_state = agent_state
         finally:
             sock.close()
 
-    def _compute(self, env_outputs, agent_state, advance: bool):
+    def _compute(self, index: int, env_outputs, agent_state, advance: bool):
+        if self._state_table is not None:
+            # [1, 1]-shaped ids so queue batching along batch_dim=1
+            # concatenates them like every other leaf.
+            outputs = self._inference_batcher.compute(
+                {
+                    "env": env_outputs,
+                    "slot": np.full((1, 1), index, np.int32),
+                    "advance": np.full((1, 1), advance, bool),
+                }
+            )
+            return outputs["outputs"], agent_state
         outputs = self._inference_batcher.compute(
             {"env": env_outputs, "agent_state": agent_state}
         )

@@ -5,9 +5,21 @@ The reference's inference threads run the model on whatever batch size the
 batcher produced (polybeast_learner.py:269-285) — fine for CUDA, hostile to
 XLA, where every distinct batch size is a recompile (SURVEY.md §7 hard part
 #1). Here each dynamic batch is padded up to the nearest power-of-two bucket
-(row 0 repeated), the jitted step runs at that static shape (one compile per
-bucket, a handful total), and the outputs are sliced back to the true size
-before set_outputs distributes rows to the waiting actors.
+(the last row repeated — see pad_to), the jitted step runs at that static
+shape (one compile per bucket, a handful total), and the outputs are sliced
+back to the true size before set_outputs distributes rows to the waiting
+actors.
+
+Two state regimes:
+
+- Legacy (state_table=None): requests carry `agent_state`; the loop pads
+  it alongside the env nest and the reply materializes the advanced state
+  back to the actor — state crosses the host boundary twice per step.
+- Device-resident (state_table=DeviceStateTable): requests carry a `slot`
+  id and an `advance` flag instead of state; the table's jitted step
+  gathers/advances/scatters state entirely on device and the reply holds
+  outputs only. Padding rows scatter to the table's trash slot so they
+  can never race a real slot's update.
 """
 
 import logging
@@ -65,6 +77,30 @@ def slice_to(tree: Any, size: int, batch_dim: int) -> Any:
     return nest.map(cut, tree)
 
 
+def pad_slots(slots: np.ndarray, size: int, trash_slot: int) -> np.ndarray:
+    """Pad a [n] slot-id vector to `size` with the table's trash slot —
+    NOT edge-repeated: a repeated real id would make the padded row's
+    scatter race the real row's (duplicate-index scatter is last-writer-
+    wins, so the real advance could be silently dropped)."""
+    slots = np.asarray(slots).reshape(-1)
+    if slots.shape[0] == size:
+        return slots
+    return np.concatenate(
+        [slots, np.full(size - slots.shape[0], trash_slot, slots.dtype)]
+    )
+
+
+def pad_advance(advance: np.ndarray, size: int) -> np.ndarray:
+    """Pad a [n] advance mask to `size` with False (padding rows must
+    never persist a state advance)."""
+    advance = np.asarray(advance, bool).reshape(-1)
+    if advance.shape[0] == size:
+        return advance
+    return np.concatenate(
+        [advance, np.zeros(size - advance.shape[0], bool)]
+    )
+
+
 def inference_loop(
     inference_batcher,
     act_fn: Callable,
@@ -72,11 +108,20 @@ def inference_loop(
     batch_dim: int = 1,
     lock: threading.Lock = None,
     pipelined: bool = False,
+    state_table=None,
 ):
     """Thread body (run num_inference_threads of these).
 
     act_fn(env_outputs, agent_state, batch_size) ->
         (agent_outputs, new_agent_state)   # numpy or device arrays
+
+    With `state_table` (a runtime.state_table.DeviceStateTable), requests
+    carry {"env", "slot", "advance"} instead of {"env", "agent_state"}:
+    the table's own jitted step (which owns params/rng threading via its
+    context_fn) gathers/advances/scatters agent state on device and
+    `act_fn` is ignored (pass None). Replies then hold {"outputs"} only —
+    no state leaf ever crosses the host boundary
+    (tests/test_state_table.py pins this with jax.transfer_guard).
 
     act_fn owns params access and rng threading (see polybeast.py). Pass
     ONE lock shared by every inference thread to serialize model calls
@@ -107,13 +152,23 @@ def inference_loop(
     overlap already comes from the threads themselves).
 
     A failing act_fn fails only its batch (promises broken with the error
-    so producers wake immediately); the loop continues serving.
+    so producers wake immediately); the loop continues serving. Exception:
+    a failed STATE-TABLE step poisons the table (its buffer is donated
+    into the dispatch, so it may already be consumed) — the loop fails
+    the batch and re-raises to kill the thread rather than serve garbage.
     """
     buckets = default_buckets(max_batch_size)
 
     def flush(entry):
         batch, outputs, new_state, n = entry
         try:
+            if state_table is not None:
+                # Device-side slice + one explicit device_get; the
+                # reply carries no agent-state leaves.
+                batch.set_outputs(
+                    {"outputs": state_table.fetch(outputs, n)}
+                )
+                return
             outputs = nest.map(np.asarray, outputs)
             new_state = nest.map(np.asarray, new_state)
             batch.set_outputs(
@@ -130,24 +185,41 @@ def inference_loop(
     for batch in inference_batcher:
         try:
             inputs = batch.get_inputs()
-            env_outputs, agent_state = inputs["env"], inputs["agent_state"]
+            env_outputs = inputs["env"]
             n = len(batch)
             padded = bucket_size(n, buckets)
             env_padded = pad_to(env_outputs, padded, batch_dim)
-            state_padded = pad_to(agent_state, padded, batch_dim)
-            if lock is not None:
-                with lock:
+            if state_table is not None:
+                slots = pad_slots(
+                    inputs["slot"], padded, state_table.trash_slot
+                )
+                advance = pad_advance(inputs["advance"], padded)
+                outputs = state_table.step(slots, advance, env_padded)
+                new_state = None
+            else:
+                state_padded = pad_to(
+                    inputs["agent_state"], padded, batch_dim
+                )
+                if lock is not None:
+                    with lock:
+                        outputs, new_state = act_fn(
+                            env_padded, state_padded, padded
+                        )
+                else:
                     outputs, new_state = act_fn(
                         env_padded, state_padded, padded
                     )
-            else:
-                outputs, new_state = act_fn(env_padded, state_padded, padded)
         except Exception as e:  # noqa: BLE001
-            log.exception("Inference batch failed; continuing")
             batch.fail(e)
             if pending is not None:
                 flush(pending)
                 pending = None
+            if state_table is not None and state_table.poisoned:
+                # The donated table buffer may already be consumed;
+                # per-batch retry would serve garbage state. Die loudly.
+                log.exception("State table poisoned; inference thread exiting")
+                raise
+            log.exception("Inference batch failed; continuing")
             continue
         # This batch is dispatched (async); NOW reply to the previous one.
         if pending is not None:

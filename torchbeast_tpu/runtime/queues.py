@@ -23,9 +23,10 @@ test surface):
 """
 
 import collections
+import queue as stdlib_queue
 import threading
 import time
-from typing import Any, List, Optional, Tuple
+from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -273,6 +274,102 @@ class Batch:
                     "Batch died before outputs were set"
                 )
                 promise.event.set()
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device staging between a batch source and
+    the learner thread.
+
+    A background thread drains `source` (any iterable — typically the
+    learner BatchingQueue) and applies `place_fn` (jax.device_put / the
+    DP shard placement — injected so this module stays numpy-only) to
+    each item. Because device placement is asynchronous, by the time the
+    learner pulls an item its H2D transfer is already riding behind the
+    previous update's compute instead of stalling the next dispatch;
+    `depth=2` is the classic double buffer (one staging while one is
+    consumed). Staging contract: each staged batch is handed to exactly
+    one consumer and nothing re-reads it afterwards, so its device
+    buffers free as soon as the consuming update drops the reference
+    (and a derived update step with batch-shaped outputs may safely
+    donate them — learner.donate_argnums_for(donate, donate_batch=True)).
+
+    End-of-stream contract (mirrors the inline prefetch thread this
+    replaces, polybeast r05): no end sentinel is enqueued — the internal
+    queue may still hold live items when the source closes — consumers
+    detect exhaustion by `get()` raising `queue.Empty` while
+    `is_alive()` is False. A `place_fn`/source error is logged, recorded
+    on `.error`, and ends the stream the same way.
+    """
+
+    def __init__(
+        self,
+        source: Iterable,
+        place_fn: Callable[[Any], Any],
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = source
+        self._place = place_fn
+        self._q = stdlib_queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="device-prefetch"
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        import logging
+
+        try:
+            for item in self._source:
+                staged = self._place(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=1.0)
+                        break
+                    except stdlib_queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except StopIteration:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self.error = e
+            logging.getLogger(__name__).exception(
+                "Device prefetch thread failed"
+            )
+
+    def get(self, timeout: Optional[float] = None):
+        """One staged item; raises queue.Empty on timeout (the caller
+        loops, checking is_alive() to detect exhaustion)."""
+        return self._q.get(timeout=timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self):
+        """Stop staging (a blocked put exits within its poll interval).
+        Already-staged items stay readable."""
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None):
+        self._thread.join(timeout)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            try:
+                return self.get(timeout=0.2)
+            except stdlib_queue.Empty:
+                if not self.is_alive():
+                    raise StopIteration from None
 
 
 class DynamicBatcher:
