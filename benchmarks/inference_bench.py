@@ -35,6 +35,13 @@ import sys
 import threading
 import time
 
+# Runnable as `python benchmarks/inference_bench.py` (same repo-root
+# insert as the sibling benches; otherwise torchbeast_tpu only resolves
+# when the caller exports PYTHONPATH).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 _ARTIFACT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "artifacts",
@@ -124,13 +131,21 @@ def acting_path_bench(args):
             [functools.partial(MockEnv, num_actions=A) for _ in range(B)]
         )
 
-    def measure(collector, pool):
+    from torchbeast_tpu import telemetry
+
+    snap_before = telemetry.snapshot()
+    reg = telemetry.get_registry()
+
+    def measure(collector, pool, label):
+        h_collect = reg.histogram(f"acting.{label}.collect_s")
         try:
             for _ in range(args.acting_warmup):
                 collector.collect()  # compile + steady-state the pipeline
             t0 = time.perf_counter()
             for _ in range(args.acting_collects):
+                tc = time.perf_counter()
                 collector.collect()
+                h_collect.observe(time.perf_counter() - tc)
             return (
                 T * B * args.acting_collects / (time.perf_counter() - t0)
             )
@@ -141,6 +156,7 @@ def acting_path_bench(args):
     sync_sps = measure(
         RolloutCollector(pool, host_policy, model.initial_state(B), T),
         pool,
+        "sync",
     )
     pool = make_pool()
     lag1_sps = measure(
@@ -151,6 +167,7 @@ def acting_path_bench(args):
             T,
         ),
         pool,
+        "pipelined",
     )
 
     # Per-env-step host<->device traffic (whole batch, both directions).
@@ -189,6 +206,10 @@ def acting_path_bench(args):
         },
         "platform": jax.devices()[0].platform,
         "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        # Interval telemetry for THIS section (per-collect latency
+        # distributions under acting.{sync,pipelined}.collect_s) — run
+        # variance is attributable from the artifact alone.
+        "telemetry": telemetry.telemetry_block(prev=snap_before),
     }
     print(json.dumps(result), flush=True)
     try:
@@ -221,6 +242,10 @@ def main():
                         help="Env pool for the acting section: process "
                              "(monobeast default; real overlap window) "
                              "or serial (pure framing-cost isolation).")
+    parser.add_argument("--no_telemetry", action="store_true",
+                        help="Disable instrumentation (the acceptance "
+                             "overhead measurement runs the bench with "
+                             "and without and compares SPS).")
     args = parser.parse_args()
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -229,6 +254,10 @@ def main():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax
     import numpy as np
+
+    from torchbeast_tpu import telemetry
+
+    telemetry.set_enabled(not args.no_telemetry)
 
     from torchbeast_tpu import learner as learner_lib
     from torchbeast_tpu.models import create_model
@@ -274,11 +303,19 @@ def main():
         )
 
     def run_config(runtime_name, queue_mod, with_lock):
+        # telemetry_name is Python-runtime-only (the C++ batcher doesn't
+        # take the kwarg; its batch sizes come from inference_loop's own
+        # instruments).
+        batcher_tm = (
+            {"telemetry_name": "inference"}
+            if runtime_name == "python" else {}
+        )
         batcher = queue_mod.DynamicBatcher(
             batch_dim=1,
             minimum_batch_size=1,
             maximum_batch_size=args.max_batch_size,
             timeout_ms=20,
+            **batcher_tm,
         )
         lock = threading.Lock() if with_lock else None
         servers = [
@@ -333,6 +370,9 @@ def main():
             time.sleep(0.1)
         with lat_lock:
             latencies.clear()  # drop compile-tainted samples
+        # Snapshot AFTER warmup so the embedded telemetry delta covers
+        # the same steady-state window as the latency numbers.
+        snap_before = telemetry.snapshot()
         time.sleep(args.seconds)
         stop.set()
         for t in actors:
@@ -362,6 +402,9 @@ def main():
             "bytes_per_step_up": req_bytes,
             "bytes_per_step_down": 4 + 4 * A + 4 + state_bytes,
             "platform": jax.devices()[0].platform,
+            # Interval telemetry for THIS configuration (batch-size
+            # distribution, queue/dispatch/reply latency p50/p95/p99).
+            "telemetry": telemetry.telemetry_block(prev=snap_before),
         }
         print(json.dumps(result), flush=True)
         return result

@@ -12,7 +12,10 @@ polybeast_learner.py:553-579).
 Usage: python benchmarks/tpu_e2e_async.py [--total_steps N] [--mock]
 Writes the captured log to --out (default /tmp/tbt_e2e.log) and prints
 a one-line JSON summary (steady-state SPS over the last half of the
-run, mean queue depths).
+run, mean queue depths) with the run's final telemetry snapshot
+embedded (read from {savedir}/{xpid}/telemetry.jsonl — structured
+JSON, not log scraping; the acting-path wire accounting rides its
+`acting_path` block).
 """
 
 import argparse
@@ -24,16 +27,11 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 LOG_RE = re.compile(
     r"Step (\d+) @ ([\d.]+) SPS\. Inference batcher size: (\d+)\. "
     r"Learner queue size: (\d+)\."
-)
-
-# polybeast logs its acting-path wire accounting once at startup:
-# "Acting path: agent_state=device_table per-step bytes up=N down=M"
-ACTING_RE = re.compile(
-    r"Acting path: agent_state=(\w+) per-step bytes up=(\d+) down=(\d+)"
 )
 
 
@@ -56,6 +54,8 @@ def main():
     ap.add_argument("--timeout_s", type=int, default=1500)
     args = ap.parse_args()
 
+    savedir = "/tmp/tbt_e2e_save"
+    xpid = f"e2e-{int(time.time())}"
     cmd = [
         sys.executable, "-m", "torchbeast_tpu.polybeast",
         "--env", args.env,
@@ -65,8 +65,8 @@ def main():
         "--batch_size", str(args.batch_size),
         "--unroll_length", str(args.unroll_length),
         "--total_steps", str(args.total_steps),
-        "--savedir", "/tmp/tbt_e2e_save",
-        "--xpid", f"e2e-{int(time.time())}",
+        "--savedir", savedir,
+        "--xpid", xpid,
         "--pipes_basename", "unix:/tmp/tbt_e2e_pipe",
         "--prewarm_inference",  # no mid-run compile stalls in telemetry
     ]
@@ -94,20 +94,23 @@ def main():
     wall = time.time() - t0
 
     rows = []
-    acting = None
     with open(args.out) as f:
         for line in f:
             m = LOG_RE.search(line)
             if m:
                 rows.append(tuple(float(x) for x in m.groups()))
-                continue
-            m = ACTING_RE.search(line)
-            if m:
-                acting = {
-                    "agent_state": m.group(1),
-                    "bytes_per_step_up": int(m.group(2)),
-                    "bytes_per_step_down": int(m.group(3)),
-                }
+
+    # Structured telemetry from the run's own exporter (queue depths,
+    # batch-size distribution p50/p95, stage latencies, wire-byte
+    # counters, and the acting-path accounting) — the attribution data
+    # the SPS log rows can't carry.
+    from torchbeast_tpu import telemetry
+
+    snaps = telemetry.read_jsonl(
+        os.path.join(savedir, xpid, "telemetry.jsonl")
+    )
+    final_snap = snaps[-1] if snaps else None
+    acting = final_snap.get("acting_path") if final_snap else None
     if not rows:
         print(json.dumps({
             "error": f"no telemetry rows parsed (rc={rc}, "
@@ -133,9 +136,17 @@ def main():
         "steady_sps_max": round(max(sps), 1),
         "inference_q_mean": round(sum(inf_q) / len(inf_q), 2),
         "learner_q_mean": round(sum(lrn_q) / len(lrn_q), 2),
-        # Acting-path wire accounting parsed from polybeast's startup
-        # line: which side holds agent state and what crosses per step.
+        # Acting-path wire accounting from the run's telemetry snapshot:
+        # which side holds agent state and what crosses per step.
         "acting_path": acting,
+        # The run's final cumulative telemetry snapshot — bench variance
+        # is attributable (queue wait vs batch wait vs dispatch) without
+        # re-running under a profiler.
+        "telemetry": {
+            "enabled": final_snap is not None,
+            "snapshot": final_snap,
+        },
+        "telemetry_lines": len(snaps),
         "n_telemetry_rows": len(rows),
         "log": args.out,
     }))

@@ -56,6 +56,77 @@ def test_pallas_smoke_compiled_cpu_fails_cleanly():
         assert "error" in case and "traceback" in case
 
 
+def _validate_telemetry_block(block):
+    """Shared schema assertion for bench artifacts' `telemetry` block
+    (the same shape tests/test_telemetry.py pins against the in-process
+    constructor — this end validates the subprocess artifacts)."""
+    from torchbeast_tpu import telemetry
+
+    assert isinstance(block, dict), block
+    assert isinstance(block.get("enabled"), bool)
+    if block["enabled"]:
+        problems = telemetry.validate_snapshot(block["snapshot"])
+        assert problems == [], problems
+
+
+def test_inference_bench_embeds_telemetry(tmp_path):
+    """Every inference_bench JSON line must carry a well-formed
+    `telemetry` block (artifact schema drift fails here, not at
+    chip-measure time)."""
+    proc = _run([
+        "benchmarks/inference_bench.py", "--actors", "4",
+        "--seconds", "1", "--num_inference_threads", "1",
+        "--acting_batch", "4", "--acting_collects", "2",
+        "--acting_warmup", "1", "--acting_unroll", "5",
+        "--acting_pool", "serial",
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        json.loads(ln)
+        for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    assert len(lines) >= 3  # 2+ hot-path configs + acting section
+    for result in lines:
+        _validate_telemetry_block(result["telemetry"])
+    hot = [r for r in lines if r["bench"] == "inference_hot_path"]
+    snap = hot[0]["telemetry"]["snapshot"]
+    # Batch-size distribution present with percentiles.
+    bs = snap["histograms"]["inference.batch_size"]
+    assert bs["count"] > 0 and bs["p95"] >= bs["p50"] > 0
+    acting = next(r for r in lines if r["bench"] == "acting_path")
+    hists = acting["telemetry"]["snapshot"]["histograms"]
+    assert "acting.sync.collect_s" in hists
+    assert "acting.pipelined.collect_s" in hists
+
+
+def test_inference_bench_no_telemetry_flag(tmp_path):
+    """--no_telemetry: the block must say so (and not explode)."""
+    proc = _run([
+        "benchmarks/inference_bench.py", "--actors", "2",
+        "--seconds", "0.5", "--num_inference_threads", "1",
+        "--skip_acting", "--no_telemetry",
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        json.loads(ln)
+        for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    assert lines
+    for result in lines:
+        assert result["telemetry"]["enabled"] is False
+
+
+def test_telemetry_selftest_cli():
+    """The exporter's --selftest is the cheap CI guard for the whole
+    snapshot/delta/jsonl/prometheus stack."""
+    proc = _run(["-m", "torchbeast_tpu.telemetry", "--selftest"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["selftest"] == "telemetry" and out["ok"] is True
+
+
 def test_vtrace_bench_emits_rows(tmp_path):
     out_md = tmp_path / "vtrace.md"
     proc = _run([

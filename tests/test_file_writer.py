@@ -65,6 +65,40 @@ def test_unsuccessful_close(tmp_path):
     assert meta["successful"] is False
 
 
+def test_close_releases_log_handlers(tmp_path):
+    """Regression: close() must detach AND close the out.log
+    FileHandler — the logger outlives the writer in logging's global
+    registry, so long test sessions / multi-writer runs used to
+    accumulate one open fd per FileWriter."""
+    import logging
+
+    fw = FileWriter(xpid="leak", rootdir=str(tmp_path))
+    logger = logging.getLogger("filewriter.leak")
+    assert len(logger.handlers) == 1
+    handler = logger.handlers[0]
+    fw.close()
+    assert logger.handlers == []
+    assert handler.stream is None or handler.stream.closed
+
+    # Sequential same-xpid writers never stack handlers (the old
+    # `if not handlers` guard would have seen the stale one and logged
+    # through a closed stream).
+    for _ in range(3):
+        fw = FileWriter(xpid="leak", rootdir=str(tmp_path))
+        assert len(logger.handlers) == 1
+        fw.log({"loss": 1.0}, verbose=True)
+        fw.close()
+    assert logger.handlers == []
+
+
+def test_telemetry_path_in_paths(tmp_path):
+    """The drivers point their JsonLinesExporter at
+    paths['telemetry']; it must live under the xpid dir."""
+    fw = FileWriter(xpid="xp", rootdir=str(tmp_path))
+    assert fw.paths["telemetry"] == str(tmp_path / "xp" / "telemetry.jsonl")
+    fw.close()
+
+
 def test_timings_mean_and_summary():
     import time
 

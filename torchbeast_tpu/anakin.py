@@ -37,14 +37,19 @@ from torchbeast_tpu.utils import (
     save_checkpoint,
 )
 
-logging.basicConfig(
-    format=(
-        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
-        "%(message)s"
-    ),
-    level=logging.INFO,
-)
 log = logging.getLogger("torchbeast_tpu.anakin")
+
+
+def _configure_logging():
+    """Called from main(), NOT at import: importing this module (as
+    every test does) must not mutate global logging state."""
+    logging.basicConfig(
+        format=(
+            "[%(levelname)s:%(process)d %(module)s:%(lineno)d "
+            "%(asctime)s] %(message)s"
+        ),
+        level=logging.INFO,
+    )
 
 
 def _agent_out_dict(out):
@@ -371,6 +376,7 @@ def train(flags):
 
 
 def main(flags):
+    _configure_logging()
     return train(flags)
 
 

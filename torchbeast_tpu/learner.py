@@ -16,11 +16,14 @@ torch-style RMSProp (eps outside the sqrt); LR decayed linearly to zero over
 total_steps environment frames.
 """
 
+import time
 from typing import Any, Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
+
+from torchbeast_tpu import telemetry
 
 from torchbeast_tpu.ops import (
     compute_baseline_loss,
@@ -329,6 +332,42 @@ def make_update_step(
         update_body(model, optimizer, hp),
         donate_argnums=donate_argnums_for(donate, donate_batch),
     )
+
+
+def instrument_update_step(update_step, registry=None):
+    """Wrap a (jitted) update step with learner-side telemetry:
+
+    - learner.update_dispatch_s: host time to hand XLA the update (the
+      dispatch is async — device compute shows up in the driver's
+      dequeue/learn stage histograms, not here);
+    - learner.batch_bytes: host->device transfer volume of the batch +
+      initial agent state per update (the learner-side wire-accounting
+      analog of the acting path's bytes_per_step gauges);
+    - learner.updates / learner.frames_per_update.
+
+    Signature-transparent: drivers swap `update_step =
+    instrument_update_step(update_step)` and nothing else changes.
+    """
+    reg = registry if registry is not None else telemetry.get_registry()
+    h_dispatch = reg.histogram("learner.update_dispatch_s")
+    c_bytes = reg.counter("learner.batch_bytes")
+    c_updates = reg.counter("learner.updates")
+
+    def wrapped(params, opt_state, batch, initial_agent_state):
+        nbytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(
+                (batch, initial_agent_state)
+            )
+        )
+        t0 = time.perf_counter()
+        out = update_step(params, opt_state, batch, initial_agent_state)
+        h_dispatch.observe(time.perf_counter() - t0)
+        c_bytes.inc(nbytes)
+        c_updates.inc()
+        return out
+
+    return wrapped
 
 
 def act_body(model, params, rng, env_output, agent_state):

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu import telemetry
 from torchbeast_tpu.envs import create_env
 from torchbeast_tpu.envs.vec import ProcessEnvPool, SerialEnvPool
 from torchbeast_tpu.models import create_model
@@ -39,14 +40,20 @@ from torchbeast_tpu.utils import (
     save_checkpoint,
 )
 
-logging.basicConfig(
-    format=(
-        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
-        "%(message)s"
-    ),
-    level=logging.INFO,
-)
 log = logging.getLogger("torchbeast_tpu.monobeast")
+
+
+def _configure_logging():
+    """Called from main(), NOT at import: importing this module (as
+    every test does, and as polybeast does for its shared helpers) must
+    not mutate global logging state."""
+    logging.basicConfig(
+        format=(
+            "[%(levelname)s:%(process)d %(module)s:%(lineno)d "
+            "%(asctime)s] %(message)s"
+        ),
+        level=logging.INFO,
+    )
 
 
 def make_parser():
@@ -226,6 +233,7 @@ def make_parser():
     parser.add_argument("--num_test_episodes", type=int, default=10)
     parser.add_argument("--profile_dir", default=None,
                         help="If set, capture a jax.profiler trace here.")
+    telemetry.add_arguments(parser)
     return parser
 
 
@@ -652,6 +660,14 @@ def train(flags):
     checkpoint_path = os.path.join(
         os.path.expanduser(flags.savedir), flags.xpid, "model.ckpt"
     )
+    # Telemetry (ISSUE 2): stage latencies, learner batch-size
+    # distribution, and dispatch-queue occupancy land in
+    # {xpid}/telemetry.jsonl on the 5s log cadence.
+    tele = telemetry.DriverTelemetry(
+        flags, plogger.paths["telemetry"], driver="monobeast"
+    )
+    telemetry_on = tele.enabled
+    reg = tele.registry
 
     hp = hparams_from_flags(flags)
     num_actions, frame_shape, frame_dtype = _probe_env(flags)
@@ -712,6 +728,9 @@ def train(flags):
         place_sub = lambda b, s: (  # noqa: E731
             jax.device_put(b), jax.device_put(s)
         )
+    if telemetry_on:
+        # Dispatch latency + batch transfer bytes per update.
+        update_step = learner_lib.instrument_update_step(update_step)
     act_step = learner_lib.make_act_step(model)
 
     pool = _make_pool(flags, B)
@@ -748,7 +767,20 @@ def train(flags):
             pool, policy, model.initial_state(B), unroll_length=T
         )
 
-        timings = Timings()
+        # Stage latencies (collect/learn) become driver.* histograms in
+        # the snapshot; with telemetry off, a private registry keeps the
+        # 5s log line working unchanged.
+        timings = Timings(
+            registry=reg if telemetry_on else None, prefix="driver."
+        )
+        # The sync trainer has no inter-thread queues; its occupancy
+        # analog is the delayed-stats dispatch pipeline — update
+        # batches dispatched whose stats the host has NOT yet flushed
+        # (sampled at the log tick: 0 before the first dispatch /
+        # after the final flush, B/batch_size in steady state).
+        h_batch_size = reg.histogram("learner.batch_size")
+        g_dispatch_q = reg.gauge("dispatch_queue.depth")
+        g_sps = reg.gauge("learner.sps")
         last_checkpoint_time = time.time()
         last_log_time = time.time()
         last_log_step = step
@@ -792,10 +824,12 @@ def train(flags):
     except BaseException:
         pool.close()
         raise
+    tracer = telemetry.get_tracer()
     try:
         while step < flags.total_steps:
             timings.reset()
-            batch, initial_agent_state = collector.collect()
+            with tracer.span("driver.collect", cat="driver"):
+                batch, initial_agent_state = collector.collect()
             timings.time("collect")
             if flags.overlap_collect:
                 # Adopt the chain head dispatched BEFORE this collect —
@@ -810,19 +844,27 @@ def train(flags):
             # batch_size columns; aggregate stats over ALL sub-batches
             # (losses averaged, episode sums/counts summed).
             device_stats = []
-            for i in range(0, B, flags.batch_size):
-                sub = {
-                    k: v[:, i : i + flags.batch_size] for k, v in batch.items()
-                }
-                sub_state = jax.tree_util.tree_map(
-                    lambda s: s[:, i : i + flags.batch_size], initial_agent_state
-                )
-                sub, sub_state = place_sub(sub, sub_state)
-                latest_params, opt_state, train_stats = update_step(
-                    latest_params, opt_state, sub, sub_state
-                )
-                device_stats.append(train_stats)
-                step += T * flags.batch_size
+            with tracer.span("driver.learn", cat="driver"):
+                for i in range(0, B, flags.batch_size):
+                    sub = {
+                        k: v[:, i : i + flags.batch_size]
+                        for k, v in batch.items()
+                    }
+                    sub_state = jax.tree_util.tree_map(
+                        lambda s: s[:, i : i + flags.batch_size],
+                        initial_agent_state,
+                    )
+                    sub, sub_state = place_sub(sub, sub_state)
+                    # Actual sub-batch columns, not the flag (honest
+                    # even while train() enforces divisibility).
+                    h_batch_size.observe(
+                        min(i + flags.batch_size, B) - i
+                    )
+                    latest_params, opt_state, train_stats = update_step(
+                        latest_params, opt_state, sub, sub_state
+                    )
+                    device_stats.append(train_stats)
+                    step += T * flags.batch_size
             if not flags.overlap_collect:
                 params_cell[0] = latest_params  # zero policy lag
             if pending is not None:
@@ -834,6 +876,11 @@ def train(flags):
             if now - last_log_time > 5:
                 sps = (step - last_log_step) / (now - last_log_time)
                 last_log_time, last_log_step = now, step
+                g_sps.set(sps)
+                # Dispatched-unflushed stat batches at this instant
+                # (the delayed-stats pipeline's real occupancy).
+                g_dispatch_q.set(len(pending[0]) if pending else 0)
+                tele.write(extra={"step": step})
                 means = timings.means()
                 log.info(
                     "Steps %d @ %.1f SPS. Loss %s. "
@@ -881,6 +928,7 @@ def train(flags):
             except Exception:
                 log.exception("Could not flush final stats")
             pending = None
+        g_dispatch_q.set(0)  # everything flushed (or abandoned) now
         if flags.profile_dir:
             jax.profiler.stop_trace()
         save_checkpoint(
@@ -891,6 +939,7 @@ def train(flags):
             flags=vars(flags),
             stats=stats,
         )
+        tele.shutdown(step=step)
         plogger.close(successful=successful)
         pool.close()
     log.info("Learning finished after %d steps.", step)
@@ -961,6 +1010,7 @@ def test(flags):
 
 
 def main(flags):
+    _configure_logging()
     if flags.mode == "train":
         return train(flags)
     return test(flags)

@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu import telemetry
 from torchbeast_tpu import polybeast_env
 from torchbeast_tpu.monobeast import (
     _init_model_and_params,
@@ -47,14 +48,19 @@ from torchbeast_tpu.utils import (
     save_checkpoint,
 )
 
-logging.basicConfig(
-    format=(
-        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
-        "%(message)s"
-    ),
-    level=logging.INFO,
-)
 log = logging.getLogger("torchbeast_tpu.polybeast")
+
+
+def _configure_logging():
+    """Called from main(), NOT at import: importing this module (as
+    every test does) must not mutate global logging state."""
+    logging.basicConfig(
+        format=(
+            "[%(levelname)s:%(process)d %(module)s:%(lineno)d "
+            "%(asctime)s] %(message)s"
+        ),
+        level=logging.INFO,
+    )
 
 
 def make_parser():
@@ -214,6 +220,7 @@ def make_parser():
                              "error. App-level env errors are never "
                              "absorbed either way.")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600)
+    telemetry.add_arguments(parser)
     # Loss / optimizer (same knobs as monobeast).
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
     parser.add_argument("--entropy_cost_final", type=float, default=None,
@@ -289,6 +296,15 @@ def train(flags):
         xpid=flags.xpid if is_lead else f"{flags.xpid}-host{proc_id}",
         xp_args=vars(flags), rootdir=flags.savedir,
     )
+    # Telemetry (ISSUE 2): one process-wide registry every runtime
+    # stage writes into; snapshots append to {xpid}/telemetry.jsonl on
+    # the monitor cadence. --no_telemetry turns the global instruments
+    # into no-ops.
+    tele = telemetry.DriverTelemetry(
+        flags, plogger.paths["telemetry"], driver="polybeast"
+    )
+    telemetry_on = tele.enabled
+    reg = tele.registry
     # All hosts resume from the LEAD's checkpoint (shared filesystem, as
     # with the reference's savedir convention).
     checkpoint_path = os.path.join(
@@ -501,6 +517,9 @@ def train(flags):
                 model, optimizer, hp, donate="opt_only"
             )
             shard = None
+        if telemetry_on:
+            # Dispatch latency + batch transfer bytes per update.
+            update_step = learner_lib.instrument_update_step(update_step)
         act_model = model
         if proc_count > 1 and (
             expert_par > 1 or seq_par > 1 or pipe_par > 1
@@ -598,18 +617,31 @@ def train(flags):
 
         # Each host's queue batches its LOCAL rows; shard_batch assembles the
         # global array across hosts (local_rows == batch_size single-host).
+        # telemetry_name wires depth/batch-size/wait series — Python
+        # runtime only (the C++ classes don't take the kwarg; their
+        # depths still land in the monitor-loop gauges below).
+        queue_tm = (
+            {} if flags.native_runtime
+            else {"telemetry_name": "learner_queue"}
+        )
+        batcher_tm = (
+            {} if flags.native_runtime
+            else {"telemetry_name": "inference"}
+        )
         learner_queue = queue_mod.BatchingQueue(
             batch_dim=1,
             minimum_batch_size=local_rows,
             maximum_batch_size=local_rows,
             maximum_queue_size=flags.max_learner_queue_size or local_rows,
             check_inputs=True,
+            **queue_tm,
         )
         inference_batcher = queue_mod.DynamicBatcher(
             batch_dim=1,
             minimum_batch_size=1,
             maximum_batch_size=flags.max_inference_batch_size,
             timeout_ms=flags.inference_timeout_ms,
+            **batcher_tm,
         )
 
         def act_fn(env_outputs, agent_state, batch_size):
@@ -691,9 +723,13 @@ def train(flags):
                 },
             )
 
-        # Per-env-step wire accounting for the acting path (parsed by
-        # benchmarks/tpu_e2e_async.py; the state table's whole point is
-        # making the state term vanish from both directions).
+        # Per-env-step wire accounting for the acting path. Exported as
+        # telemetry gauges + a static `acting_path` block on every
+        # telemetry.jsonl line (benchmarks/tpu_e2e_async.py consumes the
+        # structured snapshot, not log scraping; the cumulative actual
+        # traffic is the actor pool's wire.bytes_up/down counters). The
+        # state table's whole point is making the state term vanish
+        # from both directions.
         env_up = (
             int(np.prod(frame_shape)) * np.dtype(frame_dtype).itemsize
             + 4 + 1 + 4 + 4 + 4  # reward, done, episode_step/return, last_action
@@ -708,11 +744,15 @@ def train(flags):
         else:
             bytes_up = env_up + state_bytes
             bytes_down = out_down + state_bytes
-        log.info(
-            "Acting path: agent_state=%s per-step bytes up=%d down=%d",
-            "device_table" if state_table is not None else "host",
-            bytes_up, bytes_down,
-        )
+        acting_mode = "device_table" if state_table is not None else "host"
+        reg.gauge("acting.bytes_per_step_up").set(bytes_up)
+        reg.gauge("acting.bytes_per_step_down").set(bytes_down)
+        tele.set_static("acting_path", {
+            "agent_state": acting_mode,
+            "bytes_per_step_up": bytes_up,
+            "bytes_per_step_down": bytes_down,
+        })
+        log.info("Acting path: agent_state=%s", acting_mode)
 
         # No global inference lock (unlike reference polybeast_learner.py:269):
         # act_fn is a pure jitted call whose shared state access is already
@@ -795,7 +835,12 @@ def train(flags):
             target=actors.run, daemon=True, name="actorpool"
         )
 
-        timings = Timings()
+        # Stage latencies (dequeue/learn) become learner.* histograms
+        # in the snapshot; with telemetry off, a private registry keeps
+        # the 5s log line working unchanged.
+        timings = Timings(
+            registry=reg if telemetry_on else None, prefix="learner."
+        )
 
         # Host->HBM prefetch (SURVEY §7 hard part #3): the double-buffered
         # staging thread between the learner queue and the learner thread
@@ -816,7 +861,9 @@ def train(flags):
                 jax.device_put(initial_agent_state),
             )
 
-        prefetcher = DevicePrefetcher(learner_queue, _place, depth=2)
+        prefetcher = DevicePrefetcher(
+            learner_queue, _place, depth=2, telemetry_name="prefetch"
+        )
 
         def learner_loop():
             try:
@@ -935,6 +982,13 @@ def train(flags):
             now = time.time()
             sps = (now_step - last_step) / (now - last_time)
             last_step, last_time = now_step, now
+            if telemetry_on:
+                # Gauges set here (not in the queues) also cover the
+                # native runtime, whose C++ queues carry no instruments.
+                reg.gauge("learner.sps").set(sps)
+                reg.gauge("learner_queue.depth").set(learner_queue.size())
+                reg.gauge("inference.depth").set(inference_batcher.size())
+                tele.write(extra={"step": now_step})
             means = timings.means()
             log.info(
                 "Step %d @ %.1f SPS. Inference batcher size: %d. "
@@ -992,6 +1046,7 @@ def train(flags):
                     flags=vars(flags),
                     stats=state["stats"],
                 )
+        tele.shutdown(step=state["step"])
         plogger.close(successful=successful)
         if server_supervisor is not None:
             server_supervisor.stop()  # before terminate: no resurrect-mid-reap
@@ -1050,6 +1105,7 @@ def _probe_env_via_server(flags, address, timeout_s: float = 60.0):
 
 
 def main(flags):
+    _configure_logging()
     if flags.mode == "test":
         # Greedy checkpoint evaluation — shared with the mono driver. (The
         # reference's poly test() is a NotImplementedError,

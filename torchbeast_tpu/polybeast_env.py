@@ -14,14 +14,20 @@ import multiprocessing as mp
 import threading
 import time
 
-logging.basicConfig(
-    format=(
-        "[%(levelname)s:%(process)d %(module)s:%(lineno)d %(asctime)s] "
-        "%(message)s"
-    ),
-    level=logging.INFO,
-)
 log = logging.getLogger("torchbeast_tpu.polybeast_env")
+
+
+def _configure_logging():
+    """Called from main(), NOT at import: importing this module (as the
+    learner driver and every test does) must not mutate global logging
+    state."""
+    logging.basicConfig(
+        format=(
+            "[%(levelname)s:%(process)d %(module)s:%(lineno)d "
+            "%(asctime)s] %(message)s"
+        ),
+        level=logging.INFO,
+    )
 
 
 def make_parser():
@@ -76,7 +82,12 @@ def host_scoped_basename(pipes_basename: str, process_id: int,
 
 def _serve(env_name: str, address: str, native: bool = False,
            seed_base=None):
-    # Child process body. Import here: workers must never inherit JAX state.
+    # Child process body. Spawn-context children re-import this module
+    # but never run main(), so the child configures its own logging
+    # (INFO lines like "EnvServer listening" would otherwise be lost
+    # now that import no longer calls basicConfig).
+    _configure_logging()
+    # Import here: workers must never inherit JAX state.
     from torchbeast_tpu.envs import create_env
 
     if seed_base is None:
@@ -243,6 +254,7 @@ class ServerSupervisor:
 
 
 def main(flags):
+    _configure_logging()
     # SIGTERM must run the finally below: Python's default handler kills
     # the process without atexit/finally, orphaning the daemonic server
     # children (ppid 1, still serving their ports) — exactly what

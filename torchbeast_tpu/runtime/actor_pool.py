@@ -31,6 +31,7 @@ from typing import Any, List
 import numpy as np
 
 from torchbeast_tpu import nest
+from torchbeast_tpu import telemetry
 from torchbeast_tpu.runtime import wire
 from torchbeast_tpu.runtime.env_server import parse_address
 from torchbeast_tpu.runtime.queues import (
@@ -89,6 +90,26 @@ class ActorPool:
         self._reconnects = 0
         self._count_lock = threading.Lock()
         self._errors: List[BaseException] = []
+        # Per-connection wire accounting + request RTT (ISSUE 2).
+        # "up" = env-server -> this process (observations rising toward
+        # the learner), "down" = actions back out — the same direction
+        # convention as polybeast's per-step acting-path gauges.
+        reg = telemetry.get_registry()
+        self._tm_bytes_up = reg.counter("wire.bytes_up")
+        self._tm_bytes_down = reg.counter("wire.bytes_down")
+        self._tm_rtt = reg.histogram("actor.request_rtt_s")
+        self._tm_steps = reg.counter("actor.env_steps")
+        self._tm_connects = reg.counter("actor.connects")
+        self._tracer = telemetry.get_tracer()
+        # Sampled per-request pipeline traces: one in _TRACE_EVERY
+        # computes rides a StageTrace through the batcher (enqueue ->
+        # batch -> reply), bounding trace overhead on the hot path.
+        self._trace_tick = 0
+        # The C++ batcher's compute() has no trace parameter; only the
+        # Python DynamicBatcher threads StageTraces through.
+        self._traceable = isinstance(inference_batcher, DynamicBatcher)
+
+    _TRACE_EVERY = 256
 
     def count(self) -> int:
         """Total env steps taken (reference actorpool.cc:478,557)."""
@@ -221,10 +242,16 @@ class ActorPool:
             k: np.asarray(msg[k])[None, None] for k in _ENV_KEYS
         }
 
+    def _recv_step(self, sock):
+        msg, nbytes = wire.recv_message_sized(sock)
+        self._tm_bytes_up.inc(nbytes)
+        return self._env_outputs(msg)
+
     def _loop(self, index: int, address: str, progress=None):
         progress = progress if progress is not None else [0]
         table = self._state_table
         sock = self._connect(address)
+        self._tm_connects.inc()
         try:
             if table is not None:
                 # Fresh stream => fresh recurrent state. This also covers
@@ -234,7 +261,7 @@ class ActorPool:
                 initial_agent_state = table.initial_state_host
             else:
                 initial_agent_state = self._initial_agent_state
-            env_outputs = self._env_outputs(wire.recv_message(sock))
+            env_outputs = self._recv_step(sock)
             agent_state = self._initial_agent_state
             agent_outputs, agent_state = self._compute(
                 index, env_outputs, agent_state, advance=False
@@ -245,11 +272,12 @@ class ActorPool:
                     index, env_outputs, agent_state, advance=True
                 )
                 action = int(np.asarray(agent_outputs["action"]).reshape(()))
-                wire.send_message(
+                self._tm_bytes_down.inc(wire.send_message(
                     sock, {"type": "action", "action": action}
-                )
-                env_outputs = self._env_outputs(wire.recv_message(sock))
+                ))
+                env_outputs = self._recv_step(sock)
                 progress[0] += 1
+                self._tm_steps.inc()
                 with self._count_lock:
                     self._count += 1
                 rollout.append((env_outputs, agent_outputs))
@@ -267,20 +295,38 @@ class ActorPool:
         finally:
             sock.close()
 
+    def _request(self, inputs, index: int):
+        """One batcher round-trip with RTT telemetry and a sampled
+        per-request StageTrace (enqueue -> batch -> reply)."""
+        trace = None
+        if self._traceable:
+            # Racy tick is fine: sampling cadence, not an exact count.
+            self._trace_tick += 1
+            if self._trace_tick % self._TRACE_EVERY == 0:
+                trace = self._tracer.stage("actor.request", actor=index)
+        t0 = time.perf_counter()
+        if trace is not None:
+            outputs = self._inference_batcher.compute(inputs, trace=trace)
+        else:
+            outputs = self._inference_batcher.compute(inputs)
+        self._tm_rtt.observe(time.perf_counter() - t0)
+        return outputs
+
     def _compute(self, index: int, env_outputs, agent_state, advance: bool):
         if self._state_table is not None:
             # [1, 1]-shaped ids so queue batching along batch_dim=1
             # concatenates them like every other leaf.
-            outputs = self._inference_batcher.compute(
+            outputs = self._request(
                 {
                     "env": env_outputs,
                     "slot": np.full((1, 1), index, np.int32),
                     "advance": np.full((1, 1), advance, bool),
-                }
+                },
+                index,
             )
             return outputs["outputs"], agent_state
-        outputs = self._inference_batcher.compute(
-            {"env": env_outputs, "agent_state": agent_state}
+        outputs = self._request(
+            {"env": env_outputs, "agent_state": agent_state}, index
         )
         new_state = outputs["agent_state"]
         agent_outputs = outputs["outputs"]

@@ -19,10 +19,12 @@ import logging
 import os
 import socket
 import threading
+import time
 from typing import Callable
 
 import numpy as np
 
+from torchbeast_tpu import telemetry
 from torchbeast_tpu.envs.environment import Environment
 from torchbeast_tpu.runtime import wire
 
@@ -54,6 +56,14 @@ class EnvServer:
         self._conns = []
         self._conns_lock = threading.Lock()
         self._running = False
+        # NB: env servers usually run as separate processes, so these
+        # land in each server's OWN process registry (the learner-side
+        # mirror lives in ActorPool's wire.bytes_* counters).
+        reg = telemetry.get_registry()
+        self._tm_conns = reg.gauge("env_server.connections")
+        self._tm_bytes_in = reg.counter("env_server.bytes_in")
+        self._tm_bytes_out = reg.counter("env_server.bytes_out")
+        self._tm_step_s = reg.histogram("env_server.env_step_s")
 
     def run(self):
         """Bind and serve until stop() (reference Server.run blocks too,
@@ -138,15 +148,22 @@ class EnvServer:
 
             initial = _step_to_message(env.initial())
             initial["num_actions"] = num_actions_of(raw_env)
-            wire.send_message(conn, initial)
+            with self._conns_lock:
+                self._tm_conns.set(len(self._conns))
+            self._tm_bytes_out.inc(wire.send_message(conn, initial))
             while True:
-                msg = wire.recv_message(conn)
+                msg, nbytes = wire.recv_message_sized(conn)
                 if msg is None:
                     break  # client hung up
+                self._tm_bytes_in.inc(nbytes)
                 if msg.get("type") != "action":
                     raise wire.WireError(f"Expected action, got {msg!r}")
+                t0 = time.perf_counter()
                 step = env.step(int(msg["action"]))
-                wire.send_message(conn, _step_to_message(step))
+                self._tm_step_s.observe(time.perf_counter() - t0)
+                self._tm_bytes_out.inc(
+                    wire.send_message(conn, _step_to_message(step))
+                )
         except (wire.WireError, ConnectionError, BrokenPipeError) as e:
             log.debug("Stream ended: %s", e)
         except Exception as e:  # env raised: report to client, drop stream
@@ -163,6 +180,7 @@ class EnvServer:
             with self._conns_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+                self._tm_conns.set(len(self._conns))
 
 
 def serve_once(env_init: Callable, address: str):

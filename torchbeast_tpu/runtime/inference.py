@@ -24,11 +24,13 @@ Two state regimes:
 
 import logging
 import threading
+import time
 from typing import Any, Callable, List
 
 import numpy as np
 
 from torchbeast_tpu import nest
+from torchbeast_tpu import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -159,8 +161,33 @@ def inference_loop(
     """
     buckets = default_buckets(max_batch_size)
 
+    # Stage attribution for the serving loop (ISSUE 2): batch-size
+    # distribution, lock contention, dispatch latency (async — the time
+    # to hand XLA the program, not device compute), reply latency (the
+    # device fetch + row slicing actors actually wait on). Instruments
+    # resolve once; per-batch cost is a few perf_counter calls.
+    _reg = telemetry.get_registry()
+    _tracer = telemetry.get_tracer()
+    _h_batch = _reg.histogram("inference.batch_size")
+    # Registered only when a lock exists: a permanently-zero histogram
+    # reads as "requests never wait", not "not measured".
+    _h_lock = (
+        _reg.histogram("inference.lock_wait_s") if lock is not None
+        else None
+    )
+    _h_dispatch = _reg.histogram("inference.dispatch_s")
+    _h_reply = _reg.histogram("inference.reply_s")
+    _c_batches = _reg.counter("inference.batches")
+    _c_rows = _reg.counter("inference.rows")
+    # A Python DynamicBatcher with a telemetry_name already observes
+    # inference.batch_size per dequeued batch — observing here too
+    # would double-count it. The loop keeps that role only for
+    # un-instrumented batchers (the C++ native runtime).
+    _observe_sizes = getattr(inference_batcher, "_tm", None) is None
+
     def flush(entry):
         batch, outputs, new_state, n = entry
+        t_reply = time.perf_counter()
         try:
             if state_table is not None:
                 # Device-side slice + one explicit device_get; the
@@ -180,6 +207,8 @@ def inference_loop(
         except Exception as e:  # noqa: BLE001
             log.exception("Inference reply failed; continuing")
             batch.fail(e)
+        finally:
+            _h_reply.observe(time.perf_counter() - t_reply)
 
     pending = None
     for batch in inference_batcher:
@@ -187,27 +216,53 @@ def inference_loop(
             inputs = batch.get_inputs()
             env_outputs = inputs["env"]
             n = len(batch)
+            if _observe_sizes:
+                _h_batch.observe(n)
+            _c_batches.inc()
+            _c_rows.inc(n)
             padded = bucket_size(n, buckets)
             env_padded = pad_to(env_outputs, padded, batch_dim)
+
+            def dispatch(fn):
+                # inference.dispatch_s times ONLY the act dispatch (the
+                # host handing XLA the program) — padding is host prep
+                # and the lock wait has its own histogram; folding them
+                # in would double-count stages and misattribute a lock
+                # bottleneck to XLA.
+                t0 = time.perf_counter()
+                with _tracer.span(
+                    "inference.dispatch", cat="inference",
+                    rows=n, padded=padded,
+                ):
+                    result = fn()
+                _h_dispatch.observe(time.perf_counter() - t0)
+                return result
+
             if state_table is not None:
                 slots = pad_slots(
                     inputs["slot"], padded, state_table.trash_slot
                 )
                 advance = pad_advance(inputs["advance"], padded)
-                outputs = state_table.step(slots, advance, env_padded)
+                outputs = dispatch(
+                    lambda: state_table.step(slots, advance, env_padded)
+                )
                 new_state = None
             else:
                 state_padded = pad_to(
                     inputs["agent_state"], padded, batch_dim
                 )
                 if lock is not None:
+                    t_lock = time.perf_counter()
                     with lock:
-                        outputs, new_state = act_fn(
-                            env_padded, state_padded, padded
+                        _h_lock.observe(time.perf_counter() - t_lock)
+                        outputs, new_state = dispatch(
+                            lambda: act_fn(
+                                env_padded, state_padded, padded
+                            )
                         )
                 else:
-                    outputs, new_state = act_fn(
-                        env_padded, state_padded, padded
+                    outputs, new_state = dispatch(
+                        lambda: act_fn(env_padded, state_padded, padded)
                     )
         except Exception as e:  # noqa: BLE001
             batch.fail(e)

@@ -31,6 +31,25 @@ from typing import Any, Callable, Iterable, List, Optional, Tuple
 import numpy as np
 
 from torchbeast_tpu import nest
+from torchbeast_tpu import telemetry
+
+
+class _QueueTelemetry:
+    """Instrument bundle for a named queue/batcher (telemetry_name=None
+    keeps the queue un-instrumented — a single None check per op).
+    request_wait_s is NOT here: only the DynamicBatcher's compute()
+    side can observe it, and a plain BatchingQueue registering it would
+    export a permanently-zero histogram that reads as "requests never
+    wait" instead of "not measured"."""
+
+    __slots__ = ("depth", "items_in", "dequeue_wait_s", "batch_size")
+
+    def __init__(self, name: str):
+        reg = telemetry.get_registry()
+        self.depth = reg.gauge(f"{name}.depth")
+        self.items_in = reg.counter(f"{name}.items_in")
+        self.dequeue_wait_s = reg.histogram(f"{name}.dequeue_wait_s")
+        self.batch_size = reg.histogram(f"{name}.batch_size")
 
 
 class ClosedBatchingQueue(RuntimeError):
@@ -61,6 +80,7 @@ class BatchingQueue:
         timeout_ms: Optional[float] = None,
         maximum_queue_size: Optional[int] = None,
         check_inputs: bool = True,
+        telemetry_name: Optional[str] = None,
     ):
         if minimum_batch_size < 1:
             raise ValueError("Min batch size must be >= 1")
@@ -83,6 +103,12 @@ class BatchingQueue:
             maximum_queue_size if maximum_queue_size is not None else float("inf")
         )
         self._check_inputs = check_inputs
+        # Queue depth/occupancy + batch-size/wait-time series under
+        # `{telemetry_name}.*` (ISSUE 2: attribute stalls to queue wait
+        # vs. batch wait). None = no instruments, no overhead.
+        self._tm = (
+            _QueueTelemetry(telemetry_name) if telemetry_name else None
+        )
 
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -131,6 +157,9 @@ class BatchingQueue:
                     )
             self._deque.append((inputs, payload, rows))
             self._num_enqueued += 1
+            if self._tm is not None:
+                self._tm.items_in.inc()
+                self._tm.depth.set(len(self._deque))
             self._not_empty.notify()
 
     def close(self):
@@ -153,6 +182,7 @@ class BatchingQueue:
         timeout); return (batched nest, payloads). Up to
         maximum_batch_size rows are concatenated; the first item is always
         taken so an oversized single item can't deadlock the queue."""
+        t_wait = time.perf_counter() if self._tm is not None else 0.0
         with self._not_empty:
             # The timeout bounds how long we hold out for a FULL minimum
             # batch; an empty queue always blocks (there is nothing to
@@ -182,6 +212,12 @@ class BatchingQueue:
                 item = self._deque.popleft()
                 rows += item[2]
                 items.append(item)
+            if self._tm is not None:
+                self._tm.depth.set(len(self._deque))
+                self._tm.dequeue_wait_s.observe(
+                    time.perf_counter() - t_wait
+                )
+                self._tm.batch_size.observe(rows)
             self._not_full.notify_all()
         inputs = [it[0] for it in items]
         payloads = [it[1] for it in items]
@@ -211,12 +247,18 @@ class Batch:
     """One pending inference batch: inputs + the promises awaiting rows."""
 
     def __init__(self, batch_dim: int, inputs: Any, promises: List[_Promise],
-                 sizes: List[int]):
+                 sizes: List[int], traces: Optional[List] = None):
         self._batch_dim = batch_dim
         self._inputs = inputs
         self._promises = promises
         self._sizes = sizes
+        self._traces = traces or []
         self._outputs_set = False
+
+    def _finish_traces(self, stage: str):
+        for trace in self._traces:
+            trace.stamp(stage)
+            trace.finish()
 
     def __len__(self):
         return sum(self._sizes)
@@ -253,6 +295,7 @@ class Batch:
             )
             promise.event.set()
             offset += size
+        self._finish_traces("reply")
 
     def fail(self, error: BaseException):
         """Break every waiting promise with `error` (used by consumers
@@ -266,6 +309,7 @@ class Batch:
                 f"Inference failed: {type(error).__name__}: {error}"
             )
             promise.event.set()
+        self._finish_traces("failed")
 
     def __del__(self):
         if not self._outputs_set:
@@ -274,6 +318,7 @@ class Batch:
                     "Batch died before outputs were set"
                 )
                 promise.event.set()
+            self._finish_traces("dropped")
 
 
 class DevicePrefetcher:
@@ -306,11 +351,19 @@ class DevicePrefetcher:
         source: Iterable,
         place_fn: Callable[[Any], Any],
         depth: int = 2,
+        telemetry_name: Optional[str] = None,
     ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._source = source
         self._place = place_fn
+        # Staging-time series: place_fn (device_put / shard placement)
+        # dispatch latency + staged-buffer occupancy.
+        self._tm_stage = self._tm_depth = None
+        if telemetry_name:
+            reg = telemetry.get_registry()
+            self._tm_stage = reg.histogram(f"{telemetry_name}.stage_s")
+            self._tm_depth = reg.gauge(f"{telemetry_name}.depth")
         self._q = stdlib_queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self.error: Optional[BaseException] = None
@@ -327,13 +380,20 @@ class DevicePrefetcher:
 
         try:
             for item in self._source:
-                staged = self._place(item)
+                if self._tm_stage is not None:
+                    t0 = time.perf_counter()
+                    staged = self._place(item)
+                    self._tm_stage.observe(time.perf_counter() - t0)
+                else:
+                    staged = self._place(item)
                 while not self._stop.is_set():
                     try:
                         self._q.put(staged, timeout=1.0)
                         break
                     except stdlib_queue.Full:
                         continue
+                if self._tm_depth is not None:
+                    self._tm_depth.set(self._q.qsize())
                 if self._stop.is_set():
                     return
         except StopIteration:
@@ -347,7 +407,10 @@ class DevicePrefetcher:
     def get(self, timeout: Optional[float] = None):
         """One staged item; raises queue.Empty on timeout (the caller
         loops, checking is_alive() to detect exhaustion)."""
-        return self._q.get(timeout=timeout)
+        item = self._q.get(timeout=timeout)
+        if self._tm_depth is not None:
+            self._tm_depth.set(self._q.qsize())
+        return item
 
     def is_alive(self) -> bool:
         return self._thread.is_alive()
@@ -380,6 +443,7 @@ class DynamicBatcher:
         maximum_batch_size: Optional[int] = None,
         timeout_ms: Optional[float] = None,
         check_outputs: bool = True,
+        telemetry_name: Optional[str] = None,
     ):
         self._batch_dim = batch_dim
         self._queue = BatchingQueue(
@@ -387,6 +451,16 @@ class DynamicBatcher:
             minimum_batch_size=minimum_batch_size,
             maximum_batch_size=maximum_batch_size,
             timeout_ms=timeout_ms,
+            telemetry_name=telemetry_name,
+        )
+        # The inner queue owns depth/batch-size; the batcher adds the
+        # producer-side time-in-queue series ({name}.request_wait_s).
+        self._tm = self._queue._tm
+        self._tm_request_wait = (
+            telemetry.get_registry().histogram(
+                f"{telemetry_name}.request_wait_s"
+            )
+            if telemetry_name else None
         )
         self._check_outputs = check_outputs
         self._compute_timeout_s = 600  # reference: 10-min future timeout
@@ -410,7 +484,8 @@ class DynamicBatcher:
             q._deque.clear()
             q._not_empty.notify_all()
             q._not_full.notify_all()
-        for promise, _ in pending:
+        for payload in pending:
+            promise = payload[0]
             promise.error = AsyncError("Batcher closed with pending requests")
             promise.event.set()
         return leftover
@@ -418,8 +493,14 @@ class DynamicBatcher:
     def is_closed(self) -> bool:
         return self._queue.is_closed()
 
-    def compute(self, inputs: Any) -> Any:
-        """Blocking request/response: returns this caller's output rows."""
+    def compute(self, inputs: Any, trace=None) -> Any:
+        """Blocking request/response: returns this caller's output rows.
+
+        `trace` (an optional telemetry StageTrace) rides the payload
+        through the pipeline: stamped "enqueue" here, "batch" when the
+        consumer picks the request up, "reply"/"failed" when its rows
+        come back — per-request stage attribution for sampled traffic.
+        """
         size = np.asarray(nest.front(inputs)).shape[self._batch_dim]
         if size > self._queue._max:
             raise ValueError(
@@ -427,7 +508,10 @@ class DynamicBatcher:
                 f"than maximum_batch_size={self._queue._max}"
             )
         promise = _Promise()
-        self._queue.enqueue(inputs, (promise, size))
+        t_enq = time.perf_counter() if self._tm is not None else 0.0
+        if trace is not None:
+            trace.stamp("enqueue")
+        self._queue.enqueue(inputs, (promise, size, t_enq, trace))
         if not promise.event.wait(timeout=self._compute_timeout_s):
             raise TimeoutError(
                 "Compute response not ready after 10 minutes"
@@ -441,6 +525,15 @@ class DynamicBatcher:
 
     def __next__(self) -> Batch:
         batch_inputs, payloads = self._queue.dequeue_many()
-        promises = [p for p, _ in payloads]
-        sizes = [s for _, s in payloads]
-        return Batch(self._batch_dim, batch_inputs, promises, sizes)
+        promises = [p[0] for p in payloads]
+        sizes = [p[1] for p in payloads]
+        traces = [p[3] for p in payloads if p[3] is not None]
+        if self._tm_request_wait is not None:
+            now = time.perf_counter()
+            for p in payloads:
+                self._tm_request_wait.observe(now - p[2])
+        for trace in traces:
+            trace.stamp("batch")
+        return Batch(
+            self._batch_dim, batch_inputs, promises, sizes, traces=traces
+        )

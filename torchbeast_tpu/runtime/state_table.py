@@ -41,6 +41,7 @@ Layout/contract notes:
 """
 
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -48,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchbeast_tpu import nest
+from torchbeast_tpu import telemetry
 
 
 def _leaves(tree) -> bool:
@@ -89,6 +91,13 @@ class DeviceStateTable:
         self._context_fn = context_fn
         self._input_filter = input_filter
         self._lock = threading.Lock()
+        # Pure-host telemetry (perf_counter + dict increments only):
+        # adds no device syncs to the acting hot path — pinned by the
+        # transfer-guard test in tests/test_telemetry.py.
+        _reg = telemetry.get_registry()
+        self._tm_dispatches = _reg.counter("state_table.dispatches")
+        self._tm_fetch_s = _reg.histogram("state_table.fetch_s")
+        self._tm_read_slot_s = _reg.histogram("state_table.read_slot_s")
 
         bd = batch_dim
         for leaf in jax.tree_util.tree_leaves(initial_state):
@@ -203,6 +212,7 @@ class DeviceStateTable:
             self._table, outputs = self._step_jit(
                 table, slots_d, advance_d, ctx, env_d
             )
+        self._tm_dispatches.inc()
         return outputs
 
     def fetch(self, outputs: Any, n: int) -> Any:
@@ -214,6 +224,7 @@ class DeviceStateTable:
         and the padding overhead fetched here is only the small
         action/logits/baseline rows, not agent state. Transfer-guard-
         clean: the device_get is explicit, the slice is numpy."""
+        t0 = time.perf_counter()
         host = jax.device_get(outputs)
         bd = self.batch_dim
 
@@ -222,17 +233,22 @@ class DeviceStateTable:
             sl[bd] = slice(0, n)
             return arr[tuple(sl)]
 
-        return jax.tree_util.tree_map(cut, host)
+        out = jax.tree_util.tree_map(cut, host)
+        self._tm_fetch_s.observe(time.perf_counter() - t0)
+        return out
 
     def read_slot(self, slot: int) -> Any:
         """Host copy of one slot's state, shaped like `initial_state`
         (size 1 along batch_dim) — the rollout-boundary
         `initial_agent_state` fetch, once per unroll per actor."""
+        t0 = time.perf_counter()
         ids = self._put_ids([slot])
         with self._lock:
             self._require_alive()
             piece = self._gather_jit(self._table, ids)
-        return jax.device_get(piece)
+        out = jax.device_get(piece)
+        self._tm_read_slot_s.observe(time.perf_counter() - t0)
+        return out
 
     def reset(self, slots) -> None:
         """Reset `slots` to the initial state (actor connect/reconnect)."""

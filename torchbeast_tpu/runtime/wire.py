@@ -209,20 +209,31 @@ def decode(payload: bytes) -> Any:
     return value
 
 
-def send_message(sock: socket.socket, value: Any) -> None:
-    sock.sendall(encode(value))
+def send_message(sock: socket.socket, value: Any) -> int:
+    """Send one framed message; returns the framed byte count (header
+    included) so callers can feed wire-byte telemetry counters."""
+    frame = encode(value)
+    sock.sendall(frame)
+    return len(frame)
 
 
 def recv_message(sock: socket.socket) -> Optional[Any]:
     """Read one framed message; None on clean EOF at a frame boundary."""
+    return recv_message_sized(sock)[0]
+
+
+def recv_message_sized(sock: socket.socket):
+    """(value, framed byte count) — (None, 0) on clean EOF. The sized
+    variant exists for per-connection byte accounting (telemetry
+    wire.bytes_* counters) without re-encoding the message."""
     header = _recv_exact(sock, 4)
     if header is None:
-        return None
+        return None, 0
     (length,) = struct.unpack("<I", header)
     payload = _recv_exact(sock, length)
     if payload is None:
         raise WireError("Connection closed mid-frame")
-    return decode(payload)
+    return decode(payload), 4 + length
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

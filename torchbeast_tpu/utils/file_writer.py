@@ -98,6 +98,10 @@ class FileWriter:
             "logs": os.path.join(self.basepath, "logs.csv"),
             "fields": os.path.join(self.basepath, "fields.csv"),
             "meta": os.path.join(self.basepath, "meta.json"),
+            # JSON-lines telemetry snapshots (torchbeast_tpu.telemetry):
+            # the drivers point a JsonLinesExporter here so metrics land
+            # next to logs.csv under the same xpid dir.
+            "telemetry": os.path.join(self.basepath, "telemetry.jsonl"),
         }
 
         self._logger = logging.getLogger(f"filewriter.{xpid}")
@@ -193,3 +197,12 @@ class FileWriter:
         self.metadata["date_end"] = datetime.datetime.now().isoformat()
         self.metadata["successful"] = successful
         self._save_metadata()
+        # Detach and close the out.log FileHandler: the logger object
+        # outlives this writer (logging keeps loggers in a global
+        # registry keyed by name), so leaving the handler attached leaks
+        # one open fd per FileWriter lifetime — long test sessions and
+        # multi-writer runs accumulate them (and a same-xpid successor's
+        # handler guard would see stale handlers and never attach).
+        for handler in list(self._logger.handlers):
+            self._logger.removeHandler(handler)
+            handler.close()

@@ -1,49 +1,44 @@
-"""Online per-section timing profiler.
+"""Online per-section timing profiler — a thin shim over telemetry
+histograms (ISSUE 2).
 
-Same capability as the reference's Timings (/root/reference/torchbeast/core/
-prof.py:32-81) — O(1) running statistics per named section of the driver
-loop, printable summary with ms +/- std and % share — but implemented as
-plain moment accumulators (count, sum, sum of squares) rather than an
-incremental mean/variance recurrence. Sections here are short wall-clock
-spans (ms scale), so the naive sumsq formula has no precision trouble.
+Same API and split-timer semantics as before (each `time(name)`
+attributes the span since the previous mark to `name`, like lap times
+on a stopwatch; `means`/`stds`/`summary` report exact running moments),
+but each section is now a telemetry.metrics.Histogram: the moments are
+tracked exactly (count/sum/sumsq per-thread shards), and the SAME
+instruments additionally expose p50/p95/p99, land in telemetry
+snapshots, and merge across threads.
+
+By default every Timings owns a PRIVATE registry, so tests and
+--no_telemetry runs behave exactly as the old class did. Drivers pass
+`registry=telemetry.get_registry(), prefix="learner."` so their stage
+latencies ("dequeue", "learn", "collect") become `learner.dequeue`
+etc. in the exported snapshot — the stage-latency (p50/p95) series the
+acceptance criteria name.
 """
 
 import timeit
-from typing import Dict
+from typing import Dict, Optional
 
-
-class _Moments:
-    __slots__ = ("count", "total", "total_sq")
-
-    def __init__(self):
-        self.count = 0
-        self.total = 0.0
-        self.total_sq = 0.0
-
-    def add(self, sample: float) -> None:
-        self.count += 1
-        self.total += sample
-        self.total_sq += sample * sample
-
-    @property
-    def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
-
-    @property
-    def variance(self) -> float:
-        if not self.count:
-            return 0.0
-        m = self.mean
-        # E[x^2] - E[x]^2, clamped: float cancellation can dip epsilon-negative.
-        return max(self.total_sq / self.count - m * m, 0.0)
+from torchbeast_tpu.telemetry.metrics import Histogram, MetricsRegistry
 
 
 class Timings:
-    """Split-timer: each `time(name)` attributes the span since the previous
-    mark to `name`, like lap times on a stopwatch."""
+    """Split-timer over telemetry histograms."""
 
-    def __init__(self):
-        self._sections: Dict[str, _Moments] = {}
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        prefix: str = "",
+    ):
+        self._registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self._prefix = prefix
+        # name -> histogram, insertion-ordered; list(dict.items()) is a
+        # single C call, so monitor threads can read while the timed
+        # thread inserts a new section.
+        self._sections: Dict[str, Histogram] = {}
         self.reset()
 
     def reset(self):
@@ -55,20 +50,21 @@ class Timings:
         now = timeit.default_timer()
         section = self._sections.get(name)
         if section is None:
-            section = self._sections[name] = _Moments()
-        section.add(now - self._mark)
+            section = self._sections[name] = self._registry.histogram(
+                self._prefix + name
+            )
+        section.observe(now - self._mark)
         self._mark = now
 
+    def histogram(self, name: str) -> Optional[Histogram]:
+        """The backing histogram of a section (percentile access)."""
+        return self._sections.get(name)
+
     def means(self) -> Dict[str, float]:
-        # list(...) snapshots atomically (single C call): monitor threads
-        # read while the timed thread may be inserting a new section.
-        return {name: s.mean for name, s in list(self._sections.items())}
+        return {name: h.mean for name, h in list(self._sections.items())}
 
     def stds(self) -> Dict[str, float]:
-        return {
-            name: s.variance**0.5
-            for name, s in list(self._sections.items())
-        }
+        return {name: h.std for name, h in list(self._sections.items())}
 
     def summary(self, prefix: str = "") -> str:
         means = self.means()
