@@ -1,0 +1,451 @@
+"""Wire transport microbenchmarks: legacy copy-heavy encode+send vs the
+scatter-gather path, over TCP loopback and over shared-memory rings.
+
+Measures, per payload size (small obs / Atari 84x84x4 / raw-Atari
+210x160x3 step messages, 0-d-array scalars exactly like
+env_server._step_to_message):
+
+- encode:       pure encode throughput, encode_legacy() vs encode_into()
+- encode_send:  sustained one-way msgs/s + GB/s + per-send p50/p99, a
+                subprocess running the SAME ERA's full receive path on
+                the other end (each leg is its transport stack end to
+                end — the receiver's copies are part of the path cost):
+                  legacy_tcp: encode_legacy + sendall over 127.0.0.1,
+                              drained by chunk-list recv + alloc decode
+                              (the pre-overhaul stack, verbatim)
+                  sg_tcp:     send_message(SendBuffer) -> sendmsg iovecs,
+                              drained by RecvBuffer recv_into + zero-copy
+                              decode
+                  sg_shm:     ShmTransport (in-place ring write + 1B
+                              doorbell), drained by ring view decode
+- rtt:          full round-trip (step down, action back) through the
+                real transport objects, SocketTransport vs ShmTransport
+
+Sender and drain processes are pinned to different cores when the host
+allows it (the 2-core sandbox otherwise migrates them onto each other).
+
+The acceptance gates from ISSUE 3 are evaluated into `acceptance`:
+sg_shm >= 2x legacy_tcp msgs/s on the Atari-sized payload, and shm >=
+tcp-loopback throughput at the same payload. The JSON verdict line is
+also written to benchmarks/artifacts/wire_bench.json with the process
+telemetry block (wire.encode_s / wire.decode_s histograms) embedded.
+
+Run:  python benchmarks/wire_bench.py [--seconds 2] [--selftest]
+No jax import anywhere: the drain/echo processes are forked, which must
+stay safe.
+"""
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from torchbeast_tpu import telemetry  # noqa: E402
+from torchbeast_tpu.runtime import transport  # noqa: E402
+from torchbeast_tpu.runtime import wire  # noqa: E402
+
+_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "artifacts",
+    "wire_bench.json",
+)
+
+PAYLOADS = {
+    "small": (8, 8, 1),
+    "atari": (84, 84, 4),
+    "atari_raw": (210, 160, 3),
+}
+
+
+def step_msg(frame_shape):
+    """A step message shaped exactly like env_server._step_to_message
+    (0-d arrays, not python scalars, so dtypes survive the wire)."""
+    rng = np.random.default_rng(0)
+    return {
+        "type": "step",
+        "frame": rng.integers(0, 255, frame_shape, np.uint8),
+        "reward": np.asarray(np.float32(0.5)),
+        "done": np.asarray(False),
+        "episode_step": np.asarray(np.int32(3)),
+        "episode_return": np.asarray(np.float32(1.0)),
+        "last_action": np.asarray(np.int32(0)),
+    }
+
+
+ACTION_MSG = {"type": "action", "action": 1}
+
+
+def _set_affinity(cpus):
+    """Pin this process to `cpus`; returns the previous mask (or None if
+    pinning is unavailable / the host has a single core)."""
+    try:
+        previous = os.sched_getaffinity(0)
+        if len(previous) >= 2:
+            os.sched_setaffinity(0, cpus)
+            return previous
+    except (AttributeError, OSError):
+        pass
+    return None
+
+
+def _restore_affinity(previous):
+    if previous:
+        try:
+            os.sched_setaffinity(0, previous)
+        except OSError:
+            pass
+
+
+def _fork(child_fn, close_in_child=()):
+    """Fork; run child_fn() in the child (never returns). The child
+    first closes inherited parent-side fds — a socketpair end held open
+    in the child would swallow the parent's EOF forever — and pins
+    itself off the sender's core."""
+    pid = os.fork()
+    if pid == 0:
+        try:
+            _set_affinity({1})
+            for s in close_in_child:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            child_fn()
+        finally:
+            os._exit(0)
+    return pid
+
+
+def _percentiles(lat_us):
+    lat = np.sort(np.asarray(lat_us))
+    return (
+        float(lat[int(0.5 * (len(lat) - 1))]),
+        float(lat[int(0.99 * (len(lat) - 1))]),
+    )
+
+
+def _window(fn, seconds, min_iters, lat):
+    deadline = time.perf_counter() + seconds
+    t_start = time.perf_counter()
+    n = 0
+    while n < min_iters or time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        fn()
+        lat.append((time.perf_counter() - t0) * 1e6)
+        n += 1
+    return n / (time.perf_counter() - t_start), n
+
+
+def _timed_loop(fn, seconds, min_iters=200, repeats=3):
+    """Run `repeats` measurement windows of ~seconds/repeats each and
+    report the BEST window's throughput (the sandbox shares 2 cores with
+    a supervisor process whose bursts stall whole windows; the best
+    window is the least-contended estimate of the code's cost) plus
+    pooled p50/p99 latency. Returns (msgs_per_s, p50_us, p99_us, iters)."""
+    lat = []
+    best = 0.0
+    total = 0
+    for _ in range(repeats):
+        rate, n = _window(fn, seconds / repeats, min_iters, lat)
+        best = max(best, rate)
+        total += n
+    p50, p99 = _percentiles(lat)
+    return best, p50, p99, total
+
+
+def _timed_loops_interleaved(fns, seconds, min_iters=100, repeats=8):
+    """Measure several legs round-robin — window(leg A), window(leg B),
+    ..., repeated — so every leg samples the same noise environment.
+    Cross-leg ratios from sequential measurement on this 2-core shared
+    sandbox are dominated by WHEN each leg ran; interleaving plus
+    best-window makes them comparable. Returns per-leg
+    (msgs_per_s, p50_us, p99_us, iters)."""
+    lat = [[] for _ in fns]
+    best = [0.0] * len(fns)
+    total = [0] * len(fns)
+    window = seconds / repeats
+    for _ in range(repeats):
+        for i, fn in enumerate(fns):
+            rate, n = _window(fn, window, min_iters, lat[i])
+            best[i] = max(best[i], rate)
+            total[i] += n
+    out = []
+    for i in range(len(fns)):
+        p50, p99 = _percentiles(lat[i])
+        out.append((best[i], p50, p99, total[i]))
+    return out
+
+
+def bench_encode(msg, seconds):
+    buf = wire.SendBuffer()
+    legacy, _, _, _ = _timed_loop(lambda: wire.encode_legacy(msg), seconds)
+    sg, _, _, _ = _timed_loop(lambda: wire.encode_into(msg, buf), seconds)
+    return {"legacy_msgs_s": legacy, "sg_msgs_s": sg,
+            "speedup": sg / legacy}
+
+
+def _tcp_pair(recv_buffered):
+    """(sender socket, drain child pid) over TCP loopback; the child
+    runs the full receive path of its era — recv_buffered=False is the
+    pre-overhaul stack (per-frame chunk allocations + join + decode),
+    True is the RecvBuffer zero-copy path."""
+    listener = socket.socket()
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+
+    def child():
+        conn, _ = listener.accept()
+        listener.close()
+        buf = wire.RecvBuffer() if recv_buffered else None
+        while True:
+            try:
+                value, _ = wire.recv_message_sized(conn, buf=buf)
+            except (wire.WireError, OSError):
+                return
+            if value is None:
+                return
+
+    pid = _fork(child)
+    sender = socket.create_connection(listener.getsockname())
+    sender.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    listener.close()
+    return sender, pid
+
+
+def bench_send_legs(msg, seconds):
+    """Sender-side throughput for every transport leg of one payload,
+    measured in interleaved windows (all legs' connections live for the
+    whole measurement; each has its own forked drain process consuming
+    the other end)."""
+    frame_bytes = len(wire.encode_legacy(msg))
+
+    legacy_sock, legacy_pid = _tcp_pair(recv_buffered=False)
+    legacy_fn = lambda: legacy_sock.sendall(wire.encode_legacy(msg))  # noqa: E731
+
+    sg_sock, sg_pid = _tcp_pair(recv_buffered=True)
+    sg_buf = wire.SendBuffer()
+    sg_fn = lambda: wire.send_message(sg_sock, msg, buf=sg_buf)  # noqa: E731
+
+    srv, cli = transport.shm_pipe()
+
+    def shm_child():
+        # The real ShmTransport receive loop: doorbell, zero-copy ring
+        # view decode, release-at-next-recv.
+        while True:
+            try:
+                value, _ = srv.recv_sized()
+            except (wire.WireError, OSError):
+                return
+            if value is None:
+                return
+
+    shm_pid = _fork(
+        shm_child, close_in_child=(cli._sock, legacy_sock, sg_sock)
+    )
+    srv._sock.close()
+    shm_fn = lambda: cli.send(msg)  # noqa: E731
+
+    legs = [("legacy_tcp", legacy_fn), ("sg_tcp", sg_fn),
+            ("sg_shm", shm_fn)]
+    previous = _set_affinity({0})
+    try:
+        for _, fn in legs:
+            for _ in range(100):
+                fn()
+        measured = _timed_loops_interleaved(
+            [fn for _, fn in legs], seconds * len(legs)
+        )
+    finally:
+        _restore_affinity(previous)
+
+    legacy_sock.close()
+    sg_sock.close()
+    cli._sock.close()
+    for pid in (legacy_pid, sg_pid, shm_pid):
+        os.waitpid(pid, 0)
+    cli.close()
+    srv.close()
+
+    rows = []
+    for (leg, _), (msgs_s, p50, p99, n) in zip(legs, measured):
+        rows.append({
+            "leg": leg,
+            "frame_bytes": frame_bytes,
+            "msgs_s": msgs_s,
+            "gb_s": msgs_s * frame_bytes / 1e9,
+            "p50_us": p50,
+            "p99_us": p99,
+            "iters": n,
+        })
+    return rows
+
+
+def bench_rtt_leg(msg, kind, seconds):
+    """Full round trip through the real transport objects: the child
+    plays env server (sends the step payload), the parent plays actor
+    (replies with an action) — one RTT per env step, like production."""
+    if kind == "tcp":
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+
+        def child():
+            conn, _ = listener.accept()
+            listener.close()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = transport.SocketTransport(conn)
+            t.send(msg)
+            while True:
+                value, _ = t.recv_sized()
+                if value is None:
+                    return
+                t.send(msg)
+
+        pid = _fork(child)
+        sock = socket.create_connection(listener.getsockname())
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        listener.close()
+        client = transport.SocketTransport(sock)
+    elif kind == "shm":
+        srv, cli = transport.shm_pipe()
+
+        def child():
+            srv.send(msg)
+            while True:
+                value, _ = srv.recv_sized()
+                if value is None:
+                    return
+                srv.send(msg)
+
+        pid = _fork(child, close_in_child=(cli._sock,))
+        client = cli
+    else:
+        raise ValueError(kind)
+
+    client.recv_sized()  # initial step
+
+    def round_trip():
+        client.send(ACTION_MSG)
+        value, _ = client.recv_sized()
+        assert value is not None
+
+    for _ in range(50):
+        round_trip()
+    msgs_s, p50, p99, n = _timed_loop(round_trip, seconds)
+    if kind == "shm":
+        client._sock.close()
+        os.waitpid(pid, 0)
+        client.close()
+        srv.close()
+    else:
+        client.close()
+        os.waitpid(pid, 0)
+    return {
+        "transport": kind,
+        "msgs_s": msgs_s,
+        "p50_us": p50,
+        "p99_us": p99,
+        "iters": n,
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seconds", type=float, default=2.0,
+                        help="Measurement window per leg.")
+    parser.add_argument("--selftest", action="store_true",
+                        help="Fast structural run (tiny windows; skips "
+                             "the speedup acceptance gates, which are "
+                             "meaningless at low iteration counts).")
+    parser.add_argument("--out", default=_ARTIFACT,
+                        help="Artifact path ('' disables the write).")
+    flags = parser.parse_args(argv)
+    if flags.selftest:
+        flags.seconds = 0.05
+
+    snap_before = telemetry.snapshot()
+    results = {"encode": [], "encode_send": [], "rtt": []}
+    for name, shape in PAYLOADS.items():
+        msg = step_msg(shape)
+        enc = bench_encode(msg, flags.seconds / 2)
+        enc["payload"] = name
+        results["encode"].append(enc)
+        rows = bench_send_legs(msg, flags.seconds)
+        legacy_msgs_s = rows[0]["msgs_s"]
+        for row in rows:
+            row["payload"] = name
+            row["speedup_vs_legacy"] = row["msgs_s"] / legacy_msgs_s
+            results["encode_send"].append(row)
+        for kind in ("tcp", "shm"):
+            row = bench_rtt_leg(msg, kind, flags.seconds)
+            row["payload"] = name
+            results["rtt"].append(row)
+
+    def send_row(payload, leg):
+        return next(
+            r for r in results["encode_send"]
+            if r["payload"] == payload and r["leg"] == leg
+        )
+
+    def rtt_row(payload, kind):
+        return next(
+            r for r in results["rtt"]
+            if r["payload"] == payload and r["transport"] == kind
+        )
+
+    atari_speedup = send_row("atari", "sg_shm")["speedup_vs_legacy"]
+    shm_vs_tcp_send = (
+        send_row("atari", "sg_shm")["msgs_s"]
+        / send_row("atari", "sg_tcp")["msgs_s"]
+    )
+    shm_vs_tcp_rtt = (
+        rtt_row("atari", "shm")["msgs_s"] / rtt_row("atari", "tcp")["msgs_s"]
+    )
+    acceptance = {
+        "atari_encode_send_speedup": atari_speedup,
+        "atari_shm_over_tcp_send": shm_vs_tcp_send,
+        "atari_shm_over_tcp_rtt": shm_vs_tcp_rtt,
+    }
+    failures = []
+    if not flags.selftest:
+        if atari_speedup < 2.0:
+            failures.append(
+                f"sg_shm encode+send speedup {atari_speedup:.2f}x < 2x"
+            )
+        if shm_vs_tcp_send < 1.0:
+            failures.append(
+                f"shm send throughput below tcp ({shm_vs_tcp_send:.2f}x)"
+            )
+
+    out = {
+        "bench": "wire_bench",
+        "selftest": bool(flags.selftest),
+        "seconds_per_leg": flags.seconds,
+        "payload_shapes": {k: list(v) for k, v in PAYLOADS.items()},
+        "results": results,
+        "acceptance": acceptance,
+        "ok": not failures,
+        "failures": failures,
+        "telemetry": telemetry.telemetry_block(prev=snap_before),
+    }
+    if flags.out:
+        os.makedirs(os.path.dirname(flags.out), exist_ok=True)
+        with open(flags.out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,10 @@ enum class DType : uint8_t {
   kU32 = 9,
   kU64 = 10,
   kF16 = 11,
+  // bfloat16 (truncated f32; TPU-native activations). No host math ever
+  // touches the payload here — the runtime only moves bytes — so no
+  // bf16 arithmetic support is needed, just the itemsize.
+  kBF16 = 12,
 };
 
 inline size_t itemsize(DType dtype) {
@@ -42,6 +46,7 @@ inline size_t itemsize(DType dtype) {
     case DType::kU16:
     case DType::kI16:
     case DType::kF16:
+    case DType::kBF16:
       return 2;
     case DType::kI32:
     case DType::kU32:

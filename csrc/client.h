@@ -88,6 +88,9 @@ class FramedSocket {
     uint32_t length = 0;
     for (int i = 0; i < 4; ++i)
       length |= static_cast<uint32_t>(header[i]) << (8 * i);
+    if (length > wire::kMaxFrameBytes)
+      throw wire::WireError("wire: frame length " + std::to_string(length) +
+                            " exceeds kMaxFrameBytes");
     auto payload = std::make_shared<std::vector<uint8_t>>(length);
     recv_exact(payload->data(), length);
     return wire::decode(payload->data(), length, payload);

@@ -32,6 +32,12 @@ class WireError : public std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
+// Upper bound on a frame's payload length. A corrupt 4-byte header must
+// surface as WireError before anyone allocates what it claims (a flipped
+// bit can demand 4 GiB otherwise). Matches wire.py's
+// DEFAULT_MAX_FRAME_BYTES; enforced at the frame readers (client.h).
+constexpr size_t kMaxFrameBytes = 256ull * 1024 * 1024;
+
 // A decoded wire value: arrays, strings, ints... The runtime only needs
 // arrays + strings + ints for Step/Action messages, so the leaf is a small
 // tagged struct rather than a full dynamic type.

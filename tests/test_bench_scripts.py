@@ -127,6 +127,53 @@ def test_telemetry_selftest_cli():
     assert out["selftest"] == "telemetry" and out["ok"] is True
 
 
+def test_wire_bench_selftest(tmp_path):
+    """wire_bench --selftest: structural run of every (payload, leg)
+    combination with the artifact schema pinned, so the bench can't rot
+    between measurement rounds."""
+    out_json = tmp_path / "wire_bench.json"
+    proc = _run([
+        "benchmarks/wire_bench.py", "--selftest", "--out", str(out_json),
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "wire_bench"
+    assert out["ok"] is True and out["failures"] == []
+    assert out["selftest"] is True
+
+    legs = {
+        (r["payload"], r["leg"]) for r in out["results"]["encode_send"]
+    }
+    assert legs == {
+        (p, leg)
+        for p in ("small", "atari", "atari_raw")
+        for leg in ("legacy_tcp", "sg_tcp", "sg_shm")
+    }
+    for row in out["results"]["encode_send"]:
+        assert row["msgs_s"] > 0 and row["frame_bytes"] > 0
+        assert row["p99_us"] >= row["p50_us"] > 0
+    rtts = {(r["payload"], r["transport"]) for r in out["results"]["rtt"]}
+    assert rtts == {
+        (p, k) for p in ("small", "atari", "atari_raw")
+        for k in ("tcp", "shm")
+    }
+    for key in ("atari_encode_send_speedup", "atari_shm_over_tcp_send",
+                "atari_shm_over_tcp_rtt"):
+        assert out["acceptance"][key] > 0
+
+    # Telemetry block embedded like inference_bench, with the new wire
+    # codec histograms populated (encode from the send legs, decode from
+    # the RTT legs' client side).
+    _validate_telemetry_block(out["telemetry"])
+    hists = out["telemetry"]["snapshot"]["histograms"]
+    assert hists["wire.encode_s"]["count"] > 0
+    assert hists["wire.decode_s"]["count"] > 0
+
+    # The artifact file carries the same verdict.
+    saved = json.loads(out_json.read_text())
+    assert saved["bench"] == "wire_bench" and saved["ok"] is True
+
+
 def test_vtrace_bench_emits_rows(tmp_path):
     out_md = tmp_path / "vtrace.md"
     proc = _run([

@@ -1,0 +1,671 @@
+"""Shared-memory transport (ISSUE 3): ring data plane, doorbell flow
+control, the shm:// address scheme through EnvServer/ActorPool, and the
+crash-recovery contract (killing an env-server process mid-ring tears
+down one connection and revives it — the same contract
+tests/test_env_server.py pins for sockets)."""
+
+import multiprocessing as mp
+import os
+import socket
+import struct
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu.envs import CountingEnv
+from torchbeast_tpu.runtime import transport, wire
+from torchbeast_tpu.runtime.actor_pool import ActorPool
+from torchbeast_tpu.runtime.env_server import EnvServer
+from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
+
+EPISODE_LEN = 5
+T = 3
+
+
+# ---------------------------------------------------------------------------
+# Address scheme
+
+
+def test_parse_address_shm():
+    fam, path = transport.parse_address("shm:/tmp/x.0")
+    assert fam == socket.AF_UNIX and path == "/tmp/x.0"
+    fam, path = transport.parse_address("shm:///tmp/y")
+    assert fam == socket.AF_UNIX and path == "/tmp/y"
+    assert transport.is_shm_address("shm:/tmp/x")
+    assert not transport.is_shm_address("unix:/tmp/x")
+    assert not transport.is_shm_address("127.0.0.1:4444")
+
+
+def test_server_address_suffixes_shm():
+    from torchbeast_tpu.polybeast_env import host_scoped_basename, server_address
+
+    assert server_address("shm:/tmp/tbt", 2) == "shm:/tmp/tbt.2"
+    assert host_scoped_basename("shm:/tmp/tbt", 1, 4) == "shm:/tmp/tbt-h1"
+
+
+# ---------------------------------------------------------------------------
+# Ring data plane (shm_pipe harness)
+
+
+def fuzz_pipe(server, client, rng, rounds=60):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_wire import assert_nest_equal, random_nest
+
+    for _ in range(rounds):
+        value = random_nest(rng)
+        expected = len(wire.encode_legacy(value))
+        result = {}
+
+        def echo():
+            got, nbytes = server.recv_sized()
+            result["nbytes"] = nbytes
+            server.send(got if not isinstance(got, np.ndarray) else got.copy())
+
+        t = threading.Thread(target=echo)
+        t.start()
+        sent = client.send(value)
+        back, _ = client.recv_sized()
+        t.join()
+        assert sent == expected
+        assert result["nbytes"] == expected
+        assert_nest_equal(value, _deep_copy(back))
+
+
+def _deep_copy(value):
+    if isinstance(value, np.ndarray):
+        return value.copy()
+    if isinstance(value, list):
+        return [_deep_copy(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _deep_copy(v) for k, v in value.items()}
+    return value
+
+
+def test_shm_pipe_fuzz_roundtrip():
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=1 << 16, act_ring_bytes=1 << 16
+    )
+    try:
+        fuzz_pipe(server, client, np.random.default_rng(23))
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_ring_wraparound():
+    """Many variable-size frames through a tiny ring force every wrap
+    case (marker wrap, <4-byte implicit wrap, exact fit)."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=4096, act_ring_bytes=4096
+    )
+    rng = np.random.default_rng(5)
+    try:
+        done = []
+
+        def echo(n):
+            for _ in range(n):
+                got, _ = server.recv_sized()
+                server.send({"n": got["n"], "arr": got["arr"].copy()})
+            done.append(True)
+
+        N = 300
+        t = threading.Thread(target=echo, args=(N,))
+        t.start()
+        for i in range(N):
+            n = int(rng.integers(0, 900))
+            client.send({"n": n, "arr": np.full(n, i % 250, np.uint8)})
+            back, _ = client.recv_sized()
+            assert back["n"] == n and back["arr"].shape == (n,)
+        t.join()
+        assert done
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_oversized_frame_rides_inline():
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=8192, act_ring_bytes=8192
+    )
+    big = np.arange(1 << 16, dtype=np.uint8)  # 64 KiB >> 8 KiB rings
+    try:
+        result = {}
+
+        def echo():
+            got, nbytes = server.recv_sized()
+            result["nbytes"] = nbytes
+            server.send({"ok": True})
+
+        t = threading.Thread(target=echo)
+        t.start()
+        sent = client.send({"x": big})
+        back, _ = client.recv_sized()
+        t.join()
+        assert back["ok"] is True
+        assert sent == result["nbytes"] == len(wire.encode_legacy({"x": big}))
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_frame_lifetime_rule():
+    """Ring frames are released at the next recv: a decoded view from
+    frame 1 is overwritten once a later frame wraps into its ring space
+    (pins the consume-before-next-recv contract)."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=4096, act_ring_bytes=4096
+    )
+    first = second = third = None
+    try:
+        # ~1520B frames in a 4096B ring: two fit; the third wraps into
+        # the first's slot once the first's space has been released.
+        server.send(np.full(1500, 1, np.uint8))
+        first, _ = client.recv_sized()
+        assert int(first[0]) == 1
+        with pytest.raises((ValueError, TypeError)):
+            first[0] = 9  # read-only view into the ring
+
+        server.send(np.full(1500, 2, np.uint8))
+        second, _ = client.recv_sized()  # entry releases frame 1's space
+        server.send(np.full(1500, 3, np.uint8))  # wraps into that space
+        third, _ = client.recv_sized()
+        assert int(second[0]) == 2 and int(third[0]) == 3
+        # THE CONTRACT: the stale view now shows frame 3's bytes.
+        assert int(first[0]) == 3
+    finally:
+        first = second = third = None  # drop ring views before close
+        server.close()
+        client.close()
+
+
+def test_shm_bad_doorbell_byte_raises():
+    server, client = transport.shm_pipe()
+    try:
+        client._sock.sendall(b"\x7f")
+        with pytest.raises(wire.WireError, match="doorbell"):
+            server.recv_sized()
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_corrupt_ring_length_raises_wire_error():
+    """A bit-flipped frame length in the ring must surface as WireError
+    (the teardown exception), never struct.error/ValueError."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=8192, act_ring_bytes=8192
+    )
+    try:
+        client.send({"a": np.arange(64, dtype=np.uint8)})
+        # Corrupt the just-written frame's u32 length prefix in place.
+        ring = server._recv_ring
+        struct.pack_into("<I", ring._data, 0, 0xFFFF0000)
+        with pytest.raises(wire.WireError):
+            server.recv_sized()
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_corrupt_payload_raises_wire_error():
+    """Bit flips inside the payload (structural bytes) must also fail as
+    WireError via decode's malformed-frame trap."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=8192, act_ring_bytes=8192
+    )
+    try:
+        client.send({"a": np.arange(64, dtype=np.uint8)})
+        ring = server._recv_ring
+        ring._data[8] = 0xFF  # inside the payload: smash a tag byte
+        with pytest.raises(wire.WireError):
+            server.recv_sized()
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_truncated_by_peer_death_raises_or_eofs():
+    """Peer death between doorbell and consumption: the socket cut must
+    surface as clean EOF (None) or ConnectionError/WireError — never a
+    hang or an unrelated exception type."""
+    server, client = transport.shm_pipe()
+    client.send({"x": 1})
+    client._sock.close()  # peer dies with a frame still in the ring
+    got, nbytes = server.recv_sized()  # doorbell already queued: delivered
+    assert got == {"x": 1}
+    assert server.recv_sized() == (None, 0)  # then clean EOF
+    server.close()
+    client.close()
+
+
+def test_shm_max_frame_bytes_enforced():
+    server, client = transport.shm_pipe(max_frame_bytes=4096)
+    try:
+        client.send({"a": np.zeros(8192, np.uint8)})
+        with pytest.raises(wire.WireError, match="max_frame_bytes"):
+            server.recv_sized()
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_half_capacity_frames_route_inline_and_stay_ordered():
+    """Frames above capacity/2 are position-dependently unplaceable in
+    the ring (wrap skip + frame can exceed total capacity), so the
+    transport must route them inline — and mixed ring/inline traffic
+    must arrive in order (the in-ring marker is the ordering slot)."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=8192, act_ring_bytes=8192
+    )
+    assert server._send_ring.max_frame_bytes() == 8192 // 2 - 4
+    sizes = [100, 5000, 200, 6000, 5000, 50]  # 5000/6000 > 4092: inline
+    try:
+        def producer():
+            for i, n in enumerate(sizes):
+                server.send({"i": i, "arr": np.full(n, i, np.uint8)})
+
+        t = threading.Thread(target=producer)
+        t.start()
+        for i, n in enumerate(sizes):
+            got, nbytes = client.recv_sized()
+            assert got["i"] == i and got["arr"].shape == (n,)
+            assert nbytes == len(wire.encode_legacy(
+                {"i": i, "arr": np.full(n, i, np.uint8)}
+            ))
+        t.join()
+    finally:
+        server.close()
+        client.close()
+
+
+def test_shm_inline_byte_on_blocked_reader_recovers():
+    """THE lost-wakeup race on the oversized path: the sender can read a
+    stale waiting=0, skip the WAKE byte, and land the 0x02 inline byte
+    directly on a blocked reader. The reader must deliver the message
+    via the (by-then-visible) ring marker, not tear the stream down."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=8192, act_ring_bytes=8192
+    )
+    big = np.arange(6000, dtype=np.uint8)
+    result = {}
+    try:
+        reader = threading.Thread(
+            target=lambda: result.update(got=client.recv_sized())
+        )
+        reader.start()
+        deadline = time.monotonic() + 5
+        while not client._recv_ring.reader_waiting():  # reader blocked
+            if time.monotonic() > deadline:
+                raise TimeoutError("reader never blocked")
+            time.sleep(0.001)
+        # Emulate the racy sender verbatim, minus the WAKE byte.
+        views, total = wire.encode_into({"x": big}, wire.SendBuffer())
+        server._send_ring.write_inline_marker()
+        server._sock.sendall(b"\x02")
+        wire._sendmsg_all(server._sock, views, total)
+        reader.join(5)
+        assert not reader.is_alive()
+        got, nbytes = result["got"]
+        np.testing.assert_array_equal(got["x"].copy(), big)
+        assert nbytes == total
+    finally:
+        server.close()
+        client.close()
+
+
+def test_transport_recv_timeout_bounds_silent_server():
+    """connect_transport(recv_timeout_s=...): a server that accepts but
+    never sends must surface as socket.timeout/OSError, not a hang (the
+    env-spec probe's fallback path depends on it)."""
+    path = os.path.join(tempfile.mkdtemp(), "silent")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(1)
+    accepted = []
+    t = threading.Thread(target=lambda: accepted.append(listener.accept()))
+    t.start()
+    stream = transport.connect_transport(
+        f"unix:{path}", timeout_s=5, recv_timeout_s=0.2
+    )
+    t.join(5)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        stream.recv()
+    assert time.monotonic() - t0 < 3
+    stream.close()
+    for conn, _ in accepted:
+        conn.close()
+    listener.close()
+
+
+def test_shm_recv_timeout_bounds_silent_server():
+    """Same bound through the shm transport's waiting loop."""
+    server, client = transport.shm_pipe()
+    client._recv_timeout_s = 0.2
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        client.recv_sized()
+    assert time.monotonic() - t0 < 3
+    server.close()
+    client.close()
+
+
+def test_shm_blocked_writer_fails_fast_on_peer_death():
+    """Crash-detection parity with sockets for a ring-blocked WRITER: a
+    sender stuck waiting for ring space must notice the peer's death via
+    the doorbell-socket probe within ~ms, not after the 120s ring-wait
+    timeout (the old behavior pinned threads for 2 minutes per stream)."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=4096, act_ring_bytes=4096
+    )
+    errs = []
+
+    def pump():
+        msg = {"x": np.zeros(1200, np.uint8)}
+        try:
+            for _ in range(50):  # ring fills after ~3 frames (no reader)
+                server.send(msg)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    time.sleep(0.3)  # the writer is now blocked in the ring wait
+    t0 = time.monotonic()
+    client._sock.close()  # peer dies
+    t.join(10)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 5
+    assert errs and isinstance(errs[0], ConnectionError)
+    server.close()
+    client.close()
+
+
+def test_shm_inline_path_honors_recv_timeout():
+    """recv_timeout_s must bound the INLINE receive path too: a peer
+    that sends the inline marker + 0x02 byte but stalls before the
+    payload surfaces as a timeout, not a hang (the spec probe's
+    contract is 'bounds every receive')."""
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=8192, act_ring_bytes=8192
+    )
+    client._recv_timeout_s = 0.2
+    server._send_ring.write_inline_marker()
+    server._sock.sendall(b"\x02")  # ...but never the frame bytes
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        client.recv_sized()
+    assert time.monotonic() - t0 < 3
+    server.close()
+    client.close()
+
+
+def test_shm_ring_full_times_out_as_wire_error():
+    """A stalled reader must surface as WireError after the write
+    timeout, not a silent hang."""
+    ring = transport.ShmRing.create(256)
+    try:
+        view = memoryview(bytes(200))
+        ring.write_frame([view], 200)
+        with pytest.raises(wire.WireError, match="full"):
+            ring.write_frame([view], 200, timeout_s=0.2)
+    finally:
+        ring.close()
+
+
+# ---------------------------------------------------------------------------
+# EnvServer + ActorPool over shm://
+
+
+def _start_counting_server(path, **kwargs):
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), f"shm:{path}",
+        **kwargs,
+    )
+    server.start()
+    deadline = time.monotonic() + 10
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError("server did not bind")
+        time.sleep(0.01)
+    return server
+
+
+@pytest.fixture
+def shm_server_address():
+    path = os.path.join(tempfile.mkdtemp(), "shm_env")
+    server = _start_counting_server(path)
+    yield f"shm:{path}"
+    server.stop()
+
+
+def test_shm_stream_protocol(shm_server_address):
+    stream = transport.connect_transport(shm_server_address, timeout_s=10)
+    try:
+        step = stream.recv()
+        assert step["type"] == "step"
+        assert bool(step["done"])  # initial boundary step
+        assert np.asarray(step["reward"]).dtype == np.float32
+        assert step["num_actions"] == 2  # spec advertisement works on shm
+
+        for t in range(1, EPISODE_LEN + 1):
+            stream.send({"type": "action", "action": 1})
+            step = stream.recv()
+            assert int(step["episode_step"]) == t
+        assert bool(step["done"])
+        assert float(step["episode_return"]) == sum(
+            range(1, EPISODE_LEN + 1)
+        )
+    finally:
+        step = None  # lifetime rule: drop ring views before close
+        stream.close()
+
+
+def test_shm_fresh_env_per_connection(shm_server_address):
+    for _ in range(2):
+        stream = transport.connect_transport(shm_server_address, timeout_s=10)
+        step = stream.recv()
+        assert int(step["episode_step"]) == 0
+        step = None  # lifetime rule: drop ring views before close
+        stream.close()
+
+
+def test_shm_env_exception_surfaces():
+    class ExplodingEnv:
+        num_actions = 2
+
+        def reset(self):
+            return np.zeros((2, 2), np.uint8)
+
+        def step(self, action):
+            raise RuntimeError("boom")
+
+    path = os.path.join(tempfile.mkdtemp(), "shm_exploding")
+    server = EnvServer(ExplodingEnv, f"shm:{path}")
+    server.start()
+    deadline = time.monotonic() + 10
+    while not os.path.exists(path):
+        time.sleep(0.01)
+        if time.monotonic() > deadline:
+            raise TimeoutError
+    try:
+        stream = transport.connect_transport(f"shm:{path}", timeout_s=10)
+        stream.recv()  # initial step
+        stream.send({"type": "action", "action": 0})
+        msg = stream.recv()
+        assert msg["type"] == "error" and "boom" in msg["message"]
+        msg = None  # lifetime rule: drop ring views before close
+        stream.close()
+    finally:
+        server.stop()
+
+
+def _run_pool(address, num_rollouts=6, max_reconnects=0):
+    class CountingPolicyServer:
+        def __call__(self, env_outputs, agent_state, batch_size):
+            done = np.asarray(env_outputs["done"])  # [1, B]
+            state = np.where(done, 0, np.asarray(agent_state)) + 1
+            outputs = {
+                "action": np.zeros_like(done, dtype=np.int32),
+                "policy_logits": state[..., None].astype(np.float32),
+                "baseline": state.astype(np.float32),
+            }
+            return outputs, state
+
+    learner_queue = BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = DynamicBatcher(batch_dim=1, timeout_ms=20)
+    inf_thread = threading.Thread(
+        target=inference_loop, args=(batcher, CountingPolicyServer(), 8),
+        daemon=True,
+    )
+    inf_thread.start()
+    pool = ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+        max_reconnects=max_reconnects,
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+    return learner_queue, batcher, pool, pool_thread
+
+
+def test_shm_actor_pool_invariants(shm_server_address):
+    """The full async stack over shm must preserve the same rollout
+    invariants the socket transport pins (overlap-by-one, boundary
+    resets, action pairing) — and the per-step copies mean nothing
+    aliases the ring by the time batches reach the learner queue."""
+    learner_queue, batcher, pool, pool_thread = _run_pool(shm_server_address)
+    items = []
+    for item in learner_queue:
+        items.append(item)
+        if len(items) >= 6:
+            break
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    assert pool.errors == []
+    prev = None
+    for item in items:
+        batch = item["batch"]
+        assert batch["frame"].shape[:2] == (T + 1, 1)
+        assert batch["frame"].flags["OWNDATA"] or batch[
+            "frame"
+        ].base is not None  # stacked copies, not ring views
+        if prev is not None:
+            for key in batch:
+                np.testing.assert_array_equal(
+                    batch[key][0], prev[key][-1], err_msg=key
+                )
+        assert (batch["frame"][batch["done"]] == 0).all()
+        np.testing.assert_array_equal(
+            batch["action"][1:], batch["last_action"][1:]
+        )
+        prev = batch
+
+
+# ---------------------------------------------------------------------------
+# Crash recovery: env-server PROCESS killed mid-ring
+
+
+def _serve_counting_shm(path):
+    """Child-process body (spawn-safe: module-level, imports inside)."""
+    from torchbeast_tpu.envs import CountingEnv
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    EnvServer(lambda: CountingEnv(episode_length=5), f"shm:{path}").run()
+
+
+def _spawn_server_proc(path):
+    ctx = mp.get_context("spawn")
+    proc = ctx.Process(target=_serve_counting_shm, args=(path,), daemon=True)
+    proc.start()
+    deadline = time.monotonic() + 30
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError("spawned server did not bind")
+        time.sleep(0.05)
+    return proc
+
+
+@pytest.mark.slow
+def test_shm_actor_revives_after_server_process_kill():
+    """THE crash contract: SIGKILL an env-server process mid-ring; the
+    actor must tear down that one connection (ring + doorbell) and
+    revive it against the restarted server, same as the socket pool."""
+    path = os.path.join(tempfile.mkdtemp(), "shm_crash")
+    proc = _spawn_server_proc(path)
+    learner_queue, batcher, pool, pool_thread = _run_pool(
+        f"shm:{path}", max_reconnects=3
+    )
+    try:
+        it = iter(learner_queue)
+        next(it)  # at least one rollout through the first connection
+
+        proc.kill()  # SIGKILL: no cleanup, ring abandoned mid-stream
+        proc.join(10)
+        os.unlink(path)  # dead server's socket file lingers
+        proc = _spawn_server_proc(path)
+
+        for _ in range(3):
+            next(it)
+        assert pool.errors == []
+        assert pool.reconnects >= 1
+    finally:
+        batcher.close()
+        learner_queue.close()
+        pool_thread.join(5)
+        proc.kill()
+        proc.join(10)
+
+
+def test_shm_server_stop_severs_streams():
+    """stop() on a shm server must cut live doorbells so clients see a
+    transport failure immediately (reconnect budget path), and must
+    remove the doorbell socket."""
+    path = os.path.join(tempfile.mkdtemp(), "shm_stop")
+    server = _start_counting_server(path)
+    stream = transport.connect_transport(f"shm:{path}", timeout_s=10)
+    stream.recv()
+    server.stop()
+    with pytest.raises((wire.WireError, ConnectionError, OSError)):
+        for _ in range(10):  # EOF may take one in-flight step to surface
+            stream.send({"type": "action", "action": 0})
+            msg = stream.recv()
+            if msg is None:
+                raise ConnectionError("clean EOF")
+    stream.close()
+    assert not os.path.exists(path)
+
+
+def test_shm_handshake_garbage_raises():
+    """A server that speaks the plain protocol on a socket the client
+    believes is shm must fail the handshake as WireError, not decode
+    garbage."""
+    path = os.path.join(tempfile.mkdtemp(), "not_shm")
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.bind(path)
+    sock.listen(1)
+
+    def fake_server():
+        conn, _ = sock.accept()
+        wire.send_message(conn, {"type": "step", "frame": np.zeros(3)})
+        conn.close()
+
+    t = threading.Thread(target=fake_server)
+    t.start()
+    with pytest.raises(wire.WireError, match="handshake"):
+        transport.connect_transport(f"shm:{path}", timeout_s=5)
+    t.join()
+    sock.close()

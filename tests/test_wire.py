@@ -1,13 +1,66 @@
 """Wire protocol roundtrips, including the reference's non-contiguous-array
 regression (tests/contiguous_arrays_test.py: transposed arrays must survive
-the wire intact)."""
+the wire intact) — plus the zero-copy transport contracts (ISSUE 3): the
+scatter-gather encoder is pinned byte-identical to the legacy encoder, the
+RecvBuffer receive path is allocation-free at steady state, corrupt frames
+of every flavor surface as WireError, and oversized frames are rejected
+before allocation."""
 
+import socket
 import struct
+import threading
+import tracemalloc
 
 import numpy as np
 import pytest
 
 from torchbeast_tpu.runtime import wire
+
+
+def random_nest(rng, depth=0):
+    """Shared fuzz generator: random structures/dtypes/shapes over every
+    supported dtype (including bf16 when ml_dtypes is present)."""
+    dtypes = sorted(wire._DTYPE_CODES, key=str)
+    kind = rng.integers(0, 9 if depth < 3 else 6)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return bool(rng.integers(0, 2))
+    if kind == 2:
+        return int(rng.integers(-(2 ** 40), 2 ** 40))
+    if kind == 3:
+        return float(rng.random() * 1e6 - 5e5)
+    if kind == 4:
+        return "".join(chr(rng.integers(32, 1000)) for _ in range(8))
+    if kind == 5:
+        # Shapes up to ~2k elements so some arrays cross the
+        # scatter-gather threshold (>= _GATHER_MIN_BYTES) and some don't.
+        shape = tuple(rng.integers(0, 14, size=rng.integers(0, 4)))
+        dt = dtypes[rng.integers(0, len(dtypes))]
+        return np.asarray((rng.random(shape) * 100).astype(dt))
+    if kind == 6:
+        return [random_nest(rng, depth + 1) for _ in range(rng.integers(0, 4))]
+    return {
+        f"k{i}": random_nest(rng, depth + 1)
+        for i in range(rng.integers(0, 4))
+    }
+
+
+def assert_nest_equal(a, b):
+    if isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    elif isinstance(a, list):
+        assert isinstance(b, list) and len(a) == len(b)
+        for x, y in zip(a, b):
+            assert_nest_equal(x, y)
+    elif isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            assert_nest_equal(a[k], b[k])
+    else:
+        # type-exact: bool must not come back as int, int not as float
+        assert type(a) is type(b) and a == b
 
 
 def roundtrip(value):
@@ -184,3 +237,345 @@ def test_decoded_arrays_are_views():
     framed = wire.encode(arr)
     out = wire.decode(framed[4:])
     assert not out.flags["OWNDATA"]
+
+
+# ---------------------------------------------------------------------------
+# Scatter-gather encode (format pin + iovec semantics)
+
+
+def test_encode_matches_legacy_fuzz():
+    """FORMAT PIN: the scatter-gather encoder must be byte-identical to
+    the legacy BytesIO encoder on arbitrary nests (csrc/wire.h interop
+    depends on it), and the iovec list must concatenate to the same
+    frame with the advertised total."""
+    rng = np.random.default_rng(7)
+    buf = wire.SendBuffer()
+    for _ in range(300):
+        value = random_nest(rng)
+        legacy = wire.encode_legacy(value)
+        assert wire.encode(value) == legacy
+        views, total = wire.encode_into(value, buf)
+        assert b"".join(views) == legacy
+        assert total == len(legacy)
+
+
+def test_encode_matches_legacy_numpy_scalars():
+    # np scalars ride the slow isinstance chain; semantics must not drift
+    # from the legacy encoder (np.bool_ -> BOOL, np.int32 -> INT, ...).
+    for value in [np.bool_(True), np.int32(-5), np.int64(9), np.uint8(7),
+                  np.float32(1.5), np.float64(2.5), (1, 2, "x"),
+                  {"k": np.float16(0.5)}]:
+        assert wire.encode(value) == wire.encode_legacy(value)
+
+
+def test_encode_into_gathers_large_arrays_zero_copy():
+    """Arrays >= the gather threshold must ride their own iovec aliasing
+    the source numpy buffer (no copy); small arrays land in scratch."""
+    big = np.arange(4096, dtype=np.uint8)
+    small = np.arange(16, dtype=np.uint8)
+    buf = wire.SendBuffer()
+    views, total = wire.encode_into({"big": big, "small": small}, buf)
+    gathered = [
+        v for v in views
+        if v.nbytes == big.nbytes and v.obj is not buf.scratch
+    ]
+    assert len(gathered) == 1
+    # Mutating the source array mutates the iovec: proof there is no copy
+    # (and why the no-mutation-until-sent lifetime rule exists).
+    big[0] = 123
+    assert gathered[0][0] == 123
+
+
+def test_send_message_scatter_gather_roundtrip():
+    """send_message(buf=SendBuffer) <-> recv_message_sized(buf=RecvBuffer)
+    over a real socket, message sizes varying both directions so both
+    buffers grow and shrink usage across messages."""
+    rng = np.random.default_rng(11)
+    a, b = socket.socketpair()
+    send_buf, recv_buf = wire.SendBuffer(), wire.RecvBuffer(initial_bytes=64)
+    try:
+        sizes = [10, 5000, 3, 80000, 200, 12000, 0]
+        for n in sizes:
+            value = {"arr": np.arange(n, dtype=np.int32), "n": n}
+            sender = threading.Thread(
+                target=wire.send_message, args=(a, value),
+                kwargs={"buf": send_buf},
+            )
+            sender.start()
+            out, nbytes = wire.recv_message_sized(b, buf=recv_buf)
+            sender.join()
+            assert nbytes == len(wire.encode_legacy(value))
+            assert out["n"] == n
+            np.testing.assert_array_equal(
+                np.asarray(out["arr"]).copy(), np.arange(n, dtype=np.int32)
+            )
+    finally:
+        a.close()
+        b.close()
+
+
+def test_sendmsg_all_handles_partial_sends():
+    """_sendmsg_all must reassemble correctly when the kernel accepts
+    arbitrary prefixes (forced with a fake socket capping bytes/call)."""
+
+    class ThrottledSock:
+        def __init__(self, cap):
+            self.cap = cap
+            self.sent = bytearray()
+
+        def sendmsg(self, views):
+            budget = self.cap
+            for v in views:
+                take = min(len(v), budget)
+                self.sent += bytes(v[:take])
+                budget -= take
+                if not budget:
+                    break
+            return self.cap - budget
+
+        def sendall(self, data):  # IOV_MAX fallback
+            self.sent += bytes(data)
+
+    rng = np.random.default_rng(3)
+    value = {"a": np.arange(5000, dtype=np.int64), "b": "tail",
+             "c": np.arange(2000, dtype=np.uint8)}
+    frame = wire.encode_legacy(value)
+    for cap in (1, 7, 1000, 4096, 1 << 20):
+        sock = ThrottledSock(cap)
+        views, total = wire.encode_into(value, wire.SendBuffer())
+        wire._sendmsg_all(sock, views, total)
+        assert bytes(sock.sent) == frame, f"cap={cap}"
+
+
+def test_sendmsg_iov_max_fallback_roundtrip():
+    # > _IOV_MAX gathered arrays: the joined-sendall fallback must still
+    # produce one well-formed frame.
+    value = [np.full(1024, i % 250, np.uint8) for i in range(600)]
+    a, b = socket.socketpair()
+    try:
+        result = {}
+        recv = threading.Thread(
+            target=lambda: result.update(out=wire.recv_message(b))
+        )
+        recv.start()
+        wire.send_message(a, value, buf=wire.SendBuffer())
+        recv.join()
+        out = result["out"]
+        assert len(out) == 600
+        np.testing.assert_array_equal(out[599], value[599])
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Frame length bound (--max_frame_bytes)
+
+
+def _send_raw_header(sock, length):
+    sock.sendall(struct.pack("<I", length))
+
+
+@pytest.mark.parametrize("use_recv_buffer", [False, True])
+def test_oversized_frame_rejected_before_allocation(use_recv_buffer):
+    """A corrupt 4-byte header demanding gigabytes must fail as WireError
+    BEFORE the payload allocation, on both receive paths."""
+    a, b = socket.socketpair()
+    try:
+        _send_raw_header(a, 0xF0000000)  # ~3.75 GiB claim
+        buf = wire.RecvBuffer() if use_recv_buffer else None
+        with pytest.raises(wire.WireError, match="max_frame_bytes"):
+            wire.recv_message_sized(b, buf=buf)
+        assert buf is None or buf.capacity < (1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_max_frame_bytes_custom_limit():
+    frame = wire.encode(np.zeros(8192, np.uint8))
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame)
+        with pytest.raises(wire.WireError, match="max_frame_bytes"):
+            wire.recv_message_sized(b, max_frame_bytes=1024)
+    finally:
+        a.close()
+        b.close()
+    # The default limit admits the same frame (fresh socket: the
+    # rejected frame's payload is still queued on the old one — the
+    # production paths tear the connection down on WireError).
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame)
+        out, nbytes = wire.recv_message_sized(b)
+        assert nbytes == len(frame)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# bfloat16 (wire code 12)
+
+
+try:
+    import ml_dtypes
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax here
+    ml_dtypes = None
+
+needs_bf16 = pytest.mark.skipif(
+    ml_dtypes is None, reason="ml_dtypes not installed"
+)
+
+
+@needs_bf16
+def test_bfloat16_roundtrip():
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    assert wire._DTYPE_CODES[bf16] == 12  # pinned: csrc/array.h kBF16
+    arr = (np.arange(12).reshape(3, 4) / 4).astype(bf16)
+    framed = wire.encode(arr)
+    assert framed == wire.encode_legacy(arr)
+    out = wire.decode(framed[4:])
+    assert out.dtype == bf16
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(arr, np.float32)
+    )
+
+
+@needs_bf16
+def test_bfloat16_zero_dim_and_empty():
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    for arr in [np.zeros((), bf16), np.zeros((0, 3), bf16),
+                np.zeros((2000,), bf16)]:  # last one crosses gather cutoff
+        framed = wire.encode(arr)
+        assert framed == wire.encode_legacy(arr)
+        out = wire.decode(framed[4:])
+        assert out.dtype == bf16 and out.shape == arr.shape
+
+
+# ---------------------------------------------------------------------------
+# RecvBuffer: allocation-free steady state + buffer-reuse lifetime
+
+
+def _socket_stream(frames):
+    """Write `frames` (pre-encoded) into one end of a socketpair from a
+    thread; return the read end."""
+    a, b = socket.socketpair()
+
+    def pump():
+        for f in frames:
+            a.sendall(f)
+        a.close()
+
+    t = threading.Thread(target=pump)
+    t.start()
+    return b, t
+
+
+def test_recv_buffer_zero_steady_state_allocations():
+    """The RecvBuffer receive path must do no payload-sized allocations
+    at steady state: 50 receives of ~256 KiB frames may allocate less
+    than one frame's worth of memory IN TOTAL (small constant per-recv
+    object churn only — no chunk lists, no b''.join, no growth)."""
+    frame = wire.encode({"frame": np.zeros(256 * 1024, np.uint8), "t": 1})
+    buf = wire.RecvBuffer()
+    warm, t = _socket_stream([frame] * 5)
+    for _ in range(5):
+        wire.recv_message_sized(warm, buf=buf)  # buffer reaches max size
+    t.join()
+    warm.close()
+    capacity = buf.capacity
+
+    b, t = _socket_stream([frame] * 50)
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(50):
+        value, nbytes = wire.recv_message_sized(b, buf=buf)
+        assert nbytes == len(frame)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    t.join()
+    b.close()
+    assert buf.capacity == capacity  # no regrowth
+    grown = sum(
+        d.size_diff for d in after.compare_to(before, "filename")
+        if d.size_diff > 0
+    )
+    # 50 x 256KiB frames moved; anything payload-proportional would be
+    # ~13 MB. Allow generous slack for interpreter noise.
+    assert grown < 128 * 1024, f"receive path allocated {grown} bytes"
+
+
+def test_recv_buffer_reuse_lifetime_rule():
+    """Decoded nests alias the RecvBuffer: the next recv on the same
+    buffer OVERWRITES them (this is the documented contract consumers
+    like ActorPool must copy under)."""
+    f1 = wire.encode(np.full(2048, 1, np.uint8))
+    f2 = wire.encode(np.full(2048, 2, np.uint8))
+    buf = wire.RecvBuffer(initial_bytes=8192)
+    b, t = _socket_stream([f1, f2])
+    first, _ = wire.recv_message_sized(b, buf=buf)
+    assert int(first[0]) == 1
+    second, _ = wire.recv_message_sized(b, buf=buf)
+    t.join()
+    b.close()
+    # Same-size successor overwrote the first nest in place.
+    assert int(first[0]) == 2
+    assert int(second[0]) == 2
+    with pytest.raises((ValueError, TypeError)):
+        first[0] = 9  # views into the buffer are read-only
+
+
+def test_recv_buffer_growth_preserves_triggering_message():
+    """Growth allocates a FRESH buffer, so the message that caused the
+    growth stays valid while the old (smaller) buffer's views die."""
+    small = wire.encode(np.full(64, 7, np.uint8))
+    big = wire.encode(np.full(1 << 16, 9, np.uint8))
+    buf = wire.RecvBuffer(initial_bytes=4096)
+    b, t = _socket_stream([small, big])
+    first, _ = wire.recv_message_sized(b, buf=buf)
+    second, _ = wire.recv_message_sized(b, buf=buf)  # forces growth
+    t.join()
+    b.close()
+    assert int(first[0]) == 7  # old buffer alive via the view
+    assert int(second[0]) == 9 and second.shape == (1 << 16,)
+
+
+# ---------------------------------------------------------------------------
+# Corruption fuzz: every malformed frame fails as WireError, never
+# struct.error/ValueError (the connection-teardown contract)
+
+
+def test_truncated_frames_always_raise_wire_error():
+    rng = np.random.default_rng(13)
+    for _ in range(40):
+        payload = wire.encode(random_nest(rng))[4:]
+        if not len(payload):
+            continue
+        for cut in sorted({int(c) for c in rng.integers(
+                0, len(payload), size=8)}):
+            try:
+                wire.decode(payload[:cut])
+            except wire.WireError:
+                pass  # the only acceptable failure
+            # a clean decode of a strict prefix is impossible: the
+            # trailing-garbage check requires full consumption, so a
+            # successful return means cut == len(payload)
+
+
+def test_bitflipped_frames_raise_wire_error_or_decode():
+    """Random single-byte corruption: decode may succeed (flips inside
+    array payloads are just different data) but any failure must be
+    WireError."""
+    rng = np.random.default_rng(17)
+    for _ in range(60):
+        payload = bytearray(wire.encode(random_nest(rng))[4:])
+        if not payload:
+            continue
+        pos = int(rng.integers(0, len(payload)))
+        payload[pos] ^= 1 << int(rng.integers(0, 8))
+        try:
+            wire.decode(bytes(payload))
+        except wire.WireError:
+            pass

@@ -34,6 +34,7 @@ from torchbeast_tpu.monobeast import (
     dummy_env_outputs,
     hparams_from_flags,
 )
+from torchbeast_tpu.runtime import wire
 from torchbeast_tpu.runtime.actor_pool import ActorPool
 from torchbeast_tpu.runtime.inference import default_buckets, inference_loop
 from torchbeast_tpu.runtime.queues import (
@@ -204,6 +205,12 @@ def make_parser():
                              "steady-state behavior unchanged.")
     parser.add_argument("--max_inference_batch_size", type=int, default=64)
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
+    parser.add_argument("--max_frame_bytes", type=int,
+                        default=wire.DEFAULT_MAX_FRAME_BYTES,
+                        help="Reject wire frames longer than this before "
+                             "allocating (a corrupt 4-byte header must "
+                             "surface as WireError, not a multi-GiB "
+                             "allocation).")
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
     parser.add_argument("--max_actor_reconnects", type=int, default=None,
@@ -604,6 +611,11 @@ def train(flags):
         if flags.native_runtime:
             from torchbeast_tpu.runtime.native import import_native
 
+            if any(a.startswith("shm:") for a in addresses):
+                raise RuntimeError(
+                    "--native_runtime does not speak the shm transport "
+                    "yet; use a unix:/tcp pipes_basename"
+                )
             core = import_native()
             if core is None:
                 raise RuntimeError(
@@ -822,6 +834,8 @@ def train(flags):
         pool_kwargs = {}
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
+        if not flags.native_runtime:
+            pool_kwargs["max_frame_bytes"] = flags.max_frame_bytes
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
@@ -1065,38 +1079,53 @@ def _probe_env_via_server(flags, address, timeout_s: float = 60.0):
     to a local probe when no server is reachable (e.g. unit tests calling
     train() with start_servers but slow spawns — the local env id is the
     same)."""
-    import socket as socket_lib
+    from torchbeast_tpu.runtime import transport as transport_lib
 
-    from torchbeast_tpu.runtime import wire
-    from torchbeast_tpu.runtime.env_server import parse_address
-
-    family, target = parse_address(address)
     deadline = time.monotonic() + timeout_s
     last_error = None
     while time.monotonic() < deadline:
-        sock = socket_lib.socket(family, socket_lib.SOCK_STREAM)
-        sock.settimeout(5)
+        stream = None
         try:
-            sock.connect(target)
-            step = wire.recv_message(sock)
+            # connect_transport speaks every address scheme (incl. the
+            # shm handshake, which a raw socket probe would misread as
+            # the initial step). recv_timeout_s bounds the spec read: a
+            # server that accepts but stalls before the initial step
+            # must fall through to the retry loop / local-probe
+            # fallback, not hang startup.
+            stream = transport_lib.connect_transport(
+                address, timeout_s=min(5.0, timeout_s),
+                recv_timeout_s=5.0,
+            )
+            step = stream.recv()
             if not isinstance(step, dict) or step.get("type") == "error":
                 # Deterministic server-side failure (env construction
                 # raised) or a server that predates spec advertisement:
                 # retrying would rebuild the env ~5x/sec for nothing.
                 last_error = RuntimeError(f"server replied {step!r:.200}")
+                step = None  # drop transport-buffer views before close
                 break
             if "num_actions" not in step:
                 last_error = KeyError(
                     "server does not advertise num_actions"
                 )
+                step = None
                 break
-            frame = np.asarray(step["frame"])
-            return int(step["num_actions"]), frame.shape, frame.dtype
-        except OSError as e:  # not up yet — retry until deadline
+            frame = np.asarray(step["frame"]).copy()
+            num_actions = int(step["num_actions"])
+            # Drop the decoded nest before the finally closes the
+            # transport: its arrays are views into the shm ring /
+            # receive buffer, and unmapping under live views is an error.
+            step = None
+            return num_actions, frame.shape, frame.dtype
+        except (OSError, TimeoutError) as e:  # not up yet — retry
             last_error = e
             time.sleep(0.2)
+        except wire.WireError as e:
+            last_error = e
+            break
         finally:
-            sock.close()
+            if stream is not None:
+                stream.close()
     log.warning(
         "Could not probe env spec from %s (%s); probing locally.",
         address, last_error,

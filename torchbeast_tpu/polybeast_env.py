@@ -34,7 +34,9 @@ def make_parser():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--pipes_basename", default="unix:/tmp/torchbeast_tpu",
                         help="Basename for the env-server addresses "
-                             "(unix:/path or host:baseport).")
+                             "(unix:/path, host:baseport, or shm:/path "
+                             "for shared-memory rings when the servers "
+                             "are co-located with the learner host).")
     parser.add_argument("--num_servers", type=int, default=4)
     parser.add_argument("--env", type=str, default="PongNoFrameskip-v4",
                         help="Gym environment (or Mock / Counting).")
@@ -59,8 +61,9 @@ def make_parser():
 
 
 def server_address(pipes_basename: str, index: int) -> str:
-    """unix:/tmp/x -> unix:/tmp/x.{i};  host:port -> host:{port+i}."""
-    if pipes_basename.startswith("unix:"):
+    """unix:/tmp/x and shm:/tmp/x -> {base}.{i};  host:port ->
+    host:{port+i}."""
+    if pipes_basename.startswith(("unix:", "shm:")):
         return f"{pipes_basename}.{index}"
     host, _, port = pipes_basename.rpartition(":")
     return f"{host}:{int(port) + index}"
@@ -74,7 +77,7 @@ def host_scoped_basename(pipes_basename: str, process_id: int,
     suffix; host:port bases step by num_servers per host."""
     if process_id == 0:
         return pipes_basename
-    if pipes_basename.startswith("unix:"):
+    if pipes_basename.startswith(("unix:", "shm:")):
         return f"{pipes_basename}-h{process_id}"
     host, _, port = pipes_basename.rpartition(":")
     return f"{host}:{int(port) + process_id * num_servers}"
@@ -103,6 +106,11 @@ def _serve(env_name: str, address: str, native: bool = False,
         def env_init():
             return create_env(env_name, seed=seed_base + next(counter))
     if native:
+        if address.startswith("shm:"):
+            raise RuntimeError(
+                "--native_server does not speak the shm transport yet; "
+                "use a unix:/tcp pipes_basename or the Python server"
+            )
         from torchbeast_tpu.runtime.native import import_native
 
         core = import_native()

@@ -23,17 +23,16 @@ takes over when Python-thread overhead shows up in profiles.
 """
 
 import logging
-import socket
 import threading
 import time
-from typing import Any, List
+from typing import Any, List, Optional
 
 import numpy as np
 
 from torchbeast_tpu import nest
 from torchbeast_tpu import telemetry
+from torchbeast_tpu.runtime import transport as transport_lib
 from torchbeast_tpu.runtime import wire
-from torchbeast_tpu.runtime.env_server import parse_address
 from torchbeast_tpu.runtime.queues import (
     AsyncError,
     BatchingQueue,
@@ -60,6 +59,7 @@ class ActorPool:
         connect_timeout_s: float = 600,
         max_reconnects: int = 0,
         state_table=None,
+        max_frame_bytes: Optional[int] = None,
     ):
         self._unroll_length = unroll_length
         self._learner_queue = learner_queue
@@ -67,6 +67,7 @@ class ActorPool:
         self._addresses = list(env_server_addresses)
         self._initial_agent_state = initial_agent_state
         self._connect_timeout_s = connect_timeout_s
+        self._max_frame_bytes = max_frame_bytes
         # Device-resident agent state (runtime/state_table.py): actor i
         # owns table slot i; requests carry {"slot", "advance"} instead
         # of agent_state, replies carry outputs only, and the rollout-
@@ -201,32 +202,14 @@ class ActorPool:
                 self._errors.append(e)
                 return
 
-    def _connect(self, address: str) -> socket.socket:
-        """Connect with retries until the deadline (the reference's
-        10-minute WaitForConnected semantics, actorpool.cc:354-372): env
-        servers may still be starting up — a refused/missing socket is a
-        reason to retry, not to die."""
-        family, target = parse_address(address)
-        deadline = time.monotonic() + self._connect_timeout_s
-        last_error = None
-        while time.monotonic() < deadline:
-            sock = socket.socket(family, socket.SOCK_STREAM)
-            sock.settimeout(max(0.1, deadline - time.monotonic()))
-            try:
-                sock.connect(target)
-            except OSError as e:
-                sock.close()
-                last_error = e
-                time.sleep(0.1)
-                continue
-            sock.settimeout(None)
-            try:
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            except OSError:
-                pass
-            return sock
-        raise TimeoutError(
-            f"WaitForConnected() timed out for {address}: {last_error}"
+    def _connect(self, address: str):
+        """Transport connect with retries until the deadline (the
+        reference's 10-minute WaitForConnected semantics,
+        actorpool.cc:354-372) — SocketTransport for tcp/unix addresses,
+        ShmTransport (handshaken rings) for shm://."""
+        return transport_lib.connect_transport(
+            address, timeout_s=self._connect_timeout_s,
+            max_frame_bytes=self._max_frame_bytes,
         )
 
     @staticmethod
@@ -238,12 +221,16 @@ class ActorPool:
         # [T=1, B=1] leading dims so rollout stacking and queue batching
         # are pure concatenations (reference array_pb_to_nest prepends
         # [1, 1], actorpool.cc:480-491).
+        # COPY, not view: decoded arrays alias the transport's reusable
+        # receive buffer (RecvBuffer / shm ring), which the next recv on
+        # this connection overwrites — while the rollout keeps these
+        # steps alive for unroll_length receives (wire.py lifetime rule).
         return {
-            k: np.asarray(msg[k])[None, None] for k in _ENV_KEYS
+            k: np.asarray(msg[k])[None, None].copy() for k in _ENV_KEYS
         }
 
-    def _recv_step(self, sock):
-        msg, nbytes = wire.recv_message_sized(sock)
+    def _recv_step(self, stream):
+        msg, nbytes = stream.recv_sized()
         self._tm_bytes_up.inc(nbytes)
         return self._env_outputs(msg)
 
@@ -272,9 +259,9 @@ class ActorPool:
                     index, env_outputs, agent_state, advance=True
                 )
                 action = int(np.asarray(agent_outputs["action"]).reshape(()))
-                self._tm_bytes_down.inc(wire.send_message(
-                    sock, {"type": "action", "action": action}
-                ))
+                self._tm_bytes_down.inc(
+                    sock.send({"type": "action", "action": action})
+                )
                 env_outputs = self._recv_step(sock)
                 progress[0] += 1
                 self._tm_steps.inc()

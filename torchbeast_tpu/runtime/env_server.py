@@ -11,8 +11,11 @@ reference's server-side bookkeeping (rpcenv.cc:106-119).
 Env exceptions are reported to the client as an error message frame (the
 reference surfaces them as grpc INTERNAL status, rpcenv.cc:76-81).
 
-Addresses: "unix:/path" or "host:port" (same convention as the reference's
-pipes_basename, polybeast_learner.py:40-42).
+Addresses: "unix:/path", "host:port" (same convention as the reference's
+pipes_basename, polybeast_learner.py:40-42), or "shm:/path" — shared-
+memory rings with a unix doorbell socket at /path, for env servers
+co-located with the learner process (runtime/transport.py): obs/action
+frames skip the socket data plane entirely.
 """
 
 import logging
@@ -20,22 +23,20 @@ import os
 import socket
 import threading
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 import numpy as np
 
 from torchbeast_tpu import telemetry
 from torchbeast_tpu.envs.environment import Environment
+from torchbeast_tpu.runtime import transport as transport_lib
 from torchbeast_tpu.runtime import wire
 
+# Re-exported: parse_address lived here before the transport module
+# existed and tests/drivers import it from this path.
+from torchbeast_tpu.runtime.transport import parse_address  # noqa: F401
+
 log = logging.getLogger(__name__)
-
-
-def parse_address(address: str):
-    if address.startswith("unix:"):
-        return socket.AF_UNIX, address[len("unix:") :]
-    host, _, port = address.rpartition(":")
-    return socket.AF_INET, (host or "127.0.0.1", int(port))
 
 
 def _step_to_message(step) -> dict:
@@ -47,9 +48,16 @@ def _step_to_message(step) -> dict:
 class EnvServer:
     """Serve env streams; one thread per connection."""
 
-    def __init__(self, env_init: Callable, address: str):
+    def __init__(self, env_init: Callable, address: str,
+                 max_frame_bytes: Optional[int] = None,
+                 obs_ring_bytes: int = transport_lib.DEFAULT_OBS_RING_BYTES,
+                 act_ring_bytes: int = transport_lib.DEFAULT_ACT_RING_BYTES):
         self._env_init = env_init
         self._address = address
+        self._shm = transport_lib.is_shm_address(address)
+        self._max_frame_bytes = max_frame_bytes
+        self._obs_ring_bytes = obs_ring_bytes
+        self._act_ring_bytes = act_ring_bytes
         self._family, self._target = parse_address(address)
         self._sock = None
         self._threads = []
@@ -137,9 +145,21 @@ class EnvServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass  # unix sockets
-        raw_env = self._env_init()
-        env = Environment(raw_env)
+        stream = None
+        env = None
+        msg = None
         try:
+            # For shm addresses this creates the per-connection rings and
+            # completes the handshake BEFORE the env is built, so a
+            # client that never acks can't leak an env instance.
+            stream = transport_lib.server_transport(
+                conn, shm=self._shm,
+                obs_ring_bytes=self._obs_ring_bytes,
+                act_ring_bytes=self._act_ring_bytes,
+                max_frame_bytes=self._max_frame_bytes,
+            )
+            raw_env = self._env_init()
+            env = Environment(raw_env)
             # The initial Step doubles as the env spec: remote learners
             # probe num_actions/frame shape from it instead of having to
             # build the env locally (split deployments may not have the
@@ -150,9 +170,9 @@ class EnvServer:
             initial["num_actions"] = num_actions_of(raw_env)
             with self._conns_lock:
                 self._tm_conns.set(len(self._conns))
-            self._tm_bytes_out.inc(wire.send_message(conn, initial))
+            self._tm_bytes_out.inc(stream.send(initial))
             while True:
-                msg, nbytes = wire.recv_message_sized(conn)
+                msg, nbytes = stream.recv_sized()
                 if msg is None:
                     break  # client hung up
                 self._tm_bytes_in.inc(nbytes)
@@ -161,22 +181,28 @@ class EnvServer:
                 t0 = time.perf_counter()
                 step = env.step(int(msg["action"]))
                 self._tm_step_s.observe(time.perf_counter() - t0)
-                self._tm_bytes_out.inc(
-                    wire.send_message(conn, _step_to_message(step))
-                )
-        except (wire.WireError, ConnectionError, BrokenPipeError) as e:
+                self._tm_bytes_out.inc(stream.send(_step_to_message(step)))
+        except (wire.WireError, ConnectionError, BrokenPipeError,
+                TimeoutError) as e:
             log.debug("Stream ended: %s", e)
         except Exception as e:  # env raised: report to client, drop stream
             log.exception("Environment raised")
             try:
-                wire.send_message(
-                    conn, {"type": "error", "message": f"{type(e).__name__}: {e}"}
-                )
-            except OSError:
+                if stream is not None:
+                    stream.send({
+                        "type": "error",
+                        "message": f"{type(e).__name__}: {e}",
+                    })
+            except (OSError, wire.WireError):
                 pass
         finally:
-            env.close()
-            conn.close()
+            msg = None  # drop transport-buffer views before close
+            if env is not None:
+                env.close()
+            if stream is not None:
+                stream.close()  # closes conn and, for shm, the rings
+            else:
+                conn.close()
             with self._conns_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
