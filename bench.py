@@ -551,6 +551,44 @@ def run_bench(child_deadline: float):
             f"bench: skipping anakin phase ({remaining():.0f}s left)\n"
         )
 
+    # Learner superstep throughput (ISSUE 4): the small-MLP K=8 fused
+    # dispatch — the dispatch-amortization metric the superstep work
+    # moves. ONE measurement implementation, shared with the committed
+    # artifact: benchmarks/learner_bench.py is loaded by path (the
+    # benchmarks dir is not a package).
+    def measure_learner_superstep(k=8, n_updates=32):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "learner_bench",
+            os.path.join(_REPO, "benchmarks", "learner_bench.py"),
+        )
+        lb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lb)
+        hp, model, optimizer, params, lrng = lb.build_config(
+            use_lstm=False
+        )
+        row = lb.measure_updates_per_sec(
+            hp, model, optimizer, params, lrng, k, n_updates
+        )
+        return row["updates_per_sec"]
+
+    learner_updates_sps = None
+    if remaining() > 45:
+        try:
+            learner_updates_sps = measure_learner_superstep(
+                n_updates=32 if on_accel else 16
+            )
+        except Exception as e:  # diagnostic only — never sink the bench
+            sys.stderr.write(
+                f"bench: learner superstep measurement failed: {e}\n"
+            )
+    else:
+        sys.stderr.write(
+            f"bench: skipping learner superstep phase "
+            f"({remaining():.0f}s left)\n"
+        )
+
     result = _base_result(**_live_fields())
     result.update({
         "value": round(frames_per_sec, 1),
@@ -600,6 +638,38 @@ def run_bench(child_deadline: float):
     result["inference_steps_per_sec_delta_pct"] = (
         round(100.0 * (inference_sps - prev_inference) / prev_inference, 1)
         if inference_sps and prev_inference and on_accel
+        else None
+    )
+    # Learner superstep regression visibility (ISSUE 4), mirroring the
+    # inference convention: delta vs the committed learner_bench
+    # artifact's small-MLP K=8 number — but only when the platforms
+    # match (the committed artifact records where it was measured;
+    # CPU-vs-TPU deltas are meaningless).
+    result["learner_updates_per_sec"] = (
+        round(learner_updates_sps, 1) if learner_updates_sps else None
+    )
+    prev_learner = prev_learner_platform = None
+    try:
+        with open(os.path.join(
+            _REPO, "benchmarks", "artifacts", "learner_bench.json"
+        )) as f:
+            lb_art = json.load(f)
+        prev_learner = lb_art.get("acceptance", {}).get(
+            "mlp_updates_per_sec_ktop"
+        )
+        prev_learner_platform = lb_art.get("platform")
+    except Exception:
+        pass
+    result["learner_updates_per_sec_prev"] = (
+        round(prev_learner, 1) if prev_learner else None
+    )
+    result["learner_updates_per_sec_delta_pct"] = (
+        round(
+            100.0 * (learner_updates_sps - prev_learner) / prev_learner,
+            1,
+        )
+        if learner_updates_sps and prev_learner
+        and prev_learner_platform == platform
         else None
     )
     if not on_accel:

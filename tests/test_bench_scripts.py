@@ -174,6 +174,44 @@ def test_wire_bench_selftest(tmp_path):
     assert saved["bench"] == "wire_bench" and saved["ok"] is True
 
 
+def test_learner_bench_selftest(tmp_path):
+    """learner_bench --selftest: structural run of both configs over
+    K in {1, 2} with the artifact schema pinned (telemetry block
+    validated, host-sync accounting exact), so the bench can't rot
+    between measurement rounds."""
+    out_json = tmp_path / "learner_bench.json"
+    proc = _run([
+        "benchmarks/learner_bench.py", "--selftest",
+        "--out", str(out_json),
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "learner_bench"
+    assert out["ok"] is True and out["failures"] == []
+    assert out["selftest"] is True
+
+    rows = {(r["config"], r["k"]) for r in out["results"]["configs"]}
+    assert rows == {
+        (c, k) for c in ("mlp", "lstm") for k in (1, 2)
+    }
+    for row in out["results"]["configs"]:
+        assert row["updates_per_sec"] > 0
+        # The host-sync contract: EXACTLY updates / K stats round-trips.
+        assert row["host_syncs"] * row["k"] == row["updates"]
+    assert out["acceptance"]["mlp_speedup_ktop_vs_k1"] > 0
+
+    # Telemetry block embedded like the other benches, with the
+    # superstep instrumentation populated.
+    _validate_telemetry_block(out["telemetry"])
+    snap = out["telemetry"]["snapshot"]
+    assert snap["histograms"]["learner.update_dispatch_s"]["count"] > 0
+    assert snap["histograms"]["learner.updates_per_dispatch"]["count"] > 0
+    assert snap["counters"]["learner.host_syncs"] > 0
+
+    saved = json.loads(out_json.read_text())
+    assert saved["bench"] == "learner_bench" and saved["ok"] is True
+
+
 def test_vtrace_bench_emits_rows(tmp_path):
     out_md = tmp_path / "vtrace.md"
     proc = _run([

@@ -425,3 +425,61 @@ def test_mono_dp_rejects_bad_combos(tmp_path):
     )
     with pytest.raises(ValueError, match="composite meshes"):
         monobeast.train(flags)
+
+
+def test_superstep_train_bit_identical_to_sequential(tmp_path):
+    """--superstep_k 2 must train BIT-identically to --superstep_k 1 on
+    the same seeds: the K-scan applies the same updates in the same
+    order (schedules tick per-update), acting only sees params between
+    collects, and the Mock env + fixed seeds make the whole run
+    deterministic. Compared via the serialized checkpoint params/opt
+    bytes — any numeric drift anywhere in the superstep path fails.
+
+    MLP+LSTM model: the conv families are NOT bit-stable under a scan
+    (XLA fuses the conv differently inside the scan body, ~1e-8 ulp
+    drift — same training distribution, different bits), which is why
+    the bit-identity contract is pinned on the MLP families."""
+    import flax.serialization
+
+    def run(xpid, k):
+        flags = make_flags(
+            tmp_path, xpid=xpid, superstep_k=str(k),
+            num_actors="4", batch_size="2", total_steps="80",
+            model="mlp", use_lstm=True,
+        )
+        stats = monobeast.train(flags)
+        with open(tmp_path / xpid / "model.ckpt", "rb") as f:
+            payload = flax.serialization.msgpack_restore(f.read())
+        return stats, payload
+
+    stats1, ck1 = run("ss-k1", 1)
+    stats2, ck2 = run("ss-k2", 2)
+    assert ck1["step"] == ck2["step"]
+    assert ck1["params"] == ck2["params"]
+    assert ck1["opt_state"] == ck2["opt_state"]
+    assert stats1["total_loss"] == stats2["total_loss"]
+
+
+def test_superstep_step_accounting(tmp_path):
+    """A K=2 dispatch consumes K*T*batch_size frames: the reported step
+    counter must land on a whole number of supersteps, not undercount
+    by /K."""
+    flags = make_flags(
+        tmp_path, xpid="ss-acct", superstep_k="2",
+        num_actors="4", batch_size="2", total_steps="40",
+    )
+    stats = monobeast.train(flags)
+    assert stats["step"] >= 40
+    assert stats["step"] % (2 * 5 * 2) == 0  # K * T * batch_size
+
+
+def test_superstep_divisibility_rejected(tmp_path):
+    """K must divide the sub-batches per collect (a fixed-K scan cannot
+    take a partial group, and spilling across collects would change
+    policy lag)."""
+    flags = make_flags(
+        tmp_path, xpid="ss-bad", superstep_k="3",
+        num_actors="4", batch_size="2",
+    )
+    with pytest.raises(ValueError, match="superstep_k"):
+        monobeast.train(flags)

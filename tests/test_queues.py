@@ -363,3 +363,194 @@ class TestDevicePrefetcher:
         pf.close()
         pf.join(timeout=5)
         assert not pf.is_alive()
+
+
+class TestBatchArena:
+    """BatchArena (ISSUE 4): write-through [K, T+1, B, ...] assembly
+    straight from raw queue items, bit-identical to the
+    concat-then-stack path it replaces, with release-fenced slot
+    reuse."""
+
+    @staticmethod
+    def _item(rng, rows=1, t=3):
+        return {
+            "batch": {
+                "obs": rng.standard_normal((t, rows, 5)).astype(
+                    np.float32
+                ),
+                "act": rng.integers(0, 4, (t, rows)).astype(np.int32),
+            },
+            "initial_agent_state": (
+                rng.standard_normal((1, rows, 6)).astype(np.float32),
+            ),
+        }
+
+    def _filled_queue(self, items):
+        from torchbeast_tpu.runtime import BatchingQueue
+
+        q = BatchingQueue(
+            batch_dim=1, minimum_batch_size=1,
+            maximum_queue_size=len(items) + 1,
+        )
+        for item in items:
+            q.enqueue(item)
+        return q
+
+    def test_roundtrip_bit_identical_to_stack_path(self):
+        from torchbeast_tpu.runtime import BatchArena
+
+        k, rows = 3, 2
+        rng = np.random.default_rng(0)
+        items = [self._item(rng) for _ in range(k * rows)]
+        q = self._filled_queue(items)
+        arena = BatchArena(k=k, rows=rows, pool=2)
+        stacked, release = arena.assemble_from(q)
+        # Reference: the old list-of-nests + concat, then np.stack.
+        for key in ("obs", "act"):
+            ref = np.stack([
+                np.concatenate(
+                    [items[b * rows + c]["batch"][key]
+                     for c in range(rows)],
+                    axis=1,
+                )
+                for b in range(k)
+            ])
+            np.testing.assert_array_equal(stacked["batch"][key], ref)
+        ref_state = np.stack([
+            np.concatenate(
+                [items[b * rows + c]["initial_agent_state"][0]
+                 for c in range(rows)],
+                axis=1,
+            )
+            for b in range(k)
+        ])
+        np.testing.assert_array_equal(
+            stacked["initial_agent_state"][0], ref_state
+        )
+        release()
+
+    def test_multi_row_items_tile_batches(self):
+        from torchbeast_tpu.runtime import BatchArena
+
+        rng = np.random.default_rng(1)
+        items = [self._item(rng, rows=2) for _ in range(4)]  # K=2, B=4
+        q = self._filled_queue(items)
+        arena = BatchArena(k=2, rows=4, pool=2)
+        stacked, release = arena.assemble_from(q)
+        np.testing.assert_array_equal(
+            stacked["batch"]["obs"][0],
+            np.concatenate(
+                [items[0]["batch"]["obs"], items[1]["batch"]["obs"]],
+                axis=1,
+            ),
+        )
+        release()
+
+    def test_straddling_item_rejected(self):
+        from torchbeast_tpu.runtime import BatchArena
+
+        rng = np.random.default_rng(2)
+        q = self._filled_queue(
+            [self._item(rng, rows=2), self._item(rng, rows=3)]
+        )
+        arena = BatchArena(k=1, rows=4, pool=2)
+        with pytest.raises(ValueError, match="straddles"):
+            arena.assemble_from(q)
+
+    def test_slot_fence_blocks_until_release_then_grows(self):
+        """An unreleased slot must NOT be rewritten: with every slot
+        held, assembly falls back to growing the pool (never corrupts,
+        never deadlocks), and the held slot's data stays intact."""
+        from torchbeast_tpu.runtime import BatchArena
+
+        rng = np.random.default_rng(3)
+        arena = BatchArena(
+            k=1, rows=1, pool=2, grow_timeout_s=0.2
+        )
+        held = []
+        for i in range(3):  # one past the pool size
+            q = self._filled_queue([self._item(rng)])
+            stacked, release = arena.assemble_from(q)
+            held.append(
+                (stacked["batch"]["obs"].copy(), stacked, release)
+            )
+        assert len(arena._slots) == 3  # grew exactly once
+        for copy_before, stacked, release in held:
+            np.testing.assert_array_equal(
+                copy_before, stacked["batch"]["obs"]
+            )
+            release()
+        # All released: the next assembly reuses a slot, no growth.
+        q = self._filled_queue([self._item(rng)])
+        _, release = arena.assemble_from(q)
+        assert len(arena._slots) == 3
+        release()
+
+    def test_closed_queue_drops_partial_and_releases_slot(self):
+        from torchbeast_tpu.runtime import BatchArena, BatchingQueue
+
+        rng = np.random.default_rng(4)
+        q = BatchingQueue(batch_dim=1, maximum_queue_size=4)
+        q.enqueue(self._item(rng))
+        closer = threading.Timer(0.2, q.close)
+        closer.start()
+        arena = BatchArena(k=2, rows=2, pool=2)
+        with pytest.raises(StopIteration):
+            arena.assemble_from(q)
+        closer.join()
+        assert all(slot.free for slot in arena._slots)
+
+
+class TestDevicePrefetcherSuperstepMode:
+    def _queue_of(self, n_items, rng=None):
+        from torchbeast_tpu.runtime import BatchingQueue
+
+        rng = rng or np.random.default_rng(0)
+        q = BatchingQueue(
+            batch_dim=1, minimum_batch_size=1,
+            maximum_queue_size=n_items + 1,
+        )
+        items = [TestBatchArena._item(rng) for _ in range(n_items)]
+        for item in items:
+            q.enqueue(item)
+        return q, items
+
+    def test_yields_staged_release_pairs(self):
+        from torchbeast_tpu.runtime import BatchArena, DevicePrefetcher
+
+        k, rows = 2, 2
+        q, items = self._queue_of(2 * k * rows)
+        arena = BatchArena(k=k, rows=rows, pool=3)
+        placed = []
+        pf = DevicePrefetcher(
+            q, lambda item: placed.append(item) or item,
+            depth=2, arena=arena,
+        ).start()
+        got = []
+        q.close()
+        for staged, release in pf:
+            got.append(staged)
+            release()
+        assert len(got) == 2
+        assert len(placed) == 2
+        # Superstep 0 = the first k*rows items in order.
+        np.testing.assert_array_equal(
+            got[0]["batch"]["obs"][0, :, 0],
+            items[0]["batch"]["obs"][:, 0],
+        )
+        pf.join(timeout=5)
+
+    def test_partial_superstep_dropped_at_close(self):
+        from torchbeast_tpu.runtime import BatchArena, DevicePrefetcher
+
+        k, rows = 2, 2
+        # 1.5 supersteps' worth: the second must be dropped.
+        q, _ = self._queue_of(k * rows + rows)
+        arena = BatchArena(k=k, rows=rows, pool=3)
+        pf = DevicePrefetcher(
+            q, lambda item: item, depth=2, arena=arena
+        ).start()
+        q.close()
+        staged = [s for s, _ in pf]
+        assert len(staged) == 1
+        pf.join(timeout=5)

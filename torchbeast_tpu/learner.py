@@ -21,6 +21,7 @@ from typing import Any, Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from torchbeast_tpu import telemetry
@@ -334,24 +335,166 @@ def make_update_step(
     )
 
 
-def instrument_update_step(update_step, registry=None):
+def superstep_body(
+    model, optimizer: optax.GradientTransformation, hp: HParams
+):
+    """The UNJITTED learner superstep:
+
+    (params, opt_state, batches, initial_agent_states) ->
+        (new_params, new_opt_state, stacked_stats)
+
+    `batches` / `initial_agent_states` carry a leading K axis
+    ([K, T+1, B, ...] / [K, ...]); a `lax.scan` threads params/opt_state
+    through K applications of the EXACT update_body — so one XLA
+    dispatch performs K parameter updates, and the optimizer `count`
+    ticks once per scanned update (the LR decay and the entropy anneal
+    advance per-UPDATE, not per-dispatch; pinned by the superstep
+    bit-identity tests). Stats come back as one [K]-stacked pytree: the
+    host syncs once per K updates instead of once per update.
+
+    Shared by the single-device jit (make_update_superstep) and the
+    mesh-sharded jit (parallel/dp.make_parallel_update_step with
+    superstep_k > 1) the same way update_body is.
+    """
+    step = update_body(model, optimizer, hp)
+
+    def superstep(params, opt_state, batches, initial_agent_states):
+        def scan_body(carry, xs):
+            p, o = carry
+            batch, state = xs
+            p, o, stats = step(p, o, batch, state)
+            return (p, o), stats
+
+        (params, opt_state), stats = jax.lax.scan(
+            scan_body, (params, opt_state),
+            (batches, initial_agent_states),
+        )
+        return params, opt_state, stats
+
+    return superstep
+
+
+def consume_staged_inputs(update_fn):
+    """Wrap an update step so the staged batch/agent-state device arrays
+    are DELETED right after dispatch — the host-side half of batch
+    donation (`donate_batch=True`).
+
+    XLA-level donation is strictly input-output buffer aliasing, and the
+    superstep emits no batch-shaped outputs (its outputs are
+    params/opt_state/[K]-stats), so handing the [K, T+1, B, ...] staging
+    stack to donate_argnums would only draw the "donated buffers were
+    not usable" warning every dispatch (the same physics
+    donate_argnums_for documents for the single update step). What CAN
+    be enforced is the DevicePrefetcher staging contract — each staged
+    stack is consumed exactly once: `jax.Array.delete()` drops the host
+    reference at dispatch, so the buffers free the moment the scan's
+    execution retires (PJRT holds them alive until then) instead of
+    whenever the consumer happens to drop its references, and any
+    accidental re-read of a consumed stack raises
+    "Array has been deleted" loudly instead of training on stale data.
+    Pinned by tests: no XLA donation warning, use-after-free raises.
+    """
+
+    def wrapped(params, opt_state, batch, initial_agent_state):
+        out = update_fn(params, opt_state, batch, initial_agent_state)
+        for leaf in jax.tree_util.tree_leaves(
+            (batch, initial_agent_state)
+        ):
+            if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+                leaf.delete()
+        return out
+
+    return wrapped
+
+
+def make_update_superstep(
+    model, optimizer: optax.GradientTransformation, hp: HParams, k: int,
+    donate=True, donate_batch: bool = False,
+):
+    """Build the jitted K-update superstep (see superstep_body).
+
+    One dispatch = K SGD updates over a [K, T+1, B, ...] batch stack,
+    bit-identical (CPU backend, pinned by test) to K sequential
+    make_update_step dispatches on the same batches. `donate` is the
+    donate_argnums_for policy for params/opt_state. `donate_batch=True`
+    enforces the consume-once staging contract on the stacked batch via
+    consume_staged_inputs (host-side deletion — see there for why the
+    stack is NOT handed to donate_argnums).
+    """
+    if k < 1:
+        raise ValueError(f"superstep k must be >= 1, got {k}")
+    jitted = jax.jit(
+        superstep_body(model, optimizer, hp),
+        # Batch/state never go to donate_argnums here — no batch-shaped
+        # outputs exist to alias (consume_staged_inputs has the story).
+        donate_argnums=donate_argnums_for(donate, donate_batch=False),
+    )
+    if donate_batch:
+        return consume_staged_inputs(jitted)
+    return jitted
+
+
+def stack_superstep_columns(
+    batch: Dict[str, Any], initial_agent_state, k: int, columns: int,
+    offset: int = 0, batch_dim: int = 1,
+):
+    """Host-side superstep staging for the sync driver: slice `k`
+    consecutive `columns`-wide groups out of a wide [T+1, B_total, ...]
+    unroll batch (starting at column `offset`) and stack them into the
+    [K, T+1, columns, ...] superstep layout (states [K, ...] likewise).
+
+    np.stack materializes fresh contiguous arrays, so the staged stack
+    aliases nothing the collector still owns — safe to hand to a
+    donate_batch superstep. Values are bit-identical to dispatching the
+    k column groups sequentially (pure copies; pinned by test).
+    """
+
+    def stack(v):
+        v = np.asarray(v)
+        head = (slice(None),) * batch_dim
+        return np.stack([
+            v[head + (slice(offset + j * columns,
+                            offset + (j + 1) * columns),)]
+            for j in range(k)
+        ])
+
+    return (
+        {key: stack(v) for key, v in batch.items()},
+        jax.tree_util.tree_map(stack, initial_agent_state),
+    )
+
+
+def instrument_update_step(update_step, registry=None, superstep_k=1):
     """Wrap a (jitted) update step with learner-side telemetry:
 
     - learner.update_dispatch_s: host time to hand XLA the update (the
       dispatch is async — device compute shows up in the driver's
       dequeue/learn stage histograms, not here);
     - learner.batch_bytes: host->device transfer volume of the batch +
-      initial agent state per update (the learner-side wire-accounting
+      initial agent state per dispatch (the learner-side wire-accounting
       analog of the acting path's bytes_per_step gauges);
-    - learner.updates / learner.frames_per_update.
+    - learner.updates: +superstep_k per dispatch (a superstep dispatch
+      IS K updates — the counter counts updates, never dispatches);
+    - learner.superstep_k (gauge) and learner.updates_per_dispatch
+      (histogram: count = dispatches, mean = amortization factor) make
+      the superstep amortization visible in telemetry.jsonl;
+    - learner.host_syncs: counts host round-trips for update stats. The
+      flush happens in the driver, so the wrapper exposes it as
+      `wrapped.count_host_sync()` — drivers call it per stats fetch
+      (once per K updates under supersteps, the K-fold reduction the
+      learner_bench acceptance pins).
 
     Signature-transparent: drivers swap `update_step =
-    instrument_update_step(update_step)` and nothing else changes.
+    instrument_update_step(update_step, superstep_k=k)` and nothing
+    else changes.
     """
     reg = registry if registry is not None else telemetry.get_registry()
     h_dispatch = reg.histogram("learner.update_dispatch_s")
+    h_per_dispatch = reg.histogram("learner.updates_per_dispatch")
     c_bytes = reg.counter("learner.batch_bytes")
     c_updates = reg.counter("learner.updates")
+    c_host_syncs = reg.counter("learner.host_syncs")
+    reg.gauge("learner.superstep_k").set(superstep_k)
 
     def wrapped(params, opt_state, batch, initial_agent_state):
         nbytes = sum(
@@ -364,9 +507,11 @@ def instrument_update_step(update_step, registry=None):
         out = update_step(params, opt_state, batch, initial_agent_state)
         h_dispatch.observe(time.perf_counter() - t0)
         c_bytes.inc(nbytes)
-        c_updates.inc()
+        c_updates.inc(superstep_k)
+        h_per_dispatch.observe(superstep_k)
         return out
 
+    wrapped.count_host_sync = lambda: c_host_syncs.inc()
     return wrapped
 
 
@@ -404,8 +549,21 @@ def make_act_step(model):
 
 
 def episode_stat_postprocess(stats: Dict[str, Any]) -> Dict[str, Any]:
-    """Host-side: turn sum/count aggregates into mean_episode_return."""
-    out = {k: float(v) for k, v in stats.items()}
+    """Host-side: turn sum/count aggregates into mean_episode_return.
+
+    Leaves may be scalars (one update) or [K]-stacked arrays (a
+    superstep's scanned stats): episode sums/counts SUM over the stack
+    and loss-like keys MEAN, matching exactly what K sequential flushes
+    would have aggregated to — no /K undercount, no double count
+    (pinned by test).
+    """
+    out = {}
+    for key, v in stats.items():
+        arr = np.asarray(jax.device_get(v), np.float64)
+        if key in ("episode_returns_sum", "episode_count"):
+            out[key] = float(arr.sum())
+        else:
+            out[key] = float(arr.mean())
     count = out.pop("episode_count", 0.0)
     returns_sum = out.pop("episode_returns_sum", 0.0)
     if count > 0:

@@ -193,6 +193,18 @@ def make_parser():
                         help="Synchronous collection: materialize every "
                              "policy result on host before stepping "
                              "envs (debugging / host-policy baselines).")
+    parser.add_argument("--superstep_k", type=int, default=1,
+                        help="Learner superstep: fuse K SGD updates "
+                             "into ONE lax.scan dispatch over a "
+                             "[K, T+1, B, ...] batch stack (schedules "
+                             "tick per-update inside the scan; stats "
+                             "come back [K]-stacked so the host syncs "
+                             "once per K updates). Bit-identical to K "
+                             "sequential dispatches. Requires "
+                             "num_actors/batch_size divisible by K "
+                             "(each collect dispatches whole "
+                             "supersteps). 1 = today's per-update "
+                             "dispatch.")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--env_seed", type=int, default=None,
                         help="Base seed for stochastic envs; env i draws "
@@ -633,6 +645,18 @@ def train(flags):
             "num_actors must be a multiple of batch_size in the sync trainer "
             f"(got {flags.num_actors} vs {flags.batch_size})"
         )
+    superstep_k = getattr(flags, "superstep_k", 1)
+    if superstep_k < 1:
+        raise ValueError(f"--superstep_k must be >= 1, got {superstep_k}")
+    if (flags.num_actors // flags.batch_size) % superstep_k != 0:
+        # Each collect's sub-batches must split into whole supersteps —
+        # a fixed-K scan cannot consume a partial group, and carrying
+        # sub-batches across collects would silently change policy lag.
+        raise ValueError(
+            f"--superstep_k {superstep_k} must divide the "
+            f"{flags.num_actors // flags.batch_size} learner sub-batches "
+            "per collect (num_actors / batch_size)"
+        )
     n_dev = getattr(flags, "num_learner_devices", 1)
     if n_dev > 1:
         # Pure flag predicates — reject BEFORE any side effects
@@ -698,6 +722,7 @@ def train(flags):
     # a whole unroll, so only the opt state may be donated.
     donate = "opt_only" if flags.overlap_collect else True
     n_dev = getattr(flags, "num_learner_devices", 1)
+    K = superstep_k
     if n_dev > 1:
         from torchbeast_tpu.parallel import (
             create_mesh,
@@ -709,18 +734,33 @@ def train(flags):
         mesh = create_mesh(n_dev)
         params = replicate(mesh, params)
         opt_state = replicate(mesh, opt_state)
+        # superstep_k > 1: the same K-scan wrapper, sharded — the staged
+        # [K, T+1, B] stack is fresh (stack_superstep_columns copies),
+        # consumed exactly once, so batch donation's consume-once
+        # enforcement applies.
         update_step = make_parallel_update_step(
-            model, optimizer, hp, mesh, donate=donate
+            model, optimizer, hp, mesh, donate=donate,
+            superstep_k=K, donate_batch=K > 1,
         )
-        place_sub = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
+        place_sub = lambda b, s: shard_batch(  # noqa: E731
+            mesh, b, s, leading_axes=1 if K > 1 else 0
+        )
         log.info("Sync learner data-parallel over %d devices", n_dev)
     else:
-        # No donate_batch: update_body emits no batch-shaped outputs to
-        # alias, so donating the staged batch frees nothing (see
-        # learner.donate_argnums_for).
-        update_step = learner_lib.make_update_step(
-            model, optimizer, hp, donate=donate
-        )
+        if K > 1:
+            # One dispatch = K scanned updates; the staged stack is a
+            # fresh copy nothing re-reads, so donate it (consume-once
+            # deletion — learner.consume_staged_inputs).
+            update_step = learner_lib.make_update_superstep(
+                model, optimizer, hp, K, donate=donate, donate_batch=True
+            )
+        else:
+            # No donate_batch: update_body emits no batch-shaped outputs
+            # to alias, so donating the staged batch frees nothing (see
+            # learner.donate_argnums_for).
+            update_step = learner_lib.make_update_step(
+                model, optimizer, hp, donate=donate
+            )
         # Explicit (async) placement: donation needs committed device
         # buffers — a host-numpy arg reaches the jit as an undonatable
         # transfer (and a warning); device_put also starts the H2D copy
@@ -729,8 +769,16 @@ def train(flags):
             jax.device_put(b), jax.device_put(s)
         )
     if telemetry_on:
-        # Dispatch latency + batch transfer bytes per update.
-        update_step = learner_lib.instrument_update_step(update_step)
+        # Dispatch latency + batch transfer bytes per update (counts K
+        # updates per superstep dispatch).
+        update_step = learner_lib.instrument_update_step(
+            update_step, superstep_k=K
+        )
+    count_host_sync = getattr(
+        update_step, "count_host_sync", lambda: None
+    )
+    if K > 1:
+        log.info("Learner supersteps: %d updates per dispatch", K)
     act_step = learner_lib.make_act_step(model)
 
     pool = _make_pool(flags, B)
@@ -809,13 +857,22 @@ def train(flags):
         def flush_stats(pending_entry):
             device_stats, at_step = pending_entry
             sub_stats = jax.device_get(device_stats)  # one batched transfer
+            count_host_sync()
             agg = {}
             for key in sub_stats[0]:
-                vals = [float(s[key]) for s in sub_stats]
+                # Each dispatch's stats leaves are scalars (K=1) or
+                # [K]-stacked (supersteps): concatenate to per-UPDATE
+                # rows so episode sums/counts SUM over every update and
+                # loss keys MEAN over every update — identical
+                # aggregation either way, no /K undercount.
+                vals = np.concatenate([
+                    np.atleast_1d(np.asarray(s[key], np.float64))
+                    for s in sub_stats
+                ])
                 if key in ("episode_returns_sum", "episode_count"):
-                    agg[key] = sum(vals)
+                    agg[key] = float(vals.sum())
                 else:
-                    agg[key] = sum(vals) / len(vals)
+                    agg[key] = float(vals.mean())
             out = learner_lib.episode_stat_postprocess(agg)
             out["step"] = at_step
             plogger.log(out)
@@ -842,29 +899,56 @@ def train(flags):
 
             # Split the [T+1, num_actors] unroll into learner batches of
             # batch_size columns; aggregate stats over ALL sub-batches
-            # (losses averaged, episode sums/counts summed).
+            # (losses averaged, episode sums/counts summed). With
+            # supersteps, K consecutive sub-batches stack into one
+            # [K, T+1, batch_size] dispatch — the scan applies them in
+            # the SAME order the per-update loop would, so the update
+            # sequence (and with it every schedule tick) is identical.
             device_stats = []
             with tracer.span("driver.learn", cat="driver"):
-                for i in range(0, B, flags.batch_size):
-                    sub = {
-                        k: v[:, i : i + flags.batch_size]
-                        for k, v in batch.items()
-                    }
-                    sub_state = jax.tree_util.tree_map(
-                        lambda s: s[:, i : i + flags.batch_size],
-                        initial_agent_state,
-                    )
-                    sub, sub_state = place_sub(sub, sub_state)
-                    # Actual sub-batch columns, not the flag (honest
-                    # even while train() enforces divisibility).
-                    h_batch_size.observe(
-                        min(i + flags.batch_size, B) - i
-                    )
-                    latest_params, opt_state, train_stats = update_step(
-                        latest_params, opt_state, sub, sub_state
-                    )
-                    device_stats.append(train_stats)
-                    step += T * flags.batch_size
+                if K > 1:
+                    group = K * flags.batch_size
+                    for i in range(0, B, group):
+                        stacked, stacked_state = (
+                            learner_lib.stack_superstep_columns(
+                                batch, initial_agent_state, K,
+                                flags.batch_size, offset=i,
+                            )
+                        )
+                        stacked, stacked_state = place_sub(
+                            stacked, stacked_state
+                        )
+                        for _ in range(K):
+                            h_batch_size.observe(flags.batch_size)
+                        latest_params, opt_state, train_stats = (
+                            update_step(
+                                latest_params, opt_state, stacked,
+                                stacked_state,
+                            )
+                        )
+                        device_stats.append(train_stats)
+                        step += K * T * flags.batch_size
+                else:
+                    for i in range(0, B, flags.batch_size):
+                        sub = {
+                            k: v[:, i : i + flags.batch_size]
+                            for k, v in batch.items()
+                        }
+                        sub_state = jax.tree_util.tree_map(
+                            lambda s: s[:, i : i + flags.batch_size],
+                            initial_agent_state,
+                        )
+                        sub, sub_state = place_sub(sub, sub_state)
+                        # Actual sub-batch columns, not the flag (honest
+                        # even while train() enforces divisibility).
+                        h_batch_size.observe(
+                            min(i + flags.batch_size, B) - i
+                        )
+                        latest_params, opt_state, train_stats = update_step(
+                            latest_params, opt_state, sub, sub_state
+                        )
+                        device_stats.append(train_stats)
+                        step += T * flags.batch_size
             if not flags.overlap_collect:
                 params_cell[0] = latest_params  # zero policy lag
             if pending is not None:
@@ -877,9 +961,10 @@ def train(flags):
                 sps = (step - last_log_step) / (now - last_log_time)
                 last_log_time, last_log_step = now, step
                 g_sps.set(sps)
-                # Dispatched-unflushed stat batches at this instant
-                # (the delayed-stats pipeline's real occupancy).
-                g_dispatch_q.set(len(pending[0]) if pending else 0)
+                # Dispatched-unflushed UPDATES at this instant (the
+                # delayed-stats pipeline's real occupancy; a superstep
+                # dispatch holds K updates, so count K per entry).
+                g_dispatch_q.set(len(pending[0]) * K if pending else 0)
                 tele.write(extra={"step": step})
                 means = timings.means()
                 log.info(

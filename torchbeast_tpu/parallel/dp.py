@@ -61,6 +61,7 @@ def make_parallel_update_step(
     param_shardings: Optional[Any] = None,
     opt_shardings: Optional[Any] = None,
     donate_batch: bool = False,
+    superstep_k: int = 1,
 ):
     """Data/tensor-parallel version of learner.make_update_step.
 
@@ -69,24 +70,40 @@ def make_parallel_update_step(
     batch == the reference's single-learner loss over the full batch).
     `donate` is a policy understood by learner.donate_argnums_for: True
     (params+opt, single-threaded drivers), "opt_only" (async drivers —
-    the shared params stay undonated), or False. `donate_batch` donates
-    the staged batch/agent-state args too (prefetched drivers; the
-    staged shards must be placed with the SAME bsh/ssh shardings —
-    shard_batch does — since donation requires input placement to match).
+    the shared params stay undonated), or False. `donate_batch` enforces
+    the consume-once staging contract on the batch/agent-state args
+    (learner.consume_staged_inputs — host-side deletion after dispatch;
+    the stock body has no batch-shaped outputs for XLA-level aliasing).
+
+    `superstep_k > 1` builds the SAME scan wrapper the single-device
+    learner.make_update_superstep uses (learner.superstep_body): one
+    dispatch runs K scanned updates over a [K, T+1, B, ...] stack whose
+    B axis is sharded over `data` — DP-sharded learners amortize
+    dispatch overhead identically to single-device ones. The grad
+    all-reduce happens inside every scan iteration (each scanned update
+    consumes its own full global batch), so K scanned collective updates
+    match K sequential parallel dispatches.
 
     param_shardings (optional): a params-pytree of NamedShardings (see
     parallel/tp.py) to shard weights over the mesh's `model` axis;
     defaults to fully replicated params. Optimizer state follows the same
     sharding (optax state mirrors the params structure leaf-wise).
     """
+    if superstep_k < 1:
+        raise ValueError(f"superstep_k must be >= 1, got {superstep_k}")
     repl = mesh_lib.replicated(mesh)
-    bsh = mesh_lib.batch_sharding(mesh)
-    ssh = mesh_lib.state_sharding(mesh)
+    leading = 1 if superstep_k > 1 else 0
+    bsh = mesh_lib.batch_sharding(mesh, leading_axes=leading)
+    ssh = mesh_lib.state_sharding(mesh, leading_axes=leading)
     psh = repl if param_shardings is None else param_shardings
 
     # The exact single-device update body (incl. the entropy-anneal
     # schedule); only the jit wrapping — shardings + donation — differs.
-    update_step = learner_lib.update_body(model, optimizer, hp)
+    # superstep_k > 1 swaps in the K-scan superstep body, same sharing.
+    if superstep_k > 1:
+        update_step = learner_lib.superstep_body(model, optimizer, hp)
+    else:
+        update_step = learner_lib.update_body(model, optimizer, hp)
 
     # A single NamedSharding acts as a pytree prefix: it applies to every
     # leaf of the batch dict (all leaves are [T+1, B, ...]). Optimizer
@@ -99,7 +116,10 @@ def make_parallel_update_step(
         opt_sh = opt_shardings
     else:
         opt_sh = repl if param_shardings is None else None
-    donate_args = learner_lib.donate_argnums_for(donate, donate_batch)
+    # Batch/state args never reach donate_argnums: the body has no
+    # batch-shaped outputs to alias (learner.consume_staged_inputs
+    # documents the physics), so donate_batch is enforced host-side.
+    donate_args = learner_lib.donate_argnums_for(donate, False)
     if opt_sh is None and 1 in donate_args:
         # Donation aliases the input buffer to the output, which requires
         # input placement == output sharding. With opt placement left to
@@ -111,15 +131,19 @@ def make_parallel_update_step(
             "disabling opt_state donation (pass opt_shardings to donate)."
         )
         donate_args = tuple(a for a in donate_args if a != 1)
-    return jax.jit(
+    jitted = jax.jit(
         update_step,
         in_shardings=(psh, opt_sh, bsh, ssh),
         out_shardings=(psh, opt_sh, repl),
         donate_argnums=donate_args,
     )
+    if donate_batch:
+        return learner_lib.consume_staged_inputs(jitted)
+    return jitted
 
 
-def shard_batch(mesh, batch: Dict[str, np.ndarray], initial_agent_state: Any):
+def shard_batch(mesh, batch: Dict[str, np.ndarray], initial_agent_state: Any,
+                leading_axes: int = 0):
     """Host -> device: place a batch with the DP shardings.
 
     Single-process: jax.device_put splits across local devices. Multi-host
@@ -128,9 +152,13 @@ def shard_batch(mesh, batch: Dict[str, np.ndarray], initial_agent_state: Any):
     jax.make_array_from_process_local_data assembles the global array —
     device_put with a global sharding would fail on non-addressable
     devices.
+
+    `leading_axes=1` places [K, T+1, B, ...] superstep stacks (the B
+    axis stays the sharded one) — must match the superstep_k the update
+    step was jitted with.
     """
-    bsh = mesh_lib.batch_sharding(mesh)
-    ssh = mesh_lib.state_sharding(mesh)
+    bsh = mesh_lib.batch_sharding(mesh, leading_axes=leading_axes)
+    ssh = mesh_lib.state_sharding(mesh, leading_axes=leading_axes)
     if jax.process_count() > 1:
         put_b = lambda v: jax.make_array_from_process_local_data(bsh, v)  # noqa: E731
         put_s = lambda v: jax.make_array_from_process_local_data(ssh, v)  # noqa: E731

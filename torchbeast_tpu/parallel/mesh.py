@@ -107,11 +107,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Time-major [T, B, ...] arrays: shard the batch axis over `data`."""
-    return NamedSharding(mesh, P(None, "data"))
+def batch_sharding(mesh: Mesh, leading_axes: int = 0) -> NamedSharding:
+    """Time-major [T, B, ...] arrays: shard the batch axis over `data`.
+
+    `leading_axes` prepends unsharded axes — 1 for the superstep's
+    [K, T, B, ...] batch stacks, where B is still the sharded axis.
+    """
+    return NamedSharding(mesh, P(*([None] * (leading_axes + 1)), "data"))
 
 
-def state_sharding(mesh: Mesh) -> NamedSharding:
-    """Recurrent state [L, B, H]: shard the batch axis over `data`."""
-    return NamedSharding(mesh, P(None, "data"))
+def state_sharding(mesh: Mesh, leading_axes: int = 0) -> NamedSharding:
+    """Recurrent state [L, B, H]: shard the batch axis over `data`
+    (`leading_axes=1` for [K, L, B, H] superstep stacks)."""
+    return NamedSharding(mesh, P(*([None] * (leading_axes + 1)), "data"))

@@ -211,6 +211,18 @@ def make_parser():
                              "allocating (a corrupt 4-byte header must "
                              "surface as WireError, not a multi-GiB "
                              "allocation).")
+    parser.add_argument("--superstep_k", type=int, default=1,
+                        help="Learner superstep: fuse K SGD updates into "
+                             "ONE lax.scan dispatch — rollouts drain "
+                             "into a preallocated [K, T+1, B, ...] host "
+                             "arena, the prefetcher stages the whole "
+                             "stack as one transfer riding behind the "
+                             "previous superstep's compute, and stats "
+                             "come back [K]-stacked (one host sync per "
+                             "K updates). Bit-identical to K sequential "
+                             "dispatches; schedules tick per-update "
+                             "inside the scan. 1 = today's per-update "
+                             "dispatch. Python runtime only.")
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
     parser.add_argument("--max_actor_reconnects", type=int, default=None,
@@ -256,6 +268,19 @@ def _reap_servers(procs):
 
 def train(flags):
     from torchbeast_tpu.parallel import initialize_distributed
+
+    superstep_k = getattr(flags, "superstep_k", 1)
+    if superstep_k < 1:
+        raise ValueError(
+            f"--superstep_k must be >= 1, got {superstep_k}"
+        )
+    if superstep_k > 1 and flags.native_runtime:
+        # The C++ BatchingQueue has no raw-item intake for the host
+        # batch arena (and the native learner path predates supersteps).
+        raise RuntimeError(
+            "--superstep_k > 1 is not supported with --native_runtime; "
+            "use the Python runtime"
+        )
 
     # No-ops (with a log line) when no coordinator is configured by flag
     # or TORCHBEAST_COORDINATOR env.
@@ -497,6 +522,8 @@ def train(flags):
                 model, optimizer, hp, mesh, donate="opt_only",
                 param_shardings=param_shardings,
                 opt_shardings=opt_shardings,
+                superstep_k=superstep_k,
+                donate_batch=superstep_k > 1,
             )
             if param_shardings is None:
                 params = replicate(mesh, params)
@@ -508,7 +535,10 @@ def train(flags):
                 opt_state = jax.tree_util.tree_map(
                     jax.device_put, opt_state, opt_shardings
                 )
-            shard = lambda b, s: shard_batch(mesh, b, s)  # noqa: E731
+            shard = lambda b, s: shard_batch(  # noqa: E731
+                mesh, b, s,
+                leading_axes=1 if superstep_k > 1 else 0,
+            )
             inner_desc = (
                 (f" x model={tensor_par}" if tensor_par > 1 else "")
                 + (f" x expert={expert_par}" if expert_par > 1 else "")
@@ -520,13 +550,33 @@ def train(flags):
                 flags.num_learner_devices * inner, proc_count,
             )
         else:
-            update_step = learner_lib.make_update_step(
-                model, optimizer, hp, donate="opt_only"
-            )
+            if superstep_k > 1:
+                # One dispatch = K scanned updates; the staged arena
+                # stack is consumed exactly once (consume-once deletion,
+                # learner.consume_staged_inputs).
+                update_step = learner_lib.make_update_superstep(
+                    model, optimizer, hp, superstep_k,
+                    donate="opt_only", donate_batch=True,
+                )
+            else:
+                update_step = learner_lib.make_update_step(
+                    model, optimizer, hp, donate="opt_only"
+                )
             shard = None
         if telemetry_on:
-            # Dispatch latency + batch transfer bytes per update.
-            update_step = learner_lib.instrument_update_step(update_step)
+            # Dispatch latency + batch transfer bytes per update
+            # (counts K updates per superstep dispatch).
+            update_step = learner_lib.instrument_update_step(
+                update_step, superstep_k=superstep_k
+            )
+        count_host_sync = getattr(
+            update_step, "count_host_sync", lambda: None
+        )
+        if superstep_k > 1:
+            log.info(
+                "Learner supersteps: %d updates per dispatch "
+                "(K-batch arena staging)", superstep_k,
+            )
         act_model = model
         if proc_count > 1 and (
             expert_par > 1 or seq_par > 1 or pipe_par > 1
@@ -875,8 +925,27 @@ def train(flags):
                 jax.device_put(initial_agent_state),
             )
 
+        # Superstep mode: rollouts drain straight into the preallocated
+        # [K, T+1, B, ...] host arena (runtime/queues.BatchArena) and the
+        # prefetcher stages ONE K-batch transfer per superstep. Arena
+        # slots are release-fenced: the learner releases each at its
+        # stats flush (completion proven), so pool = prefetch depth + a
+        # filling slot + the two dispatched-unflushed supersteps.
+        prefetch_depth = 2
+        arena = None
+        if superstep_k > 1:
+            from torchbeast_tpu.runtime.queues import BatchArena
+
+            # Same series prefix as the queue: learner_queue.batch_size
+            # keeps reporting assembled update batches across modes
+            # (--no_telemetry already no-ops the global instruments).
+            arena = BatchArena(
+                k=superstep_k, rows=local_rows, batch_dim=1,
+                pool=prefetch_depth + 3, telemetry_name="learner_queue",
+            )
         prefetcher = DevicePrefetcher(
-            learner_queue, _place, depth=2, telemetry_name="prefetch"
+            learner_queue, _place, depth=prefetch_depth,
+            telemetry_name="prefetch", arena=arena,
         )
 
         def learner_loop():
@@ -892,14 +961,22 @@ def train(flags):
             # One-step-delayed stats fetch: device_get on the PREVIOUS update's
             # stats happens after the current one is dispatched, so the host
             # never stalls XLA's async pipeline (the reference's equivalent
-            # overlap came from extra learner threads + a lock).
-            pending = None  # (device_stats, step_after_that_update)
+            # overlap came from extra learner threads + a lock). Under
+            # supersteps each dispatch carries K updates and [K]-stacked
+            # stats, so this ONE delayed sync covers K updates.
+            pending = None  # (device_stats, step_after, arena_release)
 
             def flush(pending_entry):
-                device_stats, at_step = pending_entry
+                device_stats, at_step, release = pending_entry
                 s = learner_lib.episode_stat_postprocess(
                     jax.device_get(device_stats)
                 )
+                count_host_sync()
+                if release is not None:
+                    # Stats arrived => that superstep's execution (which
+                    # read the arena stack) finished: its slot may be
+                    # rewritten now (BatchArena fence contract).
+                    release()
                 s["step"] = at_step
                 s["learner_queue_size"] = learner_queue.size()
                 with state_lock:
@@ -911,11 +988,16 @@ def train(flags):
                 # for a prefetched batch (actor starvation shows up here).
                 timings.reset()
                 try:
-                    batch, initial_agent_state = prefetcher.get(timeout=1.0)
+                    staged = prefetcher.get(timeout=1.0)
                 except stdlib_queue.Empty:
                     if not prefetcher.is_alive():
                         break
                     continue
+                if superstep_k > 1:
+                    (batch, initial_agent_state), release = staged
+                else:
+                    batch, initial_agent_state = staged
+                    release = None
                 timings.time("dequeue")
                 # Dispatch under donation_lock (NOT state_lock): opt_state is
                 # donated, so the dispatch that invalidates the old opt
@@ -937,12 +1019,17 @@ def train(flags):
                     with state_lock:
                         state["params"], state["opt_state"] = new_params, new_opt
                         state["infer_params"] = infer_view
-                        # Global frames: every host ran this collective update.
-                        state["step"] += flags.unroll_length * flags.batch_size
+                        # Global frames: every host ran this collective
+                        # dispatch of superstep_k updates.
+                        state["step"] += (
+                            superstep_k
+                            * flags.unroll_length
+                            * flags.batch_size
+                        )
                         now_step = state["step"]
                 if pending is not None:
                     flush(pending)
-                pending = (train_stats, now_step)
+                pending = (train_stats, now_step, release)
                 timings.time("learn")
                 if now_step >= flags.total_steps:
                     break

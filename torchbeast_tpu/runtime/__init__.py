@@ -9,6 +9,7 @@ hot-path equivalents live under csrc/ and are used when built.
 from torchbeast_tpu.runtime.queues import (  # noqa: F401
     AsyncError,
     Batch,
+    BatchArena,
     BatchingQueue,
     ClosedBatchingQueue,
     DevicePrefetcher,
