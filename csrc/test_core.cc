@@ -439,16 +439,34 @@ void test_env_server() {
   std::printf("env server ok\n");
 }
 
-int main() {
-  test_array_concat_slice();
-  test_nest_ops();
-  test_wire_roundtrip();
-  test_wire_malformed();
-  test_batching_queue();
-  test_batching_queue_timeout_zero();
-  test_queue_stress();
-  test_dynamic_batcher();
-  test_env_server();
-  std::printf("ALL NATIVE CORE TESTS PASSED\n");
+int main(int argc, char** argv) {
+  // Optional substring filter (argv[1]): run only matching tests. Lets
+  // the sanitizer smoke tests exercise the codec/queue paths in
+  // sandboxes where the socket tests cannot run (scripts/build_native.sh
+  // --sanitize=... --filter=...; tests/test_native.py uses it).
+  const char* filter = argc > 1 ? argv[1] : nullptr;
+  auto want = [filter](const char* name) {
+    return filter == nullptr || std::strstr(name, filter) != nullptr;
+  };
+  int ran = 0;
+  if (want("array")) { test_array_concat_slice(); ++ran; }
+  if (want("nest")) { test_nest_ops(); ++ran; }
+  if (want("wire_roundtrip")) { test_wire_roundtrip(); ++ran; }
+  if (want("wire_malformed")) { test_wire_malformed(); ++ran; }
+  if (want("batching_queue")) { test_batching_queue(); ++ran; }
+  if (want("batching_queue_timeout")) { test_batching_queue_timeout_zero(); ++ran; }
+  if (want("queue_stress")) { test_queue_stress(); ++ran; }
+  if (want("dynamic_batcher")) { test_dynamic_batcher(); ++ran; }
+  if (want("env_server")) { test_env_server(); ++ran; }
+  if (ran == 0) {
+    std::fprintf(stderr, "no tests match filter '%s'\n", filter);
+    return 1;
+  }
+  if (filter == nullptr) {
+    std::printf("ALL NATIVE CORE TESTS PASSED\n");
+  } else {
+    std::printf("%d FILTERED NATIVE CORE TESTS PASSED (filter '%s')\n",
+                ran, filter);
+  }
   return 0;
 }

@@ -1,20 +1,71 @@
 #!/bin/bash
 # Build + test the native runtime: C++ unit tests then the Python extension.
-# --tsan additionally runs the C++ tests under ThreadSanitizer (the
-# reference ships no race detection at all, SURVEY.md §5.2).
+#
+# Modes:
+#   (no args)                 O2 build, run all C++ tests, build extension
+#   --tsan                    additionally build+run under ThreadSanitizer
+#                             (kept for backward compatibility)
+#   --sanitize=address        build+run ONLY the sanitized test binary
+#   --sanitize=undefined      (address|undefined|thread); skips the O2
+#   --sanitize=thread         build and the Python extension
+#   --filter=SUBSTR           pass a test-name substring filter through to
+#                             every test_core run (e.g. --filter=wire
+#                             skips the socket tests in sandboxes that
+#                             cannot run them). Applies to the plain,
+#                             --tsan, and --sanitize runs alike.
+#
+# The sanitized binaries land in build/test_core_<sanitizer>; the slow
+# smoke test in tests/test_native.py drives --sanitize=address/undefined
+# with --filter=wire when a toolchain is present.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p build
 
+SANITIZE=""
+FILTER=""
+TSAN=0
+for arg in "$@"; do
+    case "$arg" in
+        --tsan) TSAN=1 ;;
+        --sanitize=*) SANITIZE="${arg#--sanitize=}" ;;
+        --filter=*) FILTER="${arg#--filter=}" ;;
+        *)
+            echo "unknown argument: $arg" >&2
+            exit 2
+            ;;
+    esac
+done
+
+if [[ -n "$SANITIZE" ]]; then
+    case "$SANITIZE" in
+        address|undefined|thread) ;;
+        *)
+            echo "--sanitize must be address, undefined, or thread" >&2
+            exit 2
+            ;;
+    esac
+    echo "== C++ core tests (${SANITIZE} sanitizer)"
+    EXTRA=()
+    if [[ "$SANITIZE" == "undefined" ]]; then
+        # Turn UB findings into hard failures instead of log lines.
+        EXTRA+=(-fno-sanitize-recover=undefined)
+    fi
+    g++ -std=c++17 -O1 -g -Wall -pthread "-fsanitize=${SANITIZE}" \
+        "${EXTRA[@]+"${EXTRA[@]}"}" \
+        csrc/test_core.cc -o "build/test_core_${SANITIZE}"
+    "./build/test_core_${SANITIZE}" ${FILTER:+"$FILTER"}
+    exit 0
+fi
+
 echo "== C++ core tests"
 g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core
-./build/test_core
+./build/test_core ${FILTER:+"$FILTER"}
 
-if [[ "${1:-}" == "--tsan" ]]; then
+if [[ "$TSAN" == 1 ]]; then
     echo "== C++ core tests (ThreadSanitizer)"
     g++ -std=c++17 -O1 -g -Wall -pthread -fsanitize=thread \
         csrc/test_core.cc -o build/test_core_tsan
-    ./build/test_core_tsan
+    ./build/test_core_tsan ${FILTER:+"$FILTER"}
 fi
 
 echo "== Python extension"

@@ -127,6 +127,28 @@ def test_telemetry_selftest_cli():
     assert out["selftest"] == "telemetry" and out["ok"] is True
 
 
+def test_beastlint_selftest_cli():
+    """beastlint's --selftest is the cheap CI guard that every rule
+    still catches its seeded violation and stays silent on the clean
+    twin, and that the suppression/baseline mechanics hold. Schema
+    pinned here so the verdict line can't rot."""
+    proc = _run(["-m", "torchbeast_tpu.analysis", "--selftest"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["selftest"] == "beastlint" and out["ok"] is True
+    assert set(out["rules"]) == {
+        "HOTPATH-SYNC", "JIT-HAZARD", "DONATE-USE", "IMPORT-PURITY",
+        "LOCK-DISCIPLINE", "WIRE-PARITY", "FLAG-PARITY",
+    }
+    for checks in out["rules"].values():
+        assert set(checks) == {"positive", "clean", "isolated"}
+        assert all(checks.values()), out["rules"]
+    assert set(out["mechanics"]) == {
+        "suppression", "suppress_reason", "baseline",
+    }
+    assert all(out["mechanics"].values())
+
+
 def test_wire_bench_selftest(tmp_path):
     """wire_bench --selftest: structural run of every (payload, leg)
     combination with the artifact schema pinned, so the bench can't rot

@@ -208,6 +208,7 @@ def test_donate_batch_superstep_no_warning_and_use_after_free():
     )
 
     # Consume-once: every staged batch leaf is dead after dispatch.
+    # beastlint: disable=DONATE-USE  this test IS the use-after-free pin: reads must raise
     for leaf in jax.tree_util.tree_leaves((staged_b, staged_s)):
         with pytest.raises(RuntimeError, match="deleted"):
             np.asarray(leaf)

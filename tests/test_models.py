@@ -178,6 +178,7 @@ def test_resnet_remat_variants_identical(remat):
             out, _ = model.apply(p, inputs, state, sample_action=False)
             return jnp.sum(out.baseline ** 2) + jnp.sum(out.policy_logits ** 2)
 
+        # beastlint: disable=JIT-HAZARD  per-config closure compared once each; one-shot compile by design
         l, g = jax.jit(jax.value_and_grad(loss))(params)
         outs.append((l, g))
     (l0, g0), (l1, g1) = outs

@@ -87,7 +87,8 @@ def test_expert_parallel_matches_unsharded():
     shardings = expert_param_shardings(mesh, params["params"])
     assert not shardings["w_in"].is_fully_replicated
     assert shardings["router"]["kernel"].is_fully_replicated
-    y_ep = jax.jit(moe_ep.apply)(placed, x)
+    apply_ep = jax.jit(moe_ep.apply)
+    y_ep = apply_ep(placed, x)
     np.testing.assert_allclose(y_ep, y_plain, rtol=1e-5, atol=1e-5)
 
 

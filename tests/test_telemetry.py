@@ -7,7 +7,6 @@ hot path. All CPU-backend tier-1."""
 import http.client
 import json
 import os
-import re
 import subprocess
 import sys
 import threading
@@ -431,17 +430,19 @@ class TestHotPathPurity:
     def test_telemetry_modules_import_no_jax_numpy(self):
         """The telemetry package must stay stdlib-only: a jax/numpy
         import would put device-touching code one refactor away from
-        the acting hot path."""
-        tdir = os.path.join(REPO, "torchbeast_tpu", "telemetry")
-        pattern = re.compile(
-            r"^\s*(import|from)\s+(jax|numpy)\b", re.MULTILINE
+        the acting hot path. The contract's single source of truth is
+        beastlint's IMPORT-PURITY rule (analysis/config.py PURITY);
+        this test just runs that rule over the real package, so the
+        banned-module list can never drift from what CI enforces."""
+        from torchbeast_tpu import analysis
+
+        report = analysis.analyze_paths(
+            ["torchbeast_tpu/telemetry"], root=REPO
         )
-        for fname in os.listdir(tdir):
-            if fname.endswith(".py"):
-                src = open(os.path.join(tdir, fname)).read()
-                assert not pattern.search(src), (
-                    f"{fname} imports jax/numpy"
-                )
+        purity = [
+            f for f in report.findings if f.rule == "IMPORT-PURITY"
+        ]
+        assert not purity, [f.render() for f in purity]
 
     def test_instrumented_hot_path_zero_device_syncs(self):
         """Transfer-guard pin: a full instrumented acting unroll —

@@ -104,16 +104,18 @@ def test_associative_scan_matches_sequential_long_t():
         k: jnp.asarray(v)
         for k, v in _random_inputs(rng, (1024, 2)).items()
     }
-    seq = jax.jit(
+    seq_fn = jax.jit(
         lambda: vtrace.from_importance_weights(
             **inputs, scan_impl="sequential"
         )
-    )()
-    ass = jax.jit(
+    )
+    seq = seq_fn()
+    ass_fn = jax.jit(
         lambda: vtrace.from_importance_weights(
             **inputs, scan_impl="associative"
         )
-    )()
+    )
+    ass = ass_fn()
     np.testing.assert_allclose(ass.vs, seq.vs, rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(
         ass.pg_advantages, seq.pg_advantages, rtol=2e-5, atol=2e-5

@@ -1,0 +1,73 @@
+"""beastlint — repo-native static analysis for torchbeast_tpu.
+
+`python -m torchbeast_tpu.analysis [--json] [--ci] [paths...]` runs the
+rule set over the repo (default: the whole tree) and fails CI at the
+offending file:line. The rules encode the repo's real runtime contracts:
+
+    HOTPATH-SYNC     no implicit device->host syncs in annotated hot paths
+    JIT-HAZARD       no jit/scan construction in loops, no unhashable
+                     static args, no immediately-invoked jit
+    DONATE-USE       no reads of consume-once staged buffers after dispatch
+    IMPORT-PURITY    per-package import allowlists (telemetry/, analysis/)
+    LOCK-DISCIPLINE  `# guarded-by:` attributes only touched under their
+                     lock; no bare .acquire() without try/finally
+    WIRE-PARITY      runtime/wire.py == csrc/{wire,array,client}.h on the
+                     dtype table, frame tags, and kMaxFrameBytes
+    FLAG-PARITY      flags shared by monobeast/polybeast agree on default
+                     and type
+
+See README "Static analysis" for the suppression syntax and how to add a
+rule. The package is stdlib-only by contract (enforced by its own
+IMPORT-PURITY entry).
+"""
+
+from .engine import (  # noqa: F401
+    FileContext,
+    Finding,
+    Report,
+    Suppression,
+    discover_files,
+    load_baseline,
+    load_context,
+    repo_root,
+    run_rules,
+    write_baseline,
+)
+from .parity import REPO_RULES  # noqa: F401
+from .rules import FILE_RULES  # noqa: F401
+
+ALL_RULE_NAMES = (
+    {r.name for r in FILE_RULES}
+    | {r.name for r in REPO_RULES}
+    | {"SUPPRESS-REASON"}
+)
+
+
+def analyze_source(source: str, path: str = "snippet.py", rules=None):
+    """Lint a source string (fixture tests / selftest). Suppression and
+    hygiene mechanics apply exactly as in a real run."""
+    ctx = FileContext(path, source)
+    report = run_rules(
+        [ctx],
+        rules if rules is not None else FILE_RULES,
+        [],
+        root="/",
+        known_rules=ALL_RULE_NAMES,
+    )
+    return report
+
+
+def analyze_paths(paths, root=None, baseline_path=None):
+    """Lint files/directories on disk with the full rule set."""
+    root = root or repo_root()
+    files = discover_files(paths, root)
+    contexts = [c for c in (load_context(f, root) for f in files) if c]
+    baseline = load_baseline(baseline_path)
+    return run_rules(
+        contexts,
+        FILE_RULES,
+        REPO_RULES,
+        root=root,
+        baseline=baseline,
+        known_rules=ALL_RULE_NAMES,
+    )

@@ -1,0 +1,113 @@
+"""beastlint CLI.
+
+    python -m torchbeast_tpu.analysis                  lint the whole repo
+    python -m torchbeast_tpu.analysis --ci             CI gate: terse, exit 1
+                                                       on any new finding
+    python -m torchbeast_tpu.analysis --json [paths]   machine output
+    python -m torchbeast_tpu.analysis --selftest       fixture verdict JSON
+    python -m torchbeast_tpu.analysis --write-baseline grandfather current
+                                                       findings (the repo's
+                                                       committed baseline is
+                                                       empty — keep it that
+                                                       way)
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import analyze_paths
+from .engine import repo_root, write_baseline
+from .parity import REPO_RULES
+from .rules import FILE_RULES
+
+DEFAULT_BASELINE = ".beastlint-baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchbeast_tpu.analysis",
+        description="beastlint: repo-native static analysis",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="Files/directories to lint (default: repo "
+                             "root; parity rules need the default scope).")
+    parser.add_argument("--json", action="store_true",
+                        help="Emit one JSON document instead of text.")
+    parser.add_argument("--ci", action="store_true",
+                        help="CI gate mode: same checks and exit code, "
+                             "plus a final machine-greppable "
+                             "'beastlint-ci: PASS|FAIL' verdict line.")
+    parser.add_argument("--selftest", action="store_true",
+                        help="Run the embedded rule fixtures and print a "
+                             "JSON verdict.")
+    parser.add_argument("--baseline", default=None,
+                        help=f"Baseline file (default: <repo>/"
+                             f"{DEFAULT_BASELINE}).")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="Write current findings to the baseline file "
+                             "and exit 0.")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="Print the rule set and exit.")
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        from .selftest import main as selftest_main
+
+        return selftest_main()
+
+    if args.list_rules:
+        for rule in (*FILE_RULES, *REPO_RULES):
+            lines = (rule.__doc__ or "").strip().splitlines()
+            print(f"{rule.name:16s} {lines[0] if lines else ''}")
+        return 0
+
+    root = repo_root()
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    paths = args.paths or ["."]
+
+    t0 = time.perf_counter()
+    try:
+        report = analyze_paths(
+            paths, root=root,
+            baseline_path=None if args.write_baseline else baseline_path,
+        )
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        print(f"beastlint: internal error: {e}", file=sys.stderr)
+        return 2
+    report.elapsed_s = round(time.perf_counter() - t0, 3)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, report.findings)
+        print(
+            f"beastlint: wrote {len(report.findings)} fingerprint(s) to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    verdict = "FAIL" if report.findings else "PASS"
+    if args.json:
+        doc = report.as_dict()
+        if args.ci:
+            doc["ci"] = verdict
+        print(json.dumps(doc))
+    else:
+        for f in report.findings:
+            print(f.render())
+        print(
+            f"beastlint: {len(report.findings)} finding(s), "
+            f"{len(report.suppressed)} suppressed, "
+            f"{len(report.baselined)} baselined; "
+            f"{report.files_scanned} files in {report.elapsed_s:.2f}s"
+        )
+        if args.ci:
+            print(f"beastlint-ci: {verdict}")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""beastlint repo configuration: which contracts bind which paths.
+
+This file is the declarative half of the analyzer — rules read it, the
+repo edits it. Everything here is data, so adding a package to a purity
+contract or a flag to the parity exemptions is a one-line diff reviewed
+like any other contract change.
+"""
+
+# Per-package banned top-level imports (IMPORT-PURITY). Keys are
+# repo-relative directory prefixes; values are module roots that must
+# never be imported anywhere under that prefix.
+#
+# telemetry/: stdlib-only so instrumentation can never introduce a device
+# sync (replaces the PR 2 source-pin test as the single source of truth).
+# analysis/: the linter itself must run in a bare-CI image and must never
+# import the runtime it analyzes.
+_HEAVY = (
+    "jax",
+    "jaxlib",
+    "numpy",
+    "np",
+    "torch",
+    "optax",
+    "ml_dtypes",
+    "chex",
+    "flax",
+    "tensorflow",
+)
+PURITY = {
+    "torchbeast_tpu/telemetry": _HEAVY,
+    "torchbeast_tpu/analysis": _HEAVY + ("torchbeast_tpu",),
+}
+
+# WIRE-PARITY anchors: the Python codec and its C++ mirrors.
+WIRE_PY = "torchbeast_tpu/runtime/wire.py"
+WIRE_H = "csrc/wire.h"
+ARRAY_H = "csrc/array.h"
+CLIENT_H = "csrc/client.h"
+POLYBEAST_PY = "torchbeast_tpu/polybeast.py"
+
+# C++ DType enumerator -> numpy dtype name (the dtype table's rosetta
+# stone; WIRE-PARITY fails if either side has a code the other lacks).
+CPP_DTYPE_TO_NUMPY = {
+    "kU8": "uint8",
+    "kI8": "int8",
+    "kI32": "int32",
+    "kI64": "int64",
+    "kF32": "float32",
+    "kF64": "float64",
+    "kBool": "bool",
+    "kU16": "uint16",
+    "kI16": "int16",
+    "kU32": "uint32",
+    "kU64": "uint64",
+    "kF16": "float16",
+    "kBF16": "bfloat16",
+}
+
+# Ground-truth itemsizes (bytes) per wire dtype: both languages' tables
+# are checked against this, so a wrong size on either side is a finding
+# even when the two sides agree with each other.
+DTYPE_ITEMSIZE = {
+    "uint8": 1,
+    "int8": 1,
+    "bool": 1,
+    "uint16": 2,
+    "int16": 2,
+    "float16": 2,
+    "bfloat16": 2,
+    "int32": 4,
+    "uint32": 4,
+    "float32": 4,
+    "int64": 8,
+    "uint64": 8,
+    "float64": 8,
+}
+
+# FLAG-PARITY anchors: drivers whose shared flags must agree on type and
+# default. Intentional divergences carry inline suppressions at the
+# add_argument site (with the reason), not entries here — the exemption
+# should live next to the flag it exempts.
+FLAG_PARITY_FILES = (
+    "torchbeast_tpu/monobeast.py",
+    "torchbeast_tpu/polybeast.py",
+)

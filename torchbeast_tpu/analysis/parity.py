@@ -1,0 +1,433 @@
+"""beastlint repo-level rules: cross-language wire parity and cross-driver
+flag parity.
+
+Both rules are TEXTUAL: the C++ headers are parsed with regexes scoped to
+the specific declaration shapes this repo uses (constexpr tag constants,
+the DType enum, the itemsize switch), and the Python side is parsed from
+the AST without importing it. That keeps the analyzer runnable in an image
+with no compiler and no jax/numpy — and means a parity break fails lint in
+the same run that would have shipped it, instead of waiting for the
+cross-language fuzz tests to execute both stacks.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import config
+from .engine import FileContext, Finding
+
+# ---------------------------------------------------------------------------
+# C++ parsing helpers
+
+
+def _fold_cpp_int(expr: str) -> Optional[int]:
+    """Evaluate `256ull * 1024 * 1024`-style constant expressions."""
+    cleaned = re.sub(r"(?i)(?<=\d)(ull|ll|ul|u|l)\b", "", expr)
+    cleaned = cleaned.replace("'", "")  # C++14 digit separators
+    if not re.fullmatch(r"[0-9xXa-fA-F\s*+\-()<>]+", cleaned):
+        return None
+    try:
+        return int(eval(cleaned, {"__builtins__": {}}, {}))  # noqa: S307
+    except Exception:
+        return None
+
+
+def _norm_tag(name: str) -> str:
+    """Case/underscore-insensitive tag identity: TAG_NP_SCALAR (py) and
+    kTagNpScalar (C++) both normalize to NPSCALAR."""
+    return name.upper().replace("_", "")
+
+
+def parse_cpp_tags(wire_h: str) -> Dict[str, int]:
+    """kTagArray = 0x01 -> {'ARRAY': 1}."""
+    out: Dict[str, int] = {}
+    for m in re.finditer(
+        r"constexpr\s+uint8_t\s+kTag(\w+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)\s*;",
+        wire_h,
+    ):
+        out[_norm_tag(m.group(1))] = int(m.group(2), 0)
+    return out
+
+
+def parse_cpp_max_frame(src: str) -> Optional[int]:
+    m = re.search(
+        r"constexpr\s+size_t\s+kMaxFrameBytes\s*=\s*([^;]+);", src
+    )
+    return _fold_cpp_int(m.group(1)) if m else None
+
+
+def parse_cpp_dtype_enum(array_h: str) -> Dict[str, int]:
+    """enum class DType entries -> {'kU8': 0, ...}."""
+    m = re.search(
+        r"enum\s+class\s+DType\s*:\s*uint8_t\s*\{(.*?)\};", array_h,
+        re.DOTALL,
+    )
+    if not m:
+        return {}
+    out: Dict[str, int] = {}
+    for entry in re.finditer(r"(k\w+)\s*=\s*(\d+)", m.group(1)):
+        out[entry.group(1)] = int(entry.group(2))
+    return out
+
+
+def parse_cpp_itemsizes(array_h: str) -> Dict[str, int]:
+    """The itemsize() switch -> {'kU8': 1, ...}."""
+    m = re.search(
+        r"inline\s+size_t\s+itemsize\s*\(.*?\)\s*\{(.*?)\n\}", array_h,
+        re.DOTALL,
+    )
+    if not m:
+        return {}
+    out: Dict[str, int] = {}
+    pending: List[str] = []
+    for line in m.group(1).splitlines():
+        case = re.search(r"case\s+DType::(k\w+)\s*:", line)
+        if case:
+            pending.append(case.group(1))
+        ret = re.search(r"return\s+(\d+)\s*;", line)
+        if ret and pending:
+            for name in pending:
+                out[name] = int(ret.group(1))
+            pending = []
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Python (AST) parsing helpers
+
+
+def _fold_py_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left = _fold_py_int(node.left)
+        right = _fold_py_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Pow):
+            return left ** right
+        if isinstance(node.op, ast.LShift):
+            return left << right
+    return None
+
+
+def _np_dtype_name(call: ast.AST) -> Optional[str]:
+    """np.dtype(np.uint8) / np.dtype(_bfloat16) -> numpy dtype name."""
+    if not (
+        isinstance(call, ast.Call)
+        and isinstance(call.func, ast.Attribute)
+        and call.func.attr == "dtype"
+        and call.args
+    ):
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Attribute):
+        name = arg.attr
+    elif isinstance(arg, ast.Name):
+        name = arg.id
+    else:
+        return None
+    name = name.lstrip("_")
+    return {"bool_": "bool"}.get(name, name)
+
+
+def parse_py_wire(tree: ast.Module) -> Tuple[
+    Dict[str, int], Optional[int], Dict[str, int]
+]:
+    """(TAG_* map, DEFAULT_MAX_FRAME_BYTES, dtype-name -> code)."""
+    tags: Dict[str, int] = {}
+    max_frame: Optional[int] = None
+    codes: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Name):
+            if target.id.startswith("TAG_"):
+                value = _fold_py_int(node.value)
+                if value is not None:
+                    tags[_norm_tag(target.id[4:])] = value
+            elif target.id == "DEFAULT_MAX_FRAME_BYTES":
+                max_frame = _fold_py_int(node.value)
+            elif target.id == "_DTYPE_CODES" and isinstance(
+                node.value, ast.Dict
+            ):
+                for k, v in zip(node.value.keys, node.value.values):
+                    name = _np_dtype_name(k)
+                    code = _fold_py_int(v)
+                    if name is not None and code is not None:
+                        codes[name] = code
+        elif isinstance(target, ast.Subscript):
+            # _DTYPE_CODES[np.dtype(_bfloat16)] = 12 (the guarded
+            # ml_dtypes registration).
+            base = target.value
+            if isinstance(base, ast.Name) and base.id == "_DTYPE_CODES":
+                key = target.slice
+                name = _np_dtype_name(key)
+                code = _fold_py_int(node.value)
+                if name is not None and code is not None:
+                    codes[name] = code
+    return tags, max_frame, codes
+
+
+def _find_add_argument_default(
+    tree: ast.Module, flag: str
+) -> Tuple[Optional[ast.AST], Optional[int]]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == flag
+        ):
+            for kw in node.keywords:
+                if kw.arg == "default":
+                    return kw.value, node.lineno
+            return None, node.lineno
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# WIRE-PARITY
+
+
+def check_wire_parity(
+    py_ctx: FileContext,
+    wire_h: str,
+    array_h: str,
+    client_h: str,
+    poly_ctx: Optional[FileContext],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    path = py_ctx.path
+
+    def finding(line: int, msg: str, at: str = ""):
+        findings.append(Finding("WIRE-PARITY", at or path, line, msg))
+
+    tags_py, max_frame_py, codes_py = parse_py_wire(py_ctx.tree)
+    tags_cpp = parse_cpp_tags(wire_h)
+    max_frame_cpp = parse_cpp_max_frame(wire_h)
+    enum_cpp = parse_cpp_dtype_enum(array_h)
+    sizes_cpp = parse_cpp_itemsizes(array_h)
+
+    # Parse failures are findings, not silence: an unparseable header
+    # means the contract is no longer being checked.
+    if not tags_py or not codes_py or max_frame_py is None:
+        finding(1, "could not parse TAG_*/_DTYPE_CODES/"
+                   "DEFAULT_MAX_FRAME_BYTES from runtime/wire.py — "
+                   "WIRE-PARITY cannot verify the codec")
+        return findings
+    if not tags_cpp or not enum_cpp or not sizes_cpp:
+        finding(1, "could not parse kTag*/DType/itemsize from csrc "
+                   "headers — WIRE-PARITY cannot verify the codec")
+        return findings
+
+    # 1. Frame tag constants.
+    for name in sorted(tags_py.keys() | tags_cpp.keys()):
+        py_v, cpp_v = tags_py.get(name), tags_cpp.get(name)
+        if py_v is None:
+            finding(1, f"csrc/wire.h defines kTag{name.title()}={cpp_v} "
+                       "but wire.py has no matching TAG_ constant")
+        elif cpp_v is None:
+            finding(1, f"wire.py defines TAG_{name}={py_v} but "
+                       "csrc/wire.h has no matching kTag constant")
+        elif py_v != cpp_v:
+            finding(1, f"frame tag {name}: wire.py says {py_v:#x}, "
+                       f"csrc/wire.h says {cpp_v:#x}")
+
+    # 2. Dtype code table (both directions) + itemsize ground truth.
+    codes_cpp: Dict[str, int] = {}
+    for cpp_name, code in enum_cpp.items():
+        np_name = config.CPP_DTYPE_TO_NUMPY.get(cpp_name)
+        if np_name is None:
+            finding(1, f"csrc/array.h DType::{cpp_name} has no numpy "
+                       "mapping in analysis/config.py "
+                       "CPP_DTYPE_TO_NUMPY — add one")
+            continue
+        codes_cpp[np_name] = code
+    for name in sorted(codes_py.keys() | codes_cpp.keys()):
+        py_c, cpp_c = codes_py.get(name), codes_cpp.get(name)
+        if py_c is None:
+            finding(1, f"dtype {name!r} (code {cpp_c}) exists in "
+                       "csrc/array.h but not in wire.py _DTYPE_CODES")
+        elif cpp_c is None:
+            finding(1, f"dtype {name!r} (code {py_c}) exists in wire.py "
+                       "_DTYPE_CODES but not in csrc/array.h DType")
+        elif py_c != cpp_c:
+            finding(1, f"dtype {name!r}: wire.py code {py_c} != "
+                       f"csrc/array.h code {cpp_c}")
+        expected = config.DTYPE_ITEMSIZE.get(name)
+        if expected is None and (py_c is not None or cpp_c is not None):
+            finding(1, f"dtype {name!r} missing from "
+                       "analysis/config.py DTYPE_ITEMSIZE ground truth")
+    for cpp_name, size in sizes_cpp.items():
+        np_name = config.CPP_DTYPE_TO_NUMPY.get(cpp_name)
+        expected = config.DTYPE_ITEMSIZE.get(np_name or "")
+        if expected is not None and size != expected:
+            finding(1, f"csrc/array.h itemsize({cpp_name}) = {size}, "
+                       f"expected {expected} for {np_name}")
+    for cpp_name in enum_cpp:
+        if cpp_name not in sizes_cpp:
+            finding(1, f"csrc/array.h itemsize() has no case for "
+                       f"DType::{cpp_name} — decoding that code throws")
+
+    # 3. Max frame bytes: wire.py default == csrc constant, and the C++
+    # frame reader actually enforces it.
+    if max_frame_cpp is None:
+        finding(1, "could not parse kMaxFrameBytes from csrc/wire.h")
+    elif max_frame_cpp != max_frame_py:
+        finding(1, f"DEFAULT_MAX_FRAME_BYTES={max_frame_py} (wire.py) != "
+                   f"kMaxFrameBytes={max_frame_cpp} (csrc/wire.h)")
+    if client_h and "kMaxFrameBytes" not in client_h:
+        finding(1, "csrc/client.h never references kMaxFrameBytes — the "
+                   "C++ frame reader is not enforcing the frame bound")
+
+    # 4. The driver flag default must resolve to the same constant.
+    if poly_ctx is not None:
+        default, line = _find_add_argument_default(
+            poly_ctx.tree, "--max_frame_bytes"
+        )
+        if line is None:
+            finding(1, "polybeast.py no longer defines --max_frame_bytes",
+                    at=poly_ctx.path)
+        elif isinstance(default, ast.Constant):
+            if default.value != max_frame_py:
+                finding(line, f"--max_frame_bytes default {default.value} "
+                              f"!= wire.DEFAULT_MAX_FRAME_BYTES "
+                              f"{max_frame_py}", at=poly_ctx.path)
+        elif default is None or (
+            not isinstance(default, ast.Attribute)
+            or default.attr != "DEFAULT_MAX_FRAME_BYTES"
+        ):
+            finding(line or 1, "--max_frame_bytes default should be "
+                               "wire.DEFAULT_MAX_FRAME_BYTES (or its "
+                               "literal value) so py/C++ stay in lockstep",
+                    at=poly_ctx.path)
+    return findings
+
+
+class WireParityRule:
+    """WIRE-PARITY: runtime/wire.py == csrc/ on tags, dtypes, frame bound."""
+
+    name = "WIRE-PARITY"
+
+    def check_repo(
+        self, root: str, contexts: Sequence[FileContext]
+    ) -> List[Finding]:
+        by_path = {ctx.path: ctx for ctx in contexts}
+        py_ctx = by_path.get(config.WIRE_PY)
+        if py_ctx is None:
+            return []  # partial scan (explicit paths): parity not in scope
+
+        def read(rel: str) -> str:
+            p = os.path.join(root, rel)
+            try:
+                with open(p, encoding="utf-8", errors="replace") as f:
+                    return f.read()
+            except OSError:
+                return ""
+
+        wire_h = read(config.WIRE_H)
+        array_h = read(config.ARRAY_H)
+        client_h = read(config.CLIENT_H)
+        if not wire_h or not array_h:
+            return [
+                Finding(
+                    self.name, config.WIRE_PY, 1,
+                    "csrc/wire.h or csrc/array.h missing — the C++ side "
+                    "of the wire contract is gone",
+                )
+            ]
+        return check_wire_parity(
+            py_ctx, wire_h, array_h, client_h,
+            by_path.get(config.POLYBEAST_PY),
+        )
+
+
+# ---------------------------------------------------------------------------
+# FLAG-PARITY
+
+
+def _collect_flags(ctx: FileContext) -> Dict[str, dict]:
+    """--flag -> {type, default, action, line} (unparsed expr text)."""
+    out: Dict[str, dict] = {}
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("--")
+        ):
+            continue
+        spec = {"type": "", "default": "", "action": "", "line": node.lineno}
+        for kw in node.keywords:
+            if kw.arg in ("type", "default", "action"):
+                spec[kw.arg] = ast.unparse(kw.value)
+        # Normalize cross-module constant spellings so
+        # `wire.DEFAULT_MAX_FRAME_BYTES` == `DEFAULT_MAX_FRAME_BYTES` —
+        # but only for identifier chains (a float literal like `0.1`
+        # must not lose its integer part).
+        if re.fullmatch(r"[A-Za-z_][\w.]*", spec["default"] or ""):
+            spec["default"] = spec["default"].split(".")[-1]
+        out[node.args[0].value] = spec
+    return out
+
+
+def check_flag_parity(
+    ctx_a: FileContext, ctx_b: FileContext
+) -> List[Finding]:
+    """Shared flags must agree on type, default, and action. Findings
+    anchor at the SECOND file's add_argument line (one finding per flag),
+    so one inline suppression there exempts an intentional divergence."""
+    flags_a = _collect_flags(ctx_a)
+    flags_b = _collect_flags(ctx_b)
+    findings: List[Finding] = []
+    for flag in sorted(flags_a.keys() & flags_b.keys()):
+        a, b = flags_a[flag], flags_b[flag]
+        diffs = [
+            f"{field} {a[field] or '<unset>'!r} (in {ctx_a.path}) vs "
+            f"{b[field] or '<unset>'!r}"
+            for field in ("type", "default", "action")
+            if a[field] != b[field]
+        ]
+        if diffs:
+            findings.append(
+                Finding(
+                    "FLAG-PARITY", ctx_b.path, b["line"],
+                    f"flag {flag} diverges between drivers: "
+                    + "; ".join(diffs),
+                )
+            )
+    return findings
+
+
+class FlagParityRule:
+    """FLAG-PARITY: monobeast/polybeast shared flags agree on type+default."""
+
+    name = "FLAG-PARITY"
+
+    def check_repo(
+        self, root: str, contexts: Sequence[FileContext]
+    ) -> List[Finding]:
+        by_path = {ctx.path: ctx for ctx in contexts}
+        path_a, path_b = config.FLAG_PARITY_FILES
+        ctx_a, ctx_b = by_path.get(path_a), by_path.get(path_b)
+        if ctx_a is None or ctx_b is None:
+            return []  # partial scan: parity not in scope
+        return check_flag_parity(ctx_a, ctx_b)
+
+
+REPO_RULES = [WireParityRule(), FlagParityRule()]

@@ -1,0 +1,834 @@
+"""beastlint per-file rules.
+
+Each rule encodes one of this repo's real runtime contracts (see ISSUE 5 /
+README "Static analysis"). Rules are deliberately conservative: they prefer
+missing a violation over flagging correct code, because every finding fails
+CI — escape hatches are the inline `# beastlint: disable=RULE  reason`
+suppressions, not lax rules.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from . import config
+from .engine import FileContext, Finding
+
+# Names whose attribute chains indicate device/traced values. `lax` is
+# included because `from jax import lax` is the repo idiom.
+_DEVICE_ROOTS = {"jax", "jnp", "lax"}
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an Attribute/Call/Subscript chain."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, (ast.Call, ast.Subscript)):
+            node = node.func if isinstance(node, ast.Call) else node.value
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted text of a Name/Attribute chain ('' when not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _iter_defs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class HotpathSyncRule:
+    """HOTPATH-SYNC: implicit device->host syncs in annotated hot paths.
+
+    Hot regions are functions annotated `# beastlint: hot` (or every
+    function of a `# beastlint: hot-module` module). Within one:
+
+    - `.item()` forces a device sync — always flagged (numpy `.item()` in
+      a hot path is at best a refactor away from a device array).
+    - `float()/int()/bool()/np.asarray()/np.array()` on a DEVICE-TAINTED
+      value: a name assigned (in the same function) from a jax/jnp/lax
+      expression, or derived from one. Host-only conversions (wire codec
+      scalars, shapes) never taint, so hot-annotating a pure-host module
+      is free.
+    - `print()` — stdout in a per-step path is either a device-array
+      print (a sync) or hot-loop IO; both belong in telemetry.
+
+    Explicit syncs (`jax.device_get`, `np.asarray` on host data) pass:
+    the contract bans *implicit* syncs, not data movement.
+    """
+
+    name = "HOTPATH-SYNC"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        hot_defs = []
+        for node in _iter_defs(ctx.tree):
+            if ctx.is_hot_def(node):
+                hot_defs.append(node)
+        # Nested defs of a hot def are hot too; analyze each hot def as
+        # one region (its own taint scope) and skip nested re-analysis.
+        seen: Set[int] = set()
+        for node in hot_defs:
+            if id(node) in seen:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    seen.add(id(sub))
+            findings.extend(self._check_region(ctx, node))
+        return findings
+
+    def _check_region(self, ctx: FileContext, fn: ast.AST) -> List[Finding]:
+        tainted = self._taint(fn)
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "item" and (
+                not node.args and not node.keywords
+            ):
+                out.append(
+                    Finding(
+                        self.name, ctx.path, node.lineno,
+                        f"`.item()` on `{_attr_chain(func.value) or '<expr>'}`"
+                        " forces a device->host sync in a hot path",
+                    )
+                )
+                continue
+            if isinstance(func, ast.Name) and func.id == "print":
+                out.append(
+                    Finding(
+                        self.name, ctx.path, node.lineno,
+                        "print() in a hot path (device-array prints sync; "
+                        "use telemetry counters/histograms)",
+                    )
+                )
+                continue
+            target = None
+            if (
+                isinstance(func, ast.Name)
+                and func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+            ):
+                target = node.args[0]
+                desc = f"{func.id}()"
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("asarray", "array")
+                and _root_name(func) in ("np", "numpy")
+                and node.args
+            ):
+                target = node.args[0]
+                desc = f"np.{func.attr}()"
+            if target is not None and self._is_device(target, tainted):
+                out.append(
+                    Finding(
+                        self.name, ctx.path, node.lineno,
+                        f"{desc} on device value "
+                        f"`{_attr_chain(target) or ast.dump(target)[:40]}` "
+                        "is an implicit device->host sync in a hot path "
+                        "(use an explicit jax.device_get at a fetch "
+                        "boundary)",
+                    )
+                )
+        return out
+
+    def _taint(self, fn: ast.AST) -> Set[str]:
+        """Names assigned from jax/jnp/lax-rooted expressions, with
+        propagation through derived assignments (two fixpoint passes:
+        enough for straight-line and one level of forward reference)."""
+        tainted: Set[str] = set()
+        for _ in range(2):
+            before = len(tainted)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                    value = node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets = [node.target]
+                    value = node.value
+                else:
+                    continue
+                if self._is_device(value, tainted):
+                    for t in targets:
+                        for name_node in ast.walk(t):
+                            if isinstance(name_node, ast.Name):
+                                tainted.add(name_node.id)
+            if len(tainted) == before:
+                break
+        return tainted
+
+    # jax.* namespaces that do HOST work (pytree plumbing, dtype
+    # metadata): rooted there does not make a value device-resident.
+    _HOST_JAX_NAMESPACES = {"tree_util", "tree", "dtypes", "typing"}
+
+    # Calls that RETURN host values regardless of their (device)
+    # arguments — `jax.device_get` is the explicit fetch this rule's
+    # findings recommend, so its result must not re-taint.
+    _HOST_RETURNING_CALLS = {"jax.device_get"}
+
+    def _is_device(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        node = expr
+        if isinstance(node, ast.Call):
+            if _attr_chain(node.func) in self._HOST_RETURNING_CALLS:
+                return False  # prune: host result, args don't leak out
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+        if isinstance(node, ast.Attribute):
+            chain = _attr_chain(node)
+            parts = chain.split(".") if chain else []
+            if parts:
+                if parts[0] in ("jnp", "lax"):
+                    return True
+                if parts[0] == "jax" and len(parts) > 1 and (
+                    parts[1] not in self._HOST_JAX_NAMESPACES
+                ):
+                    return True
+        return any(
+            self._is_device(child, tainted)
+            for child in ast.iter_child_nodes(node)
+        )
+
+
+def _is_jit_ctor(node: ast.Call, jax_imports: Set[str]) -> Optional[str]:
+    """'jit'/'pmap'/'scan' when `node` constructs/launches compiled code."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        chain = _attr_chain(func)
+        if chain in ("jax.jit", "jax.pmap"):
+            return func.attr
+        if chain in ("lax.scan", "jax.lax.scan"):
+            return "scan"
+        return None
+    if isinstance(func, ast.Name) and func.id in jax_imports:
+        return func.id
+    return None
+
+
+class JitHazardRule:
+    """JIT-HAZARD: recompilation traps around jax.jit / lax.scan.
+
+    - jit/pmap/scan constructed inside a `for`/`while` body: each
+      iteration builds a fresh traced callable => a fresh compile cache
+      entry => recompilation every pass.
+    - Immediately-invoked `jax.jit(f)(x)`: the wrapper (and its cache)
+      dies with the statement, so every execution recompiles.
+    - `static_argnums`/`static_argnames` pointing at a parameter whose
+      default is an unhashable literal (list/dict/set): hashing the
+      static arg raises at call time.
+    """
+
+    name = "JIT-HAZARD"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        jax_imports: Set[str] = set()
+        module_defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax" or node.module.startswith("jax.")
+            ):
+                for alias in node.names:
+                    if alias.name in ("jit", "pmap"):
+                        jax_imports.add(alias.asname or alias.name)
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                module_defs[node.name] = node
+        findings: List[Finding] = []
+        self._walk(ctx, ctx.tree, 0, jax_imports, module_defs, findings)
+        return findings
+
+    def _walk(self, ctx, node, loop_depth, jax_imports, module_defs,
+              findings) -> None:
+        for child in ast.iter_child_nodes(node):
+            depth = loop_depth
+            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                depth += 1
+            if isinstance(child, ast.Call):
+                kind = _is_jit_ctor(child, jax_imports)
+                if kind is not None:
+                    if loop_depth > 0:
+                        findings.append(
+                            Finding(
+                                self.name, ctx.path, child.lineno,
+                                f"{kind} constructed inside a loop: every "
+                                "iteration traces and compiles afresh "
+                                "(hoist the construction out of the loop)",
+                            )
+                        )
+                    self._check_static_args(
+                        ctx, child, module_defs, findings
+                    )
+                # jax.jit(f)(...) — wrapper discarded after one call.
+                inner = child.func
+                if isinstance(inner, ast.Call):
+                    ikind = _is_jit_ctor(inner, jax_imports)
+                    if ikind in ("jit", "pmap"):
+                        findings.append(
+                            Finding(
+                                self.name, ctx.path, child.lineno,
+                                f"immediately-invoked jax.{ikind}(...)(...):"
+                                " the compiled wrapper (and its cache) is "
+                                "discarded after this call — bind it once",
+                            )
+                        )
+            self._walk(ctx, child, depth, jax_imports, module_defs, findings)
+
+    def _check_static_args(self, ctx, call, module_defs, findings) -> None:
+        static_nums: List[int] = []
+        static_names: List[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                static_nums = self._int_elts(kw.value)
+            elif kw.arg == "static_argnames":
+                static_names = self._str_elts(kw.value)
+        if not static_nums and not static_names:
+            return
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return
+        fn = module_defs.get(call.args[0].id)
+        if fn is None:
+            return
+        args = fn.args.args
+        defaults = fn.args.defaults
+        default_by_name: Dict[str, ast.AST] = {}
+        for arg, default in zip(args[len(args) - len(defaults):], defaults):
+            default_by_name[arg.arg] = default
+        suspects = list(static_names) + [
+            a.arg for i, a in enumerate(args) if i in static_nums
+        ]
+        for pname in suspects:
+            default = default_by_name.get(pname)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                findings.append(
+                    Finding(
+                        self.name, ctx.path, call.lineno,
+                        f"static arg {pname!r} of {call.args[0].id!r} "
+                        "defaults to an unhashable "
+                        f"{type(default).__name__.lower()} literal — "
+                        "jit static args must be hashable",
+                    )
+                )
+
+    @staticmethod
+    def _int_elts(node: ast.AST) -> List[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [
+                e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+        return []
+
+    @staticmethod
+    def _str_elts(node: ast.AST) -> List[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [
+                e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+        return []
+
+
+class DonateUseRule:
+    """DONATE-USE: reads of a consumed (host-deleted) staging binding.
+
+    The consume-once donation contract (learner.consume_staged_inputs,
+    PR 4): a staged device pytree is `.delete()`d at dispatch; touching
+    it afterwards raises "Array has been deleted" at runtime — this rule
+    moves that failure to lint time. Consumption events:
+
+    - `x.delete()` consumes `x`.
+    - calling a name bound from `consume_staged_inputs(...)` (or a
+      `make_*_update_step/superstep(..., donate_batch=True)` factory)
+      consumes its batch/state arguments (positions 2+, matching
+      `wrapped(params, opt_state, batch, initial_agent_state)`).
+
+    Any later read of a consumed name — along ANY branch — flags, until
+    the name is rebound. Loop bodies get a second pass seeded with the
+    end-of-body consumed set, so a back-edge read-after-delete is caught
+    while `x.delete(); x = next(...)` rebinding stays clean.
+    """
+
+    name = "DONATE-USE"
+
+    _CONSUMER_FACTORIES = {"consume_staged_inputs"}
+    _DONATING_FACTORIES = {
+        "make_update_superstep",
+        "make_parallel_update_step",
+    }
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in _iter_defs(ctx.tree):
+            consumers = self._consumer_names(fn)
+            state: Dict[str, int] = {}
+            dedupe: Set = set()
+            self._scan(ctx, fn.body, state, consumers, findings, dedupe)
+        return findings
+
+    def _consumer_names(self, fn: ast.AST) -> Set[str]:
+        """Local names bound to a consuming update callable."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            callee = value.func
+            fname = (
+                callee.id if isinstance(callee, ast.Name)
+                else callee.attr if isinstance(callee, ast.Attribute)
+                else ""
+            )
+            consuming = fname in self._CONSUMER_FACTORIES
+            if fname in self._DONATING_FACTORIES:
+                for kw in value.keywords:
+                    if kw.arg == "donate_batch" and isinstance(
+                        kw.value, ast.Constant
+                    ) and kw.value.value is True:
+                        consuming = True
+            if consuming:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    # -- statement interpreter ---------------------------------------------
+
+    def _scan(self, ctx, stmts, consumed, consumers, findings, dedupe):
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                self._expr(ctx, stmt.test, consumed, consumers, findings,
+                           dedupe)
+                branch_a = dict(consumed)
+                branch_b = dict(consumed)
+                self._scan(ctx, stmt.body, branch_a, consumers, findings,
+                           dedupe)
+                self._scan(ctx, stmt.orelse, branch_b, consumers, findings,
+                           dedupe)
+                consumed.clear()
+                consumed.update(branch_b)
+                consumed.update(branch_a)  # any-path union
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, ast.While):
+                    self._expr(ctx, stmt.test, consumed, consumers,
+                               findings, dedupe)
+                else:
+                    self._expr(ctx, stmt.iter, consumed, consumers,
+                               findings, dedupe)
+                    self._unbind(stmt.target, consumed)
+                before = dict(consumed)
+                self._scan(ctx, stmt.body, consumed, consumers, findings,
+                           dedupe)
+                if consumed.keys() - before.keys():
+                    # Back-edge pass: reads at the loop top see the
+                    # previous iteration's consumptions — but a for
+                    # target is rebound by the iteration itself, so it
+                    # re-enters the body clean.
+                    back = dict(consumed)
+                    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        self._unbind(stmt.target, back)
+                    self._scan(ctx, stmt.body, back, consumers, findings,
+                               dedupe)
+                self._scan(ctx, stmt.orelse, consumed, consumers, findings,
+                           dedupe)
+            elif isinstance(stmt, ast.Try):
+                body_state = dict(consumed)
+                self._scan(ctx, stmt.body, body_state, consumers, findings,
+                           dedupe)
+                merged = dict(body_state)
+                for handler in stmt.handlers:
+                    h_state = dict(consumed)
+                    h_state.update(body_state)
+                    self._scan(ctx, handler.body, h_state, consumers,
+                               findings, dedupe)
+                    merged.update(h_state)
+                self._scan(ctx, stmt.orelse, merged, consumers, findings,
+                           dedupe)
+                self._scan(ctx, stmt.finalbody, merged, consumers, findings,
+                           dedupe)
+                consumed.clear()
+                consumed.update(merged)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._expr(ctx, item.context_expr, consumed, consumers,
+                               findings, dedupe)
+                    if item.optional_vars is not None:
+                        self._unbind(item.optional_vars, consumed)
+                self._scan(ctx, stmt.body, consumed, consumers, findings,
+                           dedupe)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(stmt, "value", None)
+                if value is not None:
+                    self._expr(ctx, value, consumed, consumers, findings,
+                               dedupe)
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    self._unbind(t, consumed)
+            elif isinstance(stmt, (ast.Expr, ast.Return, ast.Raise,
+                                   ast.Assert, ast.Delete)):
+                for value in ast.iter_child_nodes(stmt):
+                    self._expr(ctx, value, consumed, consumers, findings,
+                               dedupe)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # nested scopes analyzed separately
+            else:
+                for value in ast.iter_child_nodes(stmt):
+                    if isinstance(value, ast.expr):
+                        self._expr(ctx, value, consumed, consumers,
+                                   findings, dedupe)
+
+    @staticmethod
+    def _unbind(target: ast.AST, consumed: Dict[str, int]) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                consumed.pop(node.id, None)
+
+    def _expr(self, ctx, expr, consumed, consumers, findings, dedupe):
+        if expr is None or not isinstance(expr, ast.AST):
+            return
+        consuming_now: List[str] = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "delete"
+                    and isinstance(func.value, ast.Name)
+                ):
+                    consuming_now.append(func.value.id)
+                elif isinstance(func, ast.Name) and func.id in consumers:
+                    for arg in node.args[2:]:
+                        if isinstance(arg, ast.Name):
+                            consuming_now.append(arg.id)
+        # Flag reads BEFORE registering this statement's consumptions
+        # (the consuming call's own argument read is legal).
+        skip = set(consuming_now)
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in consumed
+                and node.id not in skip
+            ):
+                key = (node.id, node.lineno)
+                if key not in dedupe:
+                    dedupe.add(key)
+                    findings.append(
+                        Finding(
+                            self.name, ctx.path, node.lineno,
+                            f"`{node.id}` read after being consumed/"
+                            f"deleted at line {consumed[node.id]} "
+                            "(consume-once donation: the device buffer "
+                            "is gone)",
+                        )
+                    )
+        for name in consuming_now:
+            consumed[name] = expr.lineno if hasattr(expr, "lineno") else 0
+
+
+class ImportPurityRule:
+    """IMPORT-PURITY: per-package import allowlists (config.PURITY).
+
+    `telemetry/` must stay stdlib-only so instrumentation can never add a
+    device sync to a hot path (this rule replaces the hand-rolled
+    source-pin test from PR 2); `analysis/` itself is held to the same
+    bar so the linter runs without the runtime's dependencies.
+    """
+
+    name = "IMPORT-PURITY"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        denied = None
+        for prefix, mods in config.PURITY.items():
+            if ctx.path.startswith(prefix + "/") or ctx.path == prefix:
+                denied = set(mods)
+                break
+        if denied is None:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            names: List[str] = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                names = [node.module or ""]
+            elif isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain in ("importlib.import_module", "__import__") and (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    names = [node.args[0].value]
+            for mod in names:
+                top = mod.split(".")[0]
+                if top in denied:
+                    findings.append(
+                        Finding(
+                            self.name, ctx.path, node.lineno,
+                            f"import of {top!r} violates the "
+                            "declared purity contract for this package "
+                            "(see analysis/config.py PURITY)",
+                        )
+                    )
+        return findings
+
+
+class LockDisciplineRule:
+    """LOCK-DISCIPLINE: `# guarded-by: self._lock` annotations.
+
+    An attribute annotated guarded-by may only be loaded/stored inside a
+    `with` on the named lock — or a Condition constructed FROM that lock
+    (holding `self._not_empty` built as `Condition(self._lock)` holds
+    `self._lock`). `__init__` is exempt (no concurrent readers exist yet);
+    helper methods documented `# beastlint: holds self._lock` start with
+    the lock held. Separately, a bare `.acquire()` whose very next
+    statement is not `try/.../finally: .release()` flags everywhere —
+    an exception between acquire and release deadlocks the process.
+    """
+
+    name = "LOCK-DISCIPLINE"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._check_class(ctx, node, findings)
+        self._check_bare_acquire(ctx, ctx.tree, findings)
+        return findings
+
+    # -- guarded attributes -------------------------------------------------
+
+    def _check_class(self, ctx, cls, findings) -> None:
+        guarded: Dict[str, str] = {}  # attr -> lock attr name
+        acquires: Dict[str, Set[str]] = {}  # with-target attr -> held attrs
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                target = node.targets[0] if node.targets else None
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target  # self._x: Dict[...] = {} form
+            else:
+                continue
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                continue
+            attr = target.attr
+            # Trailing on the assignment line, or STANDALONE just above
+            # (a trailing comment on the previous statement must not
+            # leak onto this one).
+            annotation = ctx.guarded_annotations.get(node.lineno)
+            if annotation is None and ctx.comment_only(node.lineno - 1):
+                annotation = ctx.guarded_annotations.get(node.lineno - 1)
+            if annotation is not None:
+                lock_attr = annotation.split(".")[-1]
+                guarded[attr] = lock_attr
+            value = node.value
+            if value is not None and isinstance(value, ast.Call):
+                chain = _attr_chain(value.func)
+                base = chain.split(".")[-1]
+                if base in ("Lock", "RLock"):
+                    acquires[attr] = {attr}
+                elif base == "Condition":
+                    held = {attr}
+                    if value.args:
+                        inner = value.args[0]
+                        if (
+                            isinstance(inner, ast.Attribute)
+                            and isinstance(inner.value, ast.Name)
+                            and inner.value.id == "self"
+                        ):
+                            held.add(inner.attr)
+                        elif isinstance(inner, ast.Call):
+                            pass  # Condition(Lock()): private lock
+                    acquires[attr] = held
+        # A lock/condition attribute is never itself "guarded": touching
+        # it IS how you acquire it.
+        for lock_attr in acquires:
+            guarded.pop(lock_attr, None)
+        if not guarded:
+            return
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if method.name == "__init__":
+                continue
+            held: Set[str] = set()
+            holds = ctx.holds_annotation(method)
+            if holds:
+                attr = holds.split(".")[-1]
+                held |= acquires.get(attr, {attr})
+            self._walk_method(
+                ctx, method.body, guarded, acquires, set(held), findings
+            )
+
+    def _walk_method(self, ctx, stmts, guarded, acquires, held,
+                     findings) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                new_held = set(held)
+                for item in stmt.items:
+                    expr = item.context_expr
+                    if (
+                        isinstance(expr, ast.Attribute)
+                        and isinstance(expr.value, ast.Name)
+                        and expr.value.id == "self"
+                    ):
+                        new_held |= acquires.get(expr.attr, {expr.attr})
+                    self._check_exprs(
+                        ctx, [expr], guarded, held, findings
+                    )
+                self._walk_method(
+                    ctx, stmt.body, guarded, acquires, new_held, findings
+                )
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested def: conservatively analyzed with the CURRENT
+                # held set (closures usually run synchronously under the
+                # enclosing with; a deferred closure needs a suppression).
+                self._walk_method(
+                    ctx, stmt.body, guarded, acquires, set(held), findings
+                )
+            else:
+                # Generic compound statements: recurse into statement
+                # lists (incl. except-handler bodies) as STATEMENTS so
+                # nested `with` blocks keep their held-lock semantics;
+                # everything else is checked as an expression.
+                for _, value in ast.iter_fields(stmt):
+                    if isinstance(value, list) and value:
+                        if isinstance(value[0], ast.stmt):
+                            self._walk_method(
+                                ctx, value, guarded, acquires, held,
+                                findings,
+                            )
+                        elif isinstance(value[0], ast.excepthandler):
+                            for handler in value:
+                                if handler.type is not None:
+                                    self._check_exprs(
+                                        ctx, [handler.type], guarded,
+                                        held, findings,
+                                    )
+                                self._walk_method(
+                                    ctx, handler.body, guarded, acquires,
+                                    held, findings,
+                                )
+                        else:
+                            self._check_exprs(
+                                ctx,
+                                [v for v in value
+                                 if isinstance(v, ast.expr)],
+                                guarded, held, findings,
+                            )
+                    elif isinstance(value, ast.expr):
+                        self._check_exprs(
+                            ctx, [value], guarded, held, findings
+                        )
+
+    def _check_exprs(self, ctx, exprs, guarded, held, findings) -> None:
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in guarded
+                ):
+                    lock = guarded[node.attr]
+                    if lock not in held:
+                        findings.append(
+                            Finding(
+                                self.name, ctx.path, node.lineno,
+                                f"`self.{node.attr}` is guarded-by "
+                                f"`self.{lock}` but accessed without "
+                                "holding it",
+                            )
+                        )
+
+    # -- bare acquire -------------------------------------------------------
+
+    def _check_bare_acquire(self, ctx, tree, findings) -> None:
+        for node in ast.walk(tree):
+            body = getattr(node, "body", None)
+            if not isinstance(body, list):
+                continue
+            for seq_name in ("body", "orelse", "finalbody"):
+                seq = getattr(node, seq_name, None)
+                if not isinstance(seq, list):
+                    continue
+                for i, stmt in enumerate(seq):
+                    receiver = self._acquire_receiver(stmt)
+                    if receiver is None:
+                        continue
+                    nxt = seq[i + 1] if i + 1 < len(seq) else None
+                    if self._is_release_try(nxt, receiver):
+                        continue
+                    findings.append(
+                        Finding(
+                            self.name, ctx.path, stmt.lineno,
+                            f"bare `{receiver}.acquire()` not immediately "
+                            "followed by try/finally release — an "
+                            "exception here leaks the lock (prefer "
+                            "`with`)",
+                        )
+                    )
+
+    @staticmethod
+    def _acquire_receiver(stmt: ast.AST) -> Optional[str]:
+        if not isinstance(stmt, ast.Expr):
+            return None
+        call = stmt.value
+        if not (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"
+        ):
+            return None
+        return _attr_chain(call.func.value) or None
+
+    @staticmethod
+    def _is_release_try(stmt: Optional[ast.AST], receiver: str) -> bool:
+        if not isinstance(stmt, ast.Try) or not stmt.finalbody:
+            return False
+        for node in ast.walk(ast.Module(body=stmt.finalbody,
+                                        type_ignores=[])):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"
+                and _attr_chain(node.func.value) == receiver
+            ):
+                return True
+        return False
+
+
+FILE_RULES = [
+    HotpathSyncRule(),
+    JitHazardRule(),
+    DonateUseRule(),
+    ImportPurityRule(),
+    LockDisciplineRule(),
+]

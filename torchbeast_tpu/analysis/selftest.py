@@ -1,0 +1,332 @@
+"""beastlint --selftest: every rule must catch its seeded violation and
+stay silent on the clean twin, and the suppression/baseline mechanics must
+hold. Runs from embedded fixtures (no repo state touched), prints one JSON
+verdict line — the cheap CI guard that the analyzer itself still works
+(same pattern as `python -m torchbeast_tpu.telemetry --selftest`).
+"""
+
+import json
+import time
+
+from . import ALL_RULE_NAMES, analyze_source
+from .engine import FileContext, run_rules
+from .parity import check_flag_parity, check_wire_parity
+from .rules import FILE_RULES
+
+# --------------------------------------------------------------------------
+# Per-rule fixture pairs. Each positive seeds >= 1 violation of exactly its
+# rule; each clean twin exercises the same constructs legally.
+
+_HOTPATH_POSITIVE = '''
+import jax.numpy as jnp
+
+# beastlint: hot
+def act(env):
+    logits = jnp.tanh(env)
+    loss = float(logits.mean())
+    print(loss)
+    return logits.item()
+'''
+
+_HOTPATH_CLEAN = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# beastlint: hot
+def act(env, n):
+    logits = jnp.tanh(env)
+    rows = int(n)
+    host = jax.device_get(logits)
+    return np.asarray(rows), host
+
+def cold(x):
+    return float(jnp.mean(x))
+'''
+
+_JIT_POSITIVE = '''
+import jax
+
+def train(steps, f, x):
+    for _ in range(steps):
+        step = jax.jit(f)
+        x = step(x)
+    return jax.jit(f)(x)
+'''
+
+_JIT_CLEAN = '''
+import jax
+
+def train(steps, f, x):
+    step = jax.jit(f)
+    for _ in range(steps):
+        x = step(x)
+    return x
+'''
+
+_DONATE_POSITIVE = '''
+def drive(update, params, opt, batch, state, cond):
+    wrapped = consume_staged_inputs(update)
+    out = wrapped(params, opt, batch, state)
+    if cond:
+        tail = batch.mean()
+    else:
+        tail = 0.0
+    state.delete()
+    return out, tail, state
+'''
+
+_DONATE_CLEAN = '''
+def drive(update, params, opt, batch, state, queue):
+    wrapped = consume_staged_inputs(update)
+    scale = batch.mean()
+    out = wrapped(params, opt, batch, state)
+    batch = queue.get()
+    return out, scale, batch.shape
+'''
+
+_PURITY_POSITIVE = '''
+import json
+import numpy as np
+'''
+
+_PURITY_CLEAN = '''
+import json
+import threading
+'''
+
+_LOCK_POSITIVE = '''
+import threading
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: self._lock
+
+    def size(self):
+        return len(self._items)
+
+def busy(lock, work):
+    lock.acquire()
+    work()
+    lock.release()
+'''
+
+_LOCK_CLEAN = '''
+import threading
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._items = []  # guarded-by: self._lock
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def pop(self):
+        with self._not_empty:
+            return self._items.pop()
+
+    # beastlint: holds self._lock
+    def _drain_locked(self):
+        self._items.clear()
+
+def busy(lock, work):
+    lock.acquire()
+    try:
+        work()
+    finally:
+        lock.release()
+'''
+
+_SUPPRESSED = '''
+import jax.numpy as jnp
+
+# beastlint: hot
+def act(env):
+    logits = jnp.tanh(env)
+    return logits.item()  # beastlint: disable=HOTPATH-SYNC  fixture: intended sync
+'''
+
+_REASONLESS = '''
+import jax.numpy as jnp
+
+# beastlint: hot
+def act(env):
+    logits = jnp.tanh(env)
+    return logits.item()  # beastlint: disable=HOTPATH-SYNC
+'''
+
+# -- wire-parity fixtures ---------------------------------------------------
+
+_WIRE_PY = '''
+import numpy as np
+
+TAG_ARRAY = 0x01
+TAG_LIST = 0x02
+
+DEFAULT_MAX_FRAME_BYTES = 16 * 1024
+
+_DTYPE_CODES = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.float32): 4,
+}
+'''
+
+_WIRE_H_CLEAN = """
+constexpr uint8_t kTagArray = 0x01;
+constexpr uint8_t kTagList = 0x02;
+constexpr size_t kMaxFrameBytes = 16ull * 1024;
+"""
+
+_WIRE_H_DRIFTED = """
+constexpr uint8_t kTagArray = 0x01;
+constexpr uint8_t kTagList = 0x09;
+constexpr size_t kMaxFrameBytes = 8ull * 1024;
+"""
+
+_ARRAY_H = """
+enum class DType : uint8_t {
+  kU8 = 0,
+  kF32 = 4,
+};
+
+inline size_t itemsize(DType dtype) {
+  switch (dtype) {
+    case DType::kU8:
+      return 1;
+    case DType::kF32:
+      return 4;
+  }
+  throw std::invalid_argument("unknown dtype");
+}
+"""
+
+_CLIENT_H = """
+if (length > wire::kMaxFrameBytes) throw WireError("too big");
+"""
+
+# -- flag-parity fixtures ---------------------------------------------------
+
+_FLAGS_A = '''
+def parse(parser):
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--learning_rate", type=float, default=0.1)
+    parser.add_argument("--mono_only", type=str, default="x")
+'''
+
+_FLAGS_B_CLEAN = '''
+def parse(parser):
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--learning_rate", type=float, default=0.1)
+    parser.add_argument("--poly_only", type=int, default=3)
+'''
+
+_FLAGS_B_DRIFTED = '''
+def parse(parser):
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--learning_rate", type=str, default=0.1)
+'''
+
+
+def run_selftest() -> dict:
+    t0 = time.perf_counter()
+    rules: dict = {}
+
+    pairs = {
+        "HOTPATH-SYNC": (_HOTPATH_POSITIVE, _HOTPATH_CLEAN, "snippet.py"),
+        "JIT-HAZARD": (_JIT_POSITIVE, _JIT_CLEAN, "snippet.py"),
+        "DONATE-USE": (_DONATE_POSITIVE, _DONATE_CLEAN, "snippet.py"),
+        "IMPORT-PURITY": (
+            _PURITY_POSITIVE,
+            _PURITY_CLEAN,
+            "torchbeast_tpu/telemetry/fixture.py",
+        ),
+        "LOCK-DISCIPLINE": (_LOCK_POSITIVE, _LOCK_CLEAN, "snippet.py"),
+    }
+    for name, (positive, clean, path) in pairs.items():
+        pos_report = analyze_source(positive, path=path)
+        clean_report = analyze_source(clean, path=path)
+        rules[name] = {
+            "positive": any(f.rule == name for f in pos_report.findings),
+            "clean": not any(
+                f.rule == name for f in clean_report.findings
+            ),
+            # The seeded violation must be the ONLY rule firing: a noisy
+            # fixture would hide a rule bleeding into its neighbors.
+            "isolated": all(
+                f.rule == name for f in pos_report.findings
+            ),
+        }
+
+    wire_ctx = FileContext("torchbeast_tpu/runtime/wire.py", _WIRE_PY)
+    drifted = check_wire_parity(
+        wire_ctx, _WIRE_H_DRIFTED, _ARRAY_H, _CLIENT_H, None
+    )
+    clean = check_wire_parity(
+        wire_ctx, _WIRE_H_CLEAN, _ARRAY_H, _CLIENT_H, None
+    )
+    rules["WIRE-PARITY"] = {
+        "positive": len(drifted) >= 2,  # tag drift AND frame-bound drift
+        "clean": not clean,
+        "isolated": all(f.rule == "WIRE-PARITY" for f in drifted),
+    }
+
+    ctx_a = FileContext("monobeast.py", _FLAGS_A)
+    drifted = check_flag_parity(
+        ctx_a, FileContext("polybeast.py", _FLAGS_B_DRIFTED)
+    )
+    clean = check_flag_parity(
+        ctx_a, FileContext("polybeast.py", _FLAGS_B_CLEAN)
+    )
+    rules["FLAG-PARITY"] = {
+        "positive": len(drifted) == 2,  # one default drift + one type drift
+        "clean": not clean,
+        "isolated": all(f.rule == "FLAG-PARITY" for f in drifted),
+    }
+
+    # -- mechanics ---------------------------------------------------------
+    sup_report = analyze_source(_SUPPRESSED)
+    reasonless_report = analyze_source(_REASONLESS)
+    positive_report = analyze_source(_HOTPATH_POSITIVE)
+    baseline = {f.fingerprint for f in positive_report.findings}
+    baselined_report = run_rules(
+        [FileContext("snippet.py", _HOTPATH_POSITIVE)],
+        FILE_RULES,
+        [],
+        root="/",
+        baseline=baseline,
+        known_rules=ALL_RULE_NAMES,
+    )
+    mechanics = {
+        "suppression": (
+            not sup_report.findings and len(sup_report.suppressed) == 1
+        ),
+        "suppress_reason": any(
+            f.rule == "SUPPRESS-REASON" for f in reasonless_report.findings
+        ),
+        "baseline": (
+            not baselined_report.findings
+            and len(baselined_report.baselined)
+            == len(positive_report.findings)
+        ),
+    }
+
+    ok = all(
+        all(checks.values()) for checks in rules.values()
+    ) and all(mechanics.values())
+    return {
+        "selftest": "beastlint",
+        "ok": ok,
+        "rules": rules,
+        "mechanics": mechanics,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def main() -> int:
+    verdict = run_selftest()
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1

@@ -286,6 +286,7 @@ def entropy_schedule(hp: HParams):
     return entropy_cost_at
 
 
+# beastlint: hot
 def update_body(model, optimizer: optax.GradientTransformation, hp: HParams):
     """The UNJITTED learner step:
 
@@ -335,6 +336,7 @@ def make_update_step(
     )
 
 
+# beastlint: hot
 def superstep_body(
     model, optimizer: optax.GradientTransformation, hp: HParams
 ):
@@ -374,6 +376,7 @@ def superstep_body(
     return superstep
 
 
+# beastlint: hot
 def consume_staged_inputs(update_fn):
     """Wrap an update step so the staged batch/agent-state device arrays
     are DELETED right after dispatch — the host-side half of batch
@@ -464,6 +467,7 @@ def stack_superstep_columns(
     )
 
 
+# beastlint: hot
 def instrument_update_step(update_step, registry=None, superstep_k=1):
     """Wrap a (jitted) update step with learner-side telemetry:
 
@@ -515,6 +519,7 @@ def instrument_update_step(update_step, registry=None, superstep_k=1):
     return wrapped
 
 
+# beastlint: hot
 def act_body(model, params, rng, env_output, agent_state):
     """Unjitted T=1 acting step on `[B, ...]` env outputs: adds/strips the
     time axis around the time-major model. Shared by make_act_step (jitted
@@ -527,6 +532,7 @@ def act_body(model, params, rng, env_output, agent_state):
     return out, new_state
 
 
+# beastlint: hot
 def make_act_step(model):
     """Build the jitted batched acting step.
 

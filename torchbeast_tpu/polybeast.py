@@ -67,6 +67,7 @@ def _configure_logging():
 def make_parser():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--pipes_basename", default="unix:/tmp/torchbeast_tpu")
+    # beastlint: disable=FLAG-PARITY  poly derives the default from --num_servers; mono has no servers
     parser.add_argument("--num_actors", type=int, default=None,
                         help="Actor loops (default: one per server).")
     parser.add_argument("--num_servers", type=int, default=4)
@@ -92,6 +93,7 @@ def make_parser():
                              "lax.associative_scan (O(log T) depth - "
                              "the long-unroll/long-context choice).")
     parser.add_argument("--unroll_length", type=int, default=80)
+    # beastlint: disable=FLAG-PARITY  paper defaults differ: polybeast trains the deep IMPALA net, monobeast the shallow one
     parser.add_argument("--model", default="deep",
                         choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer", "pipelined_transformer"])
     parser.add_argument("--use_lstm", action="store_true")

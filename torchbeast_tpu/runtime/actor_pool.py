@@ -87,8 +87,8 @@ class ActorPool:
         # (the partial rollout is discarded; learner batches stay valid).
         # Deterministic env errors (error frames) remain fatal.
         self._max_reconnects = max_reconnects
-        self._count = 0
-        self._reconnects = 0
+        self._count = 0  # guarded-by: self._count_lock
+        self._reconnects = 0  # guarded-by: self._count_lock
         self._count_lock = threading.Lock()
         self._errors: List[BaseException] = []
         # Per-connection wire accounting + request RTT (ISSUE 2).

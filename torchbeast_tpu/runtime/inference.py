@@ -22,6 +22,8 @@ Two state regimes:
   can never race a real slot's update.
 """
 
+# beastlint: hot-module — every function here sits on the per-batch serving path.
+
 import logging
 import threading
 import time

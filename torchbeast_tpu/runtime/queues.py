@@ -113,9 +113,10 @@ class BatchingQueue:
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
-        self._deque = collections.deque()  # (inputs, payload, rows)
-        self._closed = False
-        self._num_enqueued = 0
+        # (inputs, payload, rows) items  # guarded-by: self._lock
+        self._deque = collections.deque()
+        self._closed = False  # guarded-by: self._lock
+        self._num_enqueued = 0  # guarded-by: self._lock
 
     def name(self):
         return type(self).__name__
@@ -128,6 +129,7 @@ class BatchingQueue:
         with self._lock:
             return self._num_enqueued
 
+    # beastlint: hot
     def enqueue(self, inputs: Any, payload: Any = None):
         leaves = nest.flatten(inputs)
         if self._check_inputs:
@@ -177,6 +179,7 @@ class BatchingQueue:
         with self._lock:
             return self._closed
 
+    # beastlint: hot
     def dequeue_many(self) -> Tuple[Any, List[Any]]:
         """Block for >= minimum_batch_size rows (or any rows after
         timeout); return (batched nest, payloads). Up to
@@ -223,6 +226,7 @@ class BatchingQueue:
         payloads = [it[1] for it in items]
         return _concat_nests(inputs, self._batch_dim), payloads
 
+    # beastlint: hot
     def dequeue_item(self) -> Tuple[Any, int]:
         """One raw (inputs, rows) item in FIFO order, blocking until an
         item arrives; StopIteration once the queue is closed. The
@@ -370,6 +374,7 @@ class BatchArena:
             arrays.append(np.empty([self._k] + shape, leaf.dtype))
         slot.arrays = arrays
 
+    # beastlint: hot
     def assemble_from(self, queue: "BatchingQueue"):
         """Fill the next free arena with K batches of `rows` columns
         drained from `queue`; returns (stacked_nest, release). Raises
@@ -452,6 +457,7 @@ class Batch:
     def get_inputs(self) -> Any:
         return self._inputs
 
+    # beastlint: hot
     def set_outputs(self, outputs: Any):
         if self._outputs_set:
             raise RuntimeError("set_outputs called twice")
@@ -586,6 +592,7 @@ class DevicePrefetcher:
             except StopIteration:
                 return
 
+    # beastlint: hot
     def _run(self):
         import logging
 
@@ -706,6 +713,7 @@ class DynamicBatcher:
     def is_closed(self) -> bool:
         return self._queue.is_closed()
 
+    # beastlint: hot
     def compute(self, inputs: Any, trace=None) -> Any:
         """Blocking request/response: returns this caller's output rows.
 
@@ -736,6 +744,7 @@ class DynamicBatcher:
     def __iter__(self):
         return self
 
+    # beastlint: hot
     def __next__(self) -> Batch:
         batch_inputs, payloads = self._queue.dequeue_many()
         promises = [p[0] for p in payloads]

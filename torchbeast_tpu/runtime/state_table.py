@@ -40,6 +40,8 @@ Layout/contract notes:
   behind a rollout-boundary fetch.
 """
 
+# beastlint: hot-module — the table dispatch runs once per acting batch.
+
 import threading
 import time
 from typing import Any, Callable, Optional

@@ -29,6 +29,10 @@ next recv on the same transport (ring frames are released, and the
 RecvBuffer is overwritten, at the next recv call).
 """
 
+# beastlint: hot-module — send/recv run per env step per connection.
+# (No locks here by design: each transport is single-threaded per
+# connection, so LOCK-DISCIPLINE has nothing to guard.)
+
 import logging
 import socket
 import struct

@@ -56,6 +56,8 @@ Frames are bounded by `max_frame_bytes` (default 256 MiB): a corrupt
 (mirrored in csrc/wire.h's kMaxFrameBytes).
 """
 
+# beastlint: hot-module — the codec runs per message on the acting path.
+
 import io
 import socket
 import struct

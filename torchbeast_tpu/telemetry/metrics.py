@@ -371,9 +371,13 @@ class MetricsRegistry:
     def __init__(self, gated: bool = False):
         self._gated = gated
         self._lock = threading.Lock()
-        self._instruments: Dict[str, object] = {}
+        self._instruments: Dict[str, object] = {}  # guarded-by: self._lock
 
     def _get_or_create(self, name: str, cls):
+        # Double-checked locking: the lock-free first read is re-checked
+        # under the lock before any mutation; dict reads are atomic
+        # under the GIL, and instruments are never removed or replaced.
+        # beastlint: disable=LOCK-DISCIPLINE  racy fast-path read is re-validated under self._lock below
         inst = self._instruments.get(name)
         if inst is None:
             with self._lock:
@@ -398,6 +402,8 @@ class MetricsRegistry:
         return self._get_or_create(name, Histogram)
 
     def instruments(self) -> Dict[str, object]:
+        # Snapshot copy; same GIL-atomic read as the fast path above.
+        # beastlint: disable=LOCK-DISCIPLINE  read-only snapshot of a grow-only dict; GIL-atomic
         return dict(self._instruments)
 
 
