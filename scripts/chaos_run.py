@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Chaos acceptance harness (ISSUE 6): run polybeast under a seeded
+multi-fault plan and PROVE recovery, not just survival.
+
+Two in-process polybeast runs on the same config:
+
+  1. baseline — fault-free,
+  2. chaos    — a seeded FaultPlan firing >=3 fault classes mid-run
+                (env-server SIGKILL, transport sever, state-table
+                poison by default),
+
+then assert:
+
+  - the chaos run completes (reaches --total_steps, health != HALTED),
+  - learning is intact: final mean episode return matches the
+    fault-free baseline within --return_tol,
+  - recovery telemetry counters EXACTLY equal the injected fault
+    counts (server restarts == SIGKILLs, actor reconnects ==
+    SIGKILLs + severs with the 1:1 actor/server topology, inference
+    restarts == table rebuilds == poisons),
+  - nothing leaked: no live child processes, no new /dev/shm segments.
+
+`--selftest` is the CPU CI gate (Mock env, short run, schema-pinned in
+tests/test_bench_scripts.py); the default mode is the Catch acceptance
+run whose artifact is committed under benchmarks/artifacts/.
+
+Usage:
+  python scripts/chaos_run.py --selftest
+  python scripts/chaos_run.py --out benchmarks/artifacts/chaos_run.json
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SHM_DIR = "/dev/shm"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--selftest", action="store_true",
+                   help="Short structural run on Mock (the CI gate).")
+    p.add_argument("--env", default="Catch")
+    p.add_argument("--total_steps", type=int, default=60000)
+    p.add_argument("--num_servers", type=int, default=4)
+    p.add_argument("--num_actors", type=int, default=4,
+                   help="Keep == num_servers: the 1:1 actor/server "
+                        "topology is what makes reconnect accounting "
+                        "exact (1 per SIGKILL).")
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--unroll_length", type=int, default=20)
+    p.add_argument("--learning_rate", type=float, default=2e-3)
+    p.add_argument("--entropy_cost", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=7,
+                   help="FaultPlan seed + --env_seed for both runs.")
+    p.add_argument("--return_tol", type=float, default=0.2,
+                   help="Allowed |chaos - baseline| final-return gap.")
+    p.add_argument("--savedir", default=None,
+                   help="Default: a fresh temp dir.")
+    p.add_argument("--out", default=None,
+                   help="Also write the JSON verdict here.")
+    return p.parse_args(argv)
+
+
+def build_plan(args) -> dict:
+    """>=3 fault classes, step-triggered at fractions of the run so the
+    pipeline is warm at injection time. With num_actors == num_servers
+    every server feeds exactly one actor, which is what makes the
+    reconnect accounting exact (1 reconnect per SIGKILL, 1 per sever)."""
+    t = args.total_steps
+    return {
+        "seed": args.seed,
+        "faults": [
+            {"kind": "env_server_sigkill", "at_step": int(t * 0.2),
+             "target": 0},
+            {"kind": "transport_sever", "at_step": int(t * 0.45),
+             "target": args.num_actors - 1},
+            {"kind": "state_table_poison", "at_step": int(t * 0.7)},
+        ],
+    }
+
+
+def make_flags(args, savedir, xpid, chaos_plan_path=None):
+    from torchbeast_tpu import polybeast
+
+    argv = [
+        "--env", args.env,
+        "--model", "mlp",
+        "--use_lstm",  # the state table only exists for recurrent models
+        "--num_servers", str(args.num_servers),
+        "--num_actors", str(args.num_actors),
+        "--batch_size", str(args.batch_size),
+        "--unroll_length", str(args.unroll_length),
+        "--total_steps", str(args.total_steps),
+        "--learning_rate", str(args.learning_rate),
+        "--entropy_cost", str(args.entropy_cost),
+        "--env_seed", str(args.seed),
+        "--savedir", savedir,
+        "--xpid", xpid,
+        # shm rings so the SIGKILL class also exercises the segment
+        # sweep (the no-leak assertion below would catch a regression).
+        "--pipes_basename", f"shm:{savedir}/pipes-{xpid}",
+        "--num_inference_threads", "1",
+        "--max_inference_batch_size", "4",
+        "--checkpoint_interval_s", "100000",
+        # A wedged chaos run should fail THIS harness quickly, not
+        # after the default 5-minute stall deadline.
+        "--learner_stall_timeout_s", "60",
+    ]
+    if chaos_plan_path is not None:
+        argv += ["--chaos_plan", chaos_plan_path]
+    return polybeast.make_parser().parse_args(argv)
+
+
+def final_return(savedir, xpid):
+    """Last non-empty mean_episode_return from the run's logs.csv (the
+    in-memory stats dict can miss it when the final flush window closed
+    no episode)."""
+    import csv
+
+    path = os.path.join(savedir, xpid, "logs.csv")
+    last = None
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            val = row.get("mean_episode_return")
+            if val:
+                last = float(val)
+    return last
+
+
+def _shm_entries():
+    if not os.path.isdir(SHM_DIR):
+        return set()
+    return {n for n in os.listdir(SHM_DIR) if n.startswith("psm_")}
+
+
+def _live_children():
+    return {p.pid for p in mp.active_children() if p.is_alive()}
+
+
+def run_one(args, savedir, xpid, chaos_plan_path=None):
+    """One polybeast run with leak accounting and a counter delta."""
+    from torchbeast_tpu import polybeast, telemetry
+
+    shm_before = _shm_entries()
+    procs_before = _live_children()
+    snap_before = telemetry.snapshot()
+    t0 = time.monotonic()
+    flags = make_flags(args, savedir, xpid, chaos_plan_path)
+    stats = polybeast.train(flags)
+    elapsed = time.monotonic() - t0
+    counters = telemetry.delta(telemetry.snapshot(), snap_before).get(
+        "counters", {}
+    )
+    return {
+        "xpid": xpid,
+        "elapsed_s": round(elapsed, 1),
+        "step": stats.get("step", 0),
+        "health": stats.get("health"),
+        "mean_episode_return": final_return(savedir, xpid),
+        "server_restarts": stats.get("server_restarts", 0),
+        "actor_reconnects": stats.get("actor_reconnects", 0),
+        "inference_restarts": stats.get("inference_restarts", 0),
+        "chaos": stats.get("chaos"),
+        "counters": counters,
+        "leaked_processes": sorted(_live_children() - procs_before),
+        "leaked_shm": sorted(_shm_entries() - shm_before),
+    }
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.selftest:
+        # Short structural gate: Mock's return is deterministic (200.0
+        # per episode regardless of policy), so return parity is exact
+        # and the whole thing fits a CI budget.
+        args.env = "Mock"
+        args.total_steps = 2400
+        args.num_servers = args.num_actors = 2
+        args.batch_size = 2
+        args.return_tol = 1e-6
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    # The harness calls train() directly, so it owns logging config —
+    # without this the driver's step/health/chaos lines are invisible.
+    from torchbeast_tpu import polybeast as _polybeast
+
+    _polybeast._configure_logging()
+
+    from torchbeast_tpu import telemetry
+    from torchbeast_tpu.resilience.chaos import FaultPlan
+
+    savedir = args.savedir
+    if savedir is None:
+        import tempfile
+
+        savedir = tempfile.mkdtemp(prefix="chaos_run_")
+    plan_dict = build_plan(args)
+    plan = FaultPlan.from_dict(plan_dict)  # validates kinds/triggers
+    plan_path = os.path.join(savedir, "fault_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(plan_dict, f, indent=2)
+
+    failures = []
+    baseline = run_one(args, savedir, "chaos-baseline")
+    chaos = run_one(args, savedir, "chaos-faulted", plan_path)
+
+    # -- completion --------------------------------------------------------
+    if chaos["step"] < args.total_steps:
+        failures.append(
+            f"chaos run stopped at step {chaos['step']} < "
+            f"{args.total_steps} (health {chaos['health']})"
+        )
+    if chaos["health"] == "HALTED":
+        failures.append("chaos run ended HALTED")
+
+    # -- learning intact ---------------------------------------------------
+    base_ret, chaos_ret = (
+        baseline["mean_episode_return"], chaos["mean_episode_return"]
+    )
+    if base_ret is None or chaos_ret is None:
+        failures.append(
+            f"missing episode returns (baseline {base_ret}, "
+            f"chaos {chaos_ret})"
+        )
+    elif abs(base_ret - chaos_ret) > args.return_tol:
+        failures.append(
+            f"return drift: baseline {base_ret} vs chaos {chaos_ret} "
+            f"(tol {args.return_tol})"
+        )
+
+    # -- exact recovery accounting ----------------------------------------
+    injected = (chaos.get("chaos") or {}).get("injected", {})
+    plan_counts = plan.counts()
+    if injected != plan_counts:
+        failures.append(
+            f"injected {injected} != planned {plan_counts} "
+            "(a fault never fired)"
+        )
+    n_kill = plan_counts.get("env_server_sigkill", 0)
+    n_sever = plan_counts.get("transport_sever", 0)
+    n_poison = plan_counts.get("state_table_poison", 0)
+    counters = chaos["counters"]
+    expected = {
+        # every chaos.<kind>.injected counter must match the plan...
+        **{
+            f"chaos.{kind}.injected": n
+            for kind, n in plan_counts.items()
+        },
+        # ...and each fault class maps to its recovery counter exactly:
+        # 1 respawn per SIGKILL, 1 reconnect per SIGKILL (1:1
+        # actor/server topology) + 1 per sever, 1 rebuild+restart per
+        # poison.
+        "recovery.server_restarts": n_kill,
+        "recovery.actor_reconnects": n_kill + n_sever,
+        "recovery.inference_restarts": n_poison,
+        "recovery.table_rebuilds": n_poison,
+    }
+    for name, want in expected.items():
+        got = int(counters.get(name, 0))
+        if got != want:
+            failures.append(f"counter {name}: got {got}, want {want}")
+
+    # -- no leaks ----------------------------------------------------------
+    for run in (baseline, chaos):
+        if run["leaked_processes"]:
+            failures.append(
+                f"{run['xpid']}: leaked processes "
+                f"{run['leaked_processes']}"
+            )
+        if run["leaked_shm"]:
+            failures.append(
+                f"{run['xpid']}: leaked /dev/shm segments "
+                f"{run['leaked_shm']}"
+            )
+
+    verdict = {
+        "bench": "chaos_run",
+        "selftest": bool(args.selftest),
+        "ok": not failures,
+        "failures": failures,
+        "env": args.env,
+        "total_steps": args.total_steps,
+        "plan": plan_dict,
+        "expected_counters": expected,
+        "results": {"baseline": baseline, "chaos": chaos},
+        "telemetry": telemetry.telemetry_block(),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+            f.write("\n")
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -268,6 +268,107 @@ class TestDonateUse:
 # IMPORT-PURITY
 
 
+class TestExceptSwallow:
+    _PATH = "torchbeast_tpu/runtime/fixture.py"
+
+    def test_silent_pass_flagged(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception:\n    pass\n",
+            path=self._PATH,
+        )
+        assert _rules(report, "EXCEPT-SWALLOW")
+
+    def test_bare_except_return_flagged(self):
+        report = analysis.analyze_source(
+            "def g():\n    try:\n        f()\n"
+            "    except:\n        return None\n",
+            path=self._PATH,
+        )
+        assert _rules(report, "EXCEPT-SWALLOW")
+
+    def test_baseexception_in_tuple_flagged(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept (ValueError, BaseException):\n"
+            "    x = 1\n",
+            path=self._PATH,
+        )
+        assert _rules(report, "EXCEPT-SWALLOW")
+
+    def test_logging_clean(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception:\n"
+            "    log.exception('boom')\n",
+            path=self._PATH,
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW")
+
+    def test_reraise_clean(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept BaseException:\n"
+            "    cleanup()\n    raise\n",
+            path=self._PATH,
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW")
+
+    def test_counter_clean(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception:\n    errors.inc()\n",
+            path=self._PATH,
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW")
+
+    def test_promise_fail_clean(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception as e:\n"
+            "    batch.fail(e)\n",
+            path=self._PATH,
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW")
+
+    def test_narrow_handler_out_of_contract(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept OSError:\n    pass\n",
+            path=self._PATH,
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW")
+
+    def test_outside_scoped_paths_unconstrained(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception:\n    pass\n",
+            path="benchmarks/fixture.py",
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW")
+
+    def test_log_in_nested_def_does_not_credit_handler(self):
+        """A log call inside a nested def doesn't run as part of the
+        handler — defining a logging callback is still a swallow at
+        handler time."""
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception:\n"
+            "    def cb():\n        log.exception('later')\n"
+            "    register(cb)\n",
+            path=self._PATH,
+        )
+        assert _rules(report, "EXCEPT-SWALLOW")
+
+    def test_resilience_path_in_scope(self):
+        report = analysis.analyze_source(
+            "try:\n    f()\nexcept Exception:\n    pass\n",
+            path="torchbeast_tpu/resilience/fixture.py",
+        )
+        assert _rules(report, "EXCEPT-SWALLOW")
+
+    def test_real_runtime_and_resilience_clean(self):
+        """The burn-down contract: the real failure-handling layers
+        carry no silent broad swallows (and the baseline stays empty)."""
+        report = analysis.analyze_paths(
+            list(lint_config.EXCEPT_SWALLOW_PATHS), root=REPO
+        )
+        assert not _rules(report, "EXCEPT-SWALLOW"), [
+            f.render() for f in report.findings
+        ]
+
+
 class TestImportPurity:
     def test_numpy_in_telemetry_flagged(self):
         report = analysis.analyze_source(
@@ -654,7 +755,8 @@ class TestSelftestAndGate:
         assert verdict["ok"], verdict
         assert set(verdict["rules"]) == {
             "HOTPATH-SYNC", "JIT-HAZARD", "DONATE-USE", "IMPORT-PURITY",
-            "LOCK-DISCIPLINE", "WIRE-PARITY", "FLAG-PARITY",
+            "LOCK-DISCIPLINE", "EXCEPT-SWALLOW", "WIRE-PARITY",
+            "FLAG-PARITY",
         }
         for name, checks in verdict["rules"].items():
             assert checks["positive"] and checks["clean"], (name, checks)

@@ -138,7 +138,8 @@ def test_beastlint_selftest_cli():
     assert out["selftest"] == "beastlint" and out["ok"] is True
     assert set(out["rules"]) == {
         "HOTPATH-SYNC", "JIT-HAZARD", "DONATE-USE", "IMPORT-PURITY",
-        "LOCK-DISCIPLINE", "WIRE-PARITY", "FLAG-PARITY",
+        "LOCK-DISCIPLINE", "EXCEPT-SWALLOW", "WIRE-PARITY",
+        "FLAG-PARITY",
     }
     for checks in out["rules"].values():
         assert set(checks) == {"positive", "clean", "isolated"}
@@ -232,6 +233,57 @@ def test_learner_bench_selftest(tmp_path):
 
     saved = json.loads(out_json.read_text())
     assert saved["bench"] == "learner_bench" and saved["ok"] is True
+
+
+def test_chaos_run_selftest(tmp_path):
+    """chaos_run --selftest: two short poly runs (fault-free + seeded
+    3-class fault plan) with the acceptance contract schema-pinned —
+    completion, exact recovery-counter accounting, return parity, and
+    the no-leak sweep — so the chaos harness can't rot between
+    acceptance rounds (ISSUE 6)."""
+    out_json = tmp_path / "chaos_run.json"
+    proc = _run([
+        "scripts/chaos_run.py", "--selftest", "--out", str(out_json),
+    ])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "chaos_run"
+    assert out["selftest"] is True
+    assert out["ok"] is True and out["failures"] == []
+
+    # >= 3 fault classes, every one injected exactly as planned.
+    kinds = {f["kind"] for f in out["plan"]["faults"]}
+    assert {
+        "env_server_sigkill", "transport_sever", "state_table_poison",
+    } <= kinds
+    chaos = out["results"]["chaos"]
+    assert chaos["chaos"]["pending"] == []
+    assert chaos["chaos"]["abandoned"] == []
+
+    # The exact-accounting contract: every expected counter key is
+    # present and equal (chaos.<kind>.injected + the recovery mapping).
+    counters = chaos["counters"]
+    for name in (
+        "recovery.server_restarts", "recovery.actor_reconnects",
+        "recovery.inference_restarts", "recovery.table_rebuilds",
+    ):
+        assert name in out["expected_counters"]
+    for name, want in out["expected_counters"].items():
+        assert int(counters.get(name, 0)) == want, (name, counters)
+
+    # Both runs completed at parity with zero leaked state.
+    for run in out["results"].values():
+        assert run["step"] >= out["total_steps"]
+        assert run["leaked_processes"] == []
+        assert run["leaked_shm"] == []
+    assert (
+        out["results"]["baseline"]["mean_episode_return"]
+        == chaos["mean_episode_return"]
+    )
+
+    _validate_telemetry_block(out["telemetry"])
+    saved = json.loads(out_json.read_text())
+    assert saved["bench"] == "chaos_run" and saved["ok"] is True
 
 
 def test_vtrace_bench_emits_rows(tmp_path):

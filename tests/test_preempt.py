@@ -1,6 +1,7 @@
 """Graceful preemption: SIGTERM to a running driver produces a clean,
 checkpointed exit (the k8s/TPU-maintenance path)."""
 
+import json
 import os
 import signal
 import subprocess
@@ -10,6 +11,30 @@ import time
 import pytest
 
 pytestmark = pytest.mark.slow
+
+
+def _wait_for_output(proc, needle, deadline_s=120):
+    """Accumulate the driver's stdout until `needle` appears (select()
+    + raw os.read: a buffered readline can swallow the awaited line
+    while select keeps reporting the fd idle — see the mono test)."""
+    import select
+
+    deadline = time.time() + deadline_s
+    buf = ""
+    fd = proc.stdout.fileno()
+    while time.time() < deadline:
+        ready, _, _ = select.select([fd], [], [], 1.0)
+        if not ready:
+            if proc.poll() is not None:
+                break
+            continue
+        chunk = os.read(fd, 65536).decode(errors="replace")
+        if not chunk:  # EOF
+            break
+        buf += chunk
+        if needle in buf:
+            return True, buf
+    return False, buf
 
 
 def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
@@ -36,31 +61,8 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
         text=True,
         env=env,
     )
-    # Wait for training to actually start (first SPS log line). select()
-    # before each read so a silent-but-alive driver fails at the deadline
-    # instead of blocking the suite in readline() forever. Read raw bytes
-    # via os.read — NOT proc.stdout.readline(): the buffered wrapper can
-    # swallow a whole chunk (including the awaited line) while select()
-    # keeps reporting the fd itself as idle.
-    import select
-
-    deadline = time.time() + 120
-    started = False
-    buf = ""
-    fd = proc.stdout.fileno()
-    while time.time() < deadline:
-        ready, _, _ = select.select([fd], [], [], 1.0)
-        if not ready:
-            if proc.poll() is not None:
-                break
-            continue
-        chunk = os.read(fd, 65536).decode(errors="replace")
-        if not chunk:  # EOF
-            break
-        buf += chunk
-        if "Steps " in buf:
-            started = True
-            break
+    # Wait for training to actually start (first SPS log line).
+    started, buf = _wait_for_output(proc, "Steps ")
     if not started:
         proc.kill()
     assert started, "driver never started:\n" + buf
@@ -74,3 +76,82 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
     assert proc.returncode == 0, out
     assert "shutting down gracefully" in out
     assert (tmp_path / "preempt" / "model.ckpt").exists()
+
+
+def test_polybeast_sigterm_resume_roundtrip(tmp_path):
+    """The full preemption contract on the ASYNC driver (ISSUE 6):
+    SIGTERM a poly run mid-training -> clean checkpointed exit with the
+    preemption recorded in telemetry; relaunch the same xpid -> it
+    resumes FROM the checkpoint step (never from zero) and finishes."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    extra = [
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p
+    ]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join([repo_root] + extra),
+    }
+    argv = [
+        sys.executable, "-u", "-m", "torchbeast_tpu.polybeast",
+        "--env", "Mock", "--model", "mlp",
+        "--num_servers", "2", "--batch_size", "2",
+        "--unroll_length", "5",
+        "--savedir", str(tmp_path), "--xpid", "poly-preempt",
+        "--pipes_basename", f"unix:{tmp_path}/pipes",
+        "--num_inference_threads", "1",
+        "--max_inference_batch_size", "4",
+        "--checkpoint_interval_s", "100000",
+    ]
+    proc = subprocess.Popen(
+        argv + ["--total_steps", "100000000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env,
+    )
+    started, buf = _wait_for_output(proc, "Step ")
+    if not started:
+        proc.kill()
+    assert started, "driver never started:\n" + buf
+
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out = buf + proc.communicate(timeout=120)[0]
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, out[-4000:]
+    assert "shutting down gracefully" in out
+    ckpt = tmp_path / "poly-preempt" / "model.ckpt"
+    assert ckpt.exists()
+
+    # Telemetry recorded the preemption on the final snapshot line.
+    tele_path = tmp_path / "poly-preempt" / "telemetry.jsonl"
+    lines = [
+        json.loads(ln)
+        for ln in tele_path.read_text().splitlines() if ln.strip()
+    ]
+    assert lines[-1]["counters"].get("preempt.sigterm_received") == 1
+
+    # The checkpoint holds real progress to resume from. (Raw msgpack
+    # read: load_checkpoint wants param templates this test doesn't
+    # need just for the step counter.)
+    from flax import serialization
+
+    def _ckpt_step():
+        return int(
+            serialization.msgpack_restore(ckpt.read_bytes())["step"]
+        )
+
+    ckpt_step = _ckpt_step()
+    assert ckpt_step > 0
+
+    # Relaunch the same xpid: must resume from ckpt_step, then finish
+    # a short remainder and exit 0 — never restart from step 0.
+    proc2 = subprocess.run(
+        argv + ["--total_steps", str(ckpt_step + 40)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc2.returncode == 0, (proc2.stdout + proc2.stderr)[-4000:]
+    out2 = proc2.stdout + proc2.stderr
+    assert "Resuming preempted job" in out2
+    assert _ckpt_step() >= ckpt_step + 40

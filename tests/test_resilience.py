@@ -1,0 +1,749 @@
+"""Resilience subsystem (ISSUE 6): jittered backoff, the pipeline
+health state machine, the learner stall watchdog, FaultPlan/chaos
+injection mechanics, the inference supervisor's poisoned-table
+recovery, and the actor pool's backoff-gated retry paths.
+
+The end-to-end chaos acceptance contract (3+ fault classes against a
+live poly run, exact counter accounting, no leaks) lives in
+scripts/chaos_run.py --selftest, schema-pinned by
+tests/test_bench_scripts.py; these are the unit/integration layers
+under it.
+"""
+
+import os
+import random
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu import telemetry
+from torchbeast_tpu.resilience import (
+    Backoff,
+    BackoffDeadline,
+    ChaosController,
+    FaultPlan,
+    InferenceSupervisor,
+    LearnerWatchdog,
+    PipelineHealth,
+)
+from torchbeast_tpu.telemetry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# Backoff
+
+
+class TestBackoff:
+    def test_delays_jittered_and_bounded(self):
+        bo = Backoff(base_s=0.1, cap_s=1.0, rng=random.Random(1))
+        delays = [bo.next_delay() for _ in range(20)]
+        assert all(0.1 <= d <= 1.0 for d in delays)
+        # Decorrelated jitter: not a constant, not unbounded.
+        assert len(set(delays)) > 5
+        # The early schedule grows (in expectation; seeded so stable).
+        assert max(delays[5:]) > delays[0]
+
+    def test_seeded_schedule_deterministic(self):
+        a = Backoff(base_s=0.1, cap_s=2.0, rng=random.Random(7))
+        b = Backoff(base_s=0.1, cap_s=2.0, rng=random.Random(7))
+        assert [a.next_delay() for _ in range(10)] == [
+            b.next_delay() for _ in range(10)
+        ]
+
+    def test_reset_restarts_schedule(self):
+        rng = random.Random(3)
+        bo = Backoff(base_s=0.1, cap_s=5.0, rng=rng)
+        for _ in range(8):
+            bo.next_delay()
+        grown = bo._prev
+        assert grown > 0.1 or bo.attempts == 8
+        bo.reset()
+        assert bo.attempts == 0
+        # After reset the next draw is uniform(base, base) = base.
+        assert bo.next_delay() == pytest.approx(0.1)
+
+    def test_deadline_raises(self):
+        bo = Backoff(
+            base_s=0.01, cap_s=0.01, deadline_s=0.0,
+            rng=random.Random(0),
+        )
+        bo.sleep()  # first sleep starts the deadline window
+        with pytest.raises(BackoffDeadline):
+            bo.sleep()
+
+    def test_sleep_interruptible_by_event(self):
+        bo = Backoff(base_s=5.0, cap_s=5.0, rng=random.Random(0))
+        wake = threading.Event()
+        wake.set()
+        t0 = time.monotonic()
+        bo.sleep(wake=wake)
+        assert time.monotonic() - t0 < 1.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Backoff(base_s=0.0)
+        with pytest.raises(ValueError):
+            Backoff(base_s=1.0, cap_s=0.5)
+
+
+# ---------------------------------------------------------------------------
+# PipelineHealth
+
+
+class TestPipelineHealth:
+    def test_transitions_and_gauge(self):
+        reg = MetricsRegistry()
+        h = PipelineHealth(registry=reg)
+        assert h.state_name == "HEALTHY"
+        assert h.degrade("two actors down")
+        assert h.state_name == "DEGRADED"
+        assert not h.degrade("still down")  # no duplicate transition
+        assert h.recover("actors back")
+        assert h.state_name == "HEALTHY"
+        snap = telemetry.snapshot(reg)
+        assert snap["gauges"]["health.state"] == 0.0
+        assert snap["counters"]["health.transitions"] == 2.0
+
+    def test_keyed_causes_are_independent(self):
+        """Two concurrent degradation causes: recovering one (the
+        poison) must not mask the other (a still-active stall) — only
+        when the LAST cause clears does the run go HEALTHY."""
+        h = PipelineHealth(registry=MetricsRegistry())
+        h.degrade("learner stalled", key="learner_stall")
+        h.degrade("state table poisoned", key="state_table_poison")
+        assert not h.recover("table rebuilt", key="state_table_poison")
+        assert h.state_name == "DEGRADED"  # the stall still owns it
+        assert h.recover("dispatches resumed", key="learner_stall")
+        assert h.state_name == "HEALTHY"
+
+    def test_sticky_degrade_blocks_recovery(self):
+        """Attrition is permanent: once a sticky cause is recorded, a
+        transient recovery (stall over, table rebuilt) must NOT flip
+        the run back to HEALTHY — the limped-home DEGRADED signal
+        survives to the final stats. Halting still works."""
+        h = PipelineHealth(registry=MetricsRegistry())
+        assert h.degrade("2/4 actors retired", sticky=True)
+        assert not h.recover("inference restarted on rebuilt table")
+        assert h.state_name == "DEGRADED"
+        assert h.halt("floor crossed")
+        assert h.is_halted
+
+    def test_halted_is_terminal_and_signals(self):
+        h = PipelineHealth(registry=MetricsRegistry())
+        assert not h.is_halted
+        assert h.halt("budget exhausted")
+        assert h.is_halted and h.halted.is_set()
+        # Terminal: nothing leaves HALTED.
+        assert not h.recover("nope")
+        assert not h.degrade("nope")
+        assert not h.halt("again")
+        assert h.state_name == "HALTED"
+        assert h.reasons() == [("HALTED", "budget exhausted")]
+
+
+# ---------------------------------------------------------------------------
+# LearnerWatchdog
+
+
+class TestLearnerWatchdog:
+    def test_disabled_at_zero_deadline(self):
+        w = LearnerWatchdog(0.0, registry=MetricsRegistry())
+        w.start()
+        assert w._thread is None
+        w.stop()
+
+    def test_stall_degrades_then_recovers(self):
+        reg = MetricsRegistry()
+        h = PipelineHealth(registry=reg)
+        dumped = []
+        w = LearnerWatchdog(
+            0.3, health=h, registry=reg,
+            dump_fn=lambda: dumped.append(1) or {"queue": 0},
+        )
+        w.start()
+        try:
+            deadline = time.monotonic() + 5
+            while not w.stalled and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert w.stalled
+            assert h.state_name == "DEGRADED"
+            assert dumped  # diagnostics ran
+            # Pings resume -> recovery.
+            deadline = time.monotonic() + 5
+            while w.stalled and time.monotonic() < deadline:
+                w.ping()
+                time.sleep(0.05)
+            assert not w.stalled
+            assert h.state_name == "HEALTHY"
+            snap = telemetry.snapshot(reg)
+            assert snap["counters"]["learner.stalls"] == 1.0
+        finally:
+            w.stop()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+
+
+class TestFaultPlan:
+    def test_round_trip_and_counts(self, tmp_path):
+        data = {
+            "seed": 9,
+            "faults": [
+                {"kind": "env_server_sigkill", "at_step": 100},
+                {"kind": "env_server_sigkill", "at_step": 200,
+                 "target": 1},
+                {"kind": "state_table_poison", "at_s": 3.5},
+            ],
+        }
+        path = tmp_path / "plan.json"
+        path.write_text(__import__("json").dumps(data))
+        plan = FaultPlan.from_json(str(path))
+        assert plan.seed == 9
+        assert plan.counts() == {
+            "env_server_sigkill": 2, "state_table_poison": 1,
+        }
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="Unknown fault kind"):
+            FaultPlan.from_dict(
+                {"faults": [{"kind": "meteor_strike", "at_step": 1}]}
+            )
+
+    def test_missing_trigger_rejected(self):
+        with pytest.raises(ValueError, match="needs a trigger"):
+            FaultPlan.from_dict(
+                {"faults": [{"kind": "transport_sever"}]}
+            )
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown keys"):
+            FaultPlan.from_dict(
+                {"faults": [{"kind": "transport_sever", "at_step": 1,
+                             "severity": 11}]}
+            )
+
+    def test_runtime_bookkeeping_keys_rejected(self):
+        """A summary round-trip carrying `fired: true` back into a plan
+        would silently disarm the fault — the schema rejects the
+        bookkeeping fields outright."""
+        for key in ("fired", "abandoned", "attempts"):
+            with pytest.raises(ValueError, match="unknown keys"):
+                FaultPlan.from_dict(
+                    {"faults": [{"kind": "transport_sever",
+                                 "at_step": 1, key: True}]}
+                )
+
+    def test_due_semantics(self):
+        plan = FaultPlan.from_dict(
+            {"faults": [
+                {"kind": "transport_sever", "at_step": 10},
+                {"kind": "transport_sever", "at_s": 2.0},
+            ]}
+        )
+        by_step, by_time = plan.faults
+        assert not by_step.due(9, 100.0)
+        assert by_step.due(10, 0.0)
+        assert not by_time.due(10**9, 1.9)
+        assert by_time.due(0, 2.0)
+
+
+# ---------------------------------------------------------------------------
+# ChaosController
+
+
+class _FakeSock:
+    def __init__(self):
+        self.shut = False
+
+    def shutdown(self, how):
+        self.shut = True
+
+
+class _FakeTransport:
+    def __init__(self):
+        self._sock = _FakeSock()
+        self.sent = []
+        self.closed = False
+
+    def send(self, value):
+        self.sent.append(value)
+        return 1
+
+    def recv_sized(self):
+        return {"type": "step"}, 1
+
+    def close(self):
+        self.closed = True
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestChaosController:
+    def test_step_triggered_sever_counts_exactly(self):
+        reg = MetricsRegistry()
+        plan = FaultPlan.from_dict({
+            "seed": 1,
+            "faults": [
+                {"kind": "transport_sever", "at_step": 10, "target": 0},
+            ],
+        })
+        ctrl = ChaosController(plan, registry=reg, poll_interval_s=0.01)
+        inner = _FakeTransport()
+        wrapped = ctrl.wrap_transport(inner, 0)
+        step = [0]
+        ctrl.set_step_fn(lambda: step[0])
+        ctrl.start()
+        try:
+            time.sleep(0.1)
+            assert not inner._sock.shut  # not due yet
+            step[0] = 10
+            assert _wait_until(lambda: inner._sock.shut)
+            assert _wait_until(ctrl.done)
+            assert ctrl.injected_counts() == {"transport_sever": 1}
+            snap = telemetry.snapshot(reg)
+            assert (
+                snap["counters"]["chaos.transport_sever.injected"] == 1.0
+            )
+            assert ctrl.summary()["pending"] == []
+        finally:
+            ctrl.stop()
+        # The wrapped transport still proxies the surface, and close()
+        # unregisters it from the controller.
+        wrapped.send({"x": 1})
+        assert inner.sent == [{"x": 1}]
+        wrapped.close()
+        assert inner.closed
+        assert ctrl._live_transport(0) is None
+
+    def test_sever_waits_for_a_live_transport(self):
+        """A due fault with no connected target stays pending and fires
+        on a later tick — injected counts are exact, not best-effort."""
+        reg = MetricsRegistry()
+        plan = FaultPlan.from_dict({
+            "faults": [
+                {"kind": "transport_sever", "at_step": 0, "target": 2},
+            ],
+        })
+        ctrl = ChaosController(plan, registry=reg, poll_interval_s=0.01)
+        ctrl.start()
+        try:
+            time.sleep(0.1)
+            assert ctrl.injected_counts() == {}
+            inner = _FakeTransport()
+            ctrl.wrap_transport(inner, 2)
+            assert _wait_until(lambda: inner._sock.shut)
+            assert ctrl.injected_counts() == {"transport_sever": 1}
+        finally:
+            ctrl.stop()
+
+    def test_state_table_poison_and_delay_window(self):
+        class FakeTable:
+            poisoned = False
+
+            def poison(self):
+                self.poisoned = True
+
+        reg = MetricsRegistry()
+        plan = FaultPlan.from_dict({
+            "faults": [
+                {"kind": "state_table_poison", "at_s": 0.0},
+                {"kind": "transport_delay", "at_s": 0.0, "target": 0,
+                 "duration_s": 30.0, "delay_s": 0.05},
+            ],
+        })
+        ctrl = ChaosController(plan, registry=reg, poll_interval_s=0.01)
+        table = FakeTable()
+        ctrl.attach_state_table(table)
+        inner = _FakeTransport()
+        wrapped = ctrl.wrap_transport(inner, 0)
+        ctrl.start()
+        try:
+            assert _wait_until(ctrl.done)
+            assert table.poisoned
+            t0 = time.monotonic()
+            wrapped.recv_sized()
+            assert time.monotonic() - t0 >= 0.04  # delay window applied
+        finally:
+            ctrl.stop()
+
+    def test_shm_header_corruption_surfaces_as_wire_error(self):
+        """Deterministic single-threaded variant of the shm corruption
+        fault: stomp the queued frame's header, the reader's next recv
+        must reject it as WireError (-> the actor reconnect path)."""
+        from torchbeast_tpu.runtime import transport, wire
+        from torchbeast_tpu.resilience.chaos import _corrupt_ring
+
+        server, client = transport.shm_pipe(
+            obs_ring_bytes=1 << 16, act_ring_bytes=1 << 16
+        )
+        try:
+            assert not _corrupt_ring(
+                client._recv_ring, header=True
+            )  # empty ring: not injectable yet
+            server.send({"type": "step", "frame": np.zeros(8)})
+            assert _corrupt_ring(client._recv_ring, header=True)
+            with pytest.raises(wire.WireError):
+                client.recv_sized()
+        finally:
+            server.close()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# InferenceSupervisor + a real DeviceStateTable
+
+
+H = 3
+
+
+def _make_table(num_slots=2):
+    import jax.numpy as jnp
+    from torchbeast_tpu.runtime.state_table import DeviceStateTable
+
+    def act(ctx, env, state):
+        new = state + env["frame"][..., None]  # [1, B, H]
+        return {"out": new.sum(-1)}, new
+
+    return DeviceStateTable(
+        jnp.zeros((1, 1, H), jnp.float32),
+        num_slots=num_slots,
+        act_fn=act,
+        batch_dim=1,
+    )
+
+
+def _env(vals):
+    return {"frame": np.asarray(vals, np.float32)[None]}
+
+
+class TestStateTableRecovery:
+    def test_rebuild_unpoisons_and_resets_slots(self):
+        import jax
+
+        table = _make_table()
+        table.step(
+            np.asarray([0], np.int32), np.ones(1, bool), _env([2.0])
+        )
+        assert np.asarray(
+            jax.device_get(table.read_slot(0))
+        ).reshape(-1).tolist() == [2.0] * H
+        table.poison()
+        assert table.poisoned
+        from torchbeast_tpu.runtime.state_table import (
+            StateTablePoisonedError,
+        )
+
+        with pytest.raises(StateTablePoisonedError):
+            table.read_slot(0)
+        table.rebuild()
+        assert not table.poisoned
+        # Every slot back at the initial state.
+        assert np.asarray(
+            jax.device_get(table.read_slot(0))
+        ).reshape(-1).tolist() == [0.0] * H
+
+    def test_supervisor_recovers_serving_after_poison(self):
+        """The tentpole recovery contract: poison the table mid-serve;
+        the supervisor rebuilds it, restarts the serving thread, and
+        actors' subsequent requests are served from initial state — the
+        run continues instead of wedging."""
+        from torchbeast_tpu.runtime.inference import inference_loop
+        from torchbeast_tpu.runtime.queues import (
+            AsyncError,
+            DynamicBatcher,
+        )
+
+        table = _make_table()
+        batcher = DynamicBatcher(batch_dim=1, timeout_ms=10)
+        reg = MetricsRegistry()
+        health = PipelineHealth(registry=reg)
+        sup = InferenceSupervisor(
+            lambda: inference_loop(batcher, None, 4, state_table=table),
+            num_threads=1,
+            state_table=table,
+            restart_budget=2,
+            health=health,
+            registry=reg,
+        )
+        sup.start()
+
+        def compute(slot):
+            out = batcher.compute({
+                "env": _env([1.0]),
+                "slot": np.full((1, 1), slot, np.int32),
+                "advance": np.full((1, 1), True, bool),
+            })
+            return float(np.asarray(out["outputs"]["out"]).reshape(()))
+
+        try:
+            assert compute(0) == H * 1.0  # state 0 -> 1 per feature
+            assert compute(0) == H * 2.0  # advanced state persisted
+            table.poison()
+            # The in-flight/next batch fails over to the actor's retry
+            # path; the supervisor rebuilds and serving resumes.
+            recovered = None
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    recovered = compute(0)
+                    break
+                except AsyncError:
+                    time.sleep(0.05)
+            # Rebuilt table: slot state reset to initial.
+            assert recovered == H * 1.0
+            assert sup.restarts == 1
+            assert health.state_name == "HEALTHY"
+            snap = telemetry.snapshot(reg)
+            assert snap["counters"]["recovery.table_rebuilds"] == 1.0
+            assert (
+                snap["counters"]["recovery.inference_restarts"] == 1.0
+            )
+        finally:
+            batcher.close()
+            sup.join(timeout=10)
+        assert sup.alive_count() == 0
+        assert sup.errors == []
+
+    def test_budget_exhaustion_halts(self):
+        """Acceptance pin: a poison with no remaining restart budget
+        transitions health to HALTED (the driver's monitor loop turns
+        that into checkpoint-and-exit) instead of retrying forever."""
+        from torchbeast_tpu.runtime.inference import inference_loop
+        from torchbeast_tpu.runtime.queues import (
+            AsyncError,
+            DynamicBatcher,
+        )
+
+        table = _make_table()
+        batcher = DynamicBatcher(batch_dim=1, timeout_ms=10)
+        reg = MetricsRegistry()
+        health = PipelineHealth(registry=reg)
+        sup = InferenceSupervisor(
+            lambda: inference_loop(batcher, None, 4, state_table=table),
+            num_threads=1,
+            state_table=table,
+            restart_budget=0,
+            health=health,
+            registry=reg,
+        )
+        sup.start()
+        table.poison()
+
+        def poke():
+            try:
+                batcher.compute({
+                    "env": _env([1.0]),
+                    "slot": np.zeros((1, 1), np.int32),
+                    "advance": np.ones((1, 1), bool),
+                })
+            except (AsyncError, Exception):  # noqa: BLE001
+                pass
+
+        t = threading.Thread(target=poke, daemon=True)
+        t.start()
+        try:
+            assert health.halted.wait(timeout=20)
+            assert health.state_name == "HALTED"
+            assert sup.restarts == 0
+        finally:
+            batcher.close()
+            t.join(timeout=5)
+            sup.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# ActorPool retry paths go through backoff (the tight-loop pin)
+
+
+class _RecordingBackoff(Backoff):
+    def __init__(self, calls):
+        super().__init__(
+            base_s=0.01, cap_s=0.02, rng=random.Random(0)
+        )
+        self._calls = calls
+
+    def next_delay(self):
+        d = super().next_delay()
+        self._calls.append(d)
+        return d
+
+
+class TestActorPoolBackoff:
+    def test_reconnects_are_backoff_gated(self, tmp_path):
+        """A dead address is NOT re-dialed in a tight loop: every
+        reconnect attempt passes through the jittered backoff (one
+        next_delay per retry), and the budget still bounds the total."""
+        from torchbeast_tpu.runtime.actor_pool import ActorPool
+        from torchbeast_tpu.runtime.queues import (
+            BatchingQueue,
+            DynamicBatcher,
+        )
+
+        calls = []
+        pool = ActorPool(
+            unroll_length=2,
+            learner_queue=BatchingQueue(
+                batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+            ),
+            inference_batcher=DynamicBatcher(batch_dim=1, timeout_ms=5),
+            env_server_addresses=[f"unix:{tmp_path}/nowhere"],
+            initial_agent_state=np.zeros((1, 1), np.int64),
+            connect_timeout_s=0.2,
+            max_reconnects=2,
+            backoff_factory=lambda: _RecordingBackoff(calls),
+        )
+        with pytest.raises(TimeoutError):
+            pool.run()
+        # 1 initial + 2 budgeted reconnects, each retried through ONE
+        # backoff step; afterwards the actor retires.
+        assert len(calls) == 2
+        assert all(0.01 <= d <= 0.02 for d in calls)
+        assert pool.reconnects == 2
+        assert pool.live_actors() == 0
+        assert len(pool.errors) == 1
+
+    def test_poisoned_table_error_is_retried_not_fatal(self, tmp_path):
+        """An actor's DIRECT table call (unroll-boundary read_slot,
+        connect-time reset) landing inside the poison-to-rebuild window
+        must ride the budgeted retry path — not the generic fatal
+        handler that would permanently retire the actor while the
+        supervisor is mid-rebuild."""
+        from torchbeast_tpu.runtime.actor_pool import ActorPool
+        from torchbeast_tpu.runtime.errors import StateTablePoisonedError
+        from torchbeast_tpu.runtime.queues import (
+            BatchingQueue,
+            ClosedBatchingQueue,
+            DynamicBatcher,
+        )
+
+        calls = []
+        pool = ActorPool(
+            unroll_length=2,
+            learner_queue=BatchingQueue(
+                batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+            ),
+            inference_batcher=DynamicBatcher(batch_dim=1, timeout_ms=5),
+            env_server_addresses=[f"unix:{tmp_path}/unused"],
+            initial_agent_state=np.zeros((1, 1), np.int64),
+            max_reconnects=3,
+            backoff_factory=lambda: _RecordingBackoff([]),
+        )
+
+        def fake_loop(index, address, progress=None):
+            calls.append(1)
+            if len(calls) < 3:
+                raise StateTablePoisonedError("mid-rebuild window")
+            raise ClosedBatchingQueue("shutdown")
+
+        pool._loop = fake_loop
+        pool._recovering_loop(0, "unix:unused")
+        assert len(calls) == 3  # two budgeted retries, then clean exit
+        assert pool.errors == []
+
+    def test_default_reconnect_budget_nonzero(self):
+        """A single env-server blip must no longer permanently kill an
+        actor: the pool's own default budget is nonzero (the drivers
+        default --max_actor_reconnects the same way)."""
+        import inspect
+
+        from torchbeast_tpu.runtime.actor_pool import ActorPool
+        from torchbeast_tpu import polybeast
+
+        sig = inspect.signature(ActorPool.__init__)
+        assert sig.parameters["max_reconnects"].default >= 1
+        parser = polybeast.make_parser()
+        default = parser.get_default("max_actor_reconnects")
+        assert default is not None and default >= 1
+
+
+# ---------------------------------------------------------------------------
+# Preemption telemetry
+
+
+class TestPreemptTelemetry:
+    def test_sigterm_is_counted(self):
+        """install_preemption_handler records the preemption in the
+        `preempt.sigterm_received` counter before unwinding, so a
+        preempted run's final telemetry line says it was preempted."""
+        from torchbeast_tpu.utils import install_preemption_handler
+
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            assert install_preemption_handler()
+            before = (
+                telemetry.snapshot()["counters"]
+                .get("preempt.sigterm_received", 0)
+            )
+            with pytest.raises(KeyboardInterrupt):
+                os.kill(os.getpid(), signal.SIGTERM)
+                # Signal delivery is between-bytecodes; give it one.
+                time.sleep(1)
+            after = (
+                telemetry.snapshot()["counters"]
+                .get("preempt.sigterm_received", 0)
+            )
+            assert after == before + 1
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# Driver-level HALTED contract (slow)
+
+
+@pytest.mark.slow
+def test_poly_budget_exhaustion_checkpoints_and_exits(tmp_path):
+    """Budget-exhaustion end-to-end: a chaos-poisoned state table with
+    --inference_restart_budget 0 must NOT hang or crash the driver —
+    train() returns cleanly with health HALTED, the checkpoint written,
+    and the env-server group reaped."""
+    import json
+    import multiprocessing as mp
+
+    from torchbeast_tpu import polybeast
+
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json.dumps({
+        "seed": 1,
+        "faults": [{"kind": "state_table_poison", "at_step": 200}],
+    }))
+    flags = polybeast.make_parser().parse_args([
+        "--env", "Mock",
+        "--model", "mlp", "--use_lstm",
+        "--num_servers", "2",
+        "--batch_size", "2",
+        "--unroll_length", "5",
+        "--total_steps", "100000000",  # unreachable: only HALTED ends it
+        "--savedir", str(tmp_path),
+        "--xpid", "halted",
+        "--pipes_basename", f"unix:{tmp_path}/pipes",
+        "--num_inference_threads", "1",
+        "--max_inference_batch_size", "4",
+        "--checkpoint_interval_s", "100000",
+        "--chaos_plan", str(plan_path),
+        "--inference_restart_budget", "0",
+        "--max_actor_reconnects", "1",
+    ])
+    before = {p.pid for p in mp.active_children()}
+    stats = polybeast.train(flags)
+    assert stats["health"] == "HALTED"
+    assert any(
+        "budget exhausted" in reason or "below --min_live_actors" in reason
+        for _, reason in stats["health_reasons"]
+    ), stats["health_reasons"]
+    assert (tmp_path / "halted" / "model.ckpt").exists()
+    leftover = {
+        p.pid for p in mp.active_children() if p.is_alive()
+    } - before
+    assert leftover == set()

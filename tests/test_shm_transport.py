@@ -630,6 +630,45 @@ def test_shm_actor_revives_after_server_process_kill():
         proc.join(10)
 
 
+def _shm_segments():
+    if not os.path.isdir("/dev/shm"):
+        return set()
+    return {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
+
+
+@pytest.mark.slow
+def test_shm_no_segment_leak_after_server_kill():
+    """SIGKILL'd env servers can strand SharedMemory segments (the
+    resource_tracker caveats from ISSUE 3 — the dead owner never runs
+    its unlink): the actor pool's teardown sweep must leave /dev/shm
+    clean after the connection dies (ISSUE 6 satellite)."""
+    before = _shm_segments()
+    path = os.path.join(tempfile.mkdtemp(), "shm_leak")
+    proc = _spawn_server_proc(path)
+    learner_queue, batcher, pool, pool_thread = _run_pool(
+        f"shm:{path}", max_reconnects=0
+    )
+    try:
+        it = iter(learner_queue)
+        next(it)  # the ring pair is live and mid-stream
+
+        proc.kill()  # SIGKILL: the owner never unlinks
+        proc.join(10)
+        # Budget 0: the actor retires after the failure; its teardown
+        # sweep is the only thing standing between this kill and a
+        # leaked ring pair.
+        pool_thread.join(30)
+        assert not pool_thread.is_alive()
+    finally:
+        batcher.close()
+        learner_queue.close()
+        pool_thread.join(5)
+        proc.kill()
+        proc.join(10)
+    leaked = _shm_segments() - before
+    assert leaked == set(), f"leaked /dev/shm segments: {leaked}"
+
+
 def test_shm_server_stop_severs_streams():
     """stop() on a shm server must cut live doorbells so clients see a
     transport failure immediately (reconnect budget path), and must

@@ -11,6 +11,9 @@ offending file:line. The rules encode the repo's real runtime contracts:
     IMPORT-PURITY    per-package import allowlists (telemetry/, analysis/)
     LOCK-DISCIPLINE  `# guarded-by:` attributes only touched under their
                      lock; no bare .acquire() without try/finally
+    EXCEPT-SWALLOW   broad except bodies on runtime/ + resilience/ paths
+                     re-raise, log, or count the failure (no silent
+                     swallows on the failure-handling layers)
     WIRE-PARITY      runtime/wire.py == csrc/{wire,array,client}.h on the
                      dtype table, frame tags, and kMaxFrameBytes
     FLAG-PARITY      flags shared by monobeast/polybeast agree on default

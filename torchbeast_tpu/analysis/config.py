@@ -31,6 +31,17 @@ PURITY = {
     "torchbeast_tpu/analysis": _HEAVY + ("torchbeast_tpu",),
 }
 
+# EXCEPT-SWALLOW scope: path prefixes where a broad `except:` /
+# `except Exception:` / `except BaseException:` body must re-raise,
+# log, or count the failure. These are the pipeline's failure-handling
+# layers — a silent swallow here is exactly how a DEGRADED run hides
+# (ISSUE 6). Other packages stay out of scope: broad-but-silent guards
+# in benches/tests are noise, not hidden outages.
+EXCEPT_SWALLOW_PATHS = (
+    "torchbeast_tpu/runtime",
+    "torchbeast_tpu/resilience",
+)
+
 # WIRE-PARITY anchors: the Python codec and its C++ mirrors.
 WIRE_PY = "torchbeast_tpu/runtime/wire.py"
 WIRE_H = "csrc/wire.h"

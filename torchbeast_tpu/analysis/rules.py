@@ -825,10 +825,113 @@ class LockDisciplineRule:
         return False
 
 
+class ExceptSwallowRule:
+    """EXCEPT-SWALLOW: broad exception handlers that hide failures.
+
+    On the pipeline's failure-handling paths (config.EXCEPT_SWALLOW_PATHS:
+    runtime/ and resilience/), a `except:` / `except Exception:` /
+    `except BaseException:` body must do at least one of:
+
+    - re-raise (`raise`),
+    - log (any `*.debug/info/warning/error/exception/critical/log` call —
+      `log.exception` is the idiom),
+    - count it (a telemetry `.inc()`/`.observe()`), or
+    - surface it to the waiting producer (`.fail(e)` on a batch promise).
+
+    A broad handler that silently `pass`es or returns a default is how a
+    DEGRADED pipeline hides: the chaos machinery (ISSUE 6) can only
+    assert recovery == injected when every absorbed failure leaves a
+    trace. Narrow handlers (`except OSError:` teardown guards) stay out
+    of scope — the contract targets the catch-alls that can absorb
+    *anything*.
+    """
+
+    name = "EXCEPT-SWALLOW"
+
+    _BROAD = {"Exception", "BaseException"}
+    _LOG_METHODS = {
+        "debug", "info", "warning", "error", "exception", "critical",
+        "log",
+    }
+    _ACCOUNT_METHODS = {"inc", "observe", "fail"}
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if not any(
+            ctx.path.startswith(prefix + "/") or ctx.path == prefix
+            for prefix in config.EXCEPT_SWALLOW_PATHS
+        ):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            spec = self._broad_spec(node.type)
+            if spec is None:
+                continue
+            if self._accounts_for_failure(node.body):
+                continue
+            findings.append(
+                Finding(
+                    self.name, ctx.path, node.lineno,
+                    f"`{spec}` body neither re-raises, logs, "
+                    "nor counts the failure (silent swallows are how "
+                    "degraded pipelines hide)",
+                )
+            )
+        return findings
+
+    def _broad_spec(self, type_node) -> Optional[str]:
+        """The handler's spec text when it is broad, else None."""
+        if type_node is None:
+            return "except:"  # bare
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [_attr_chain(e).rsplit(".", 1)[-1]
+                     for e in type_node.elts]
+        else:
+            chain = _attr_chain(type_node)
+            if chain:
+                names = [chain.rsplit(".", 1)[-1]]
+        for name in names:
+            if name in self._BROAD:
+                return f"except {name}:"
+        return None
+
+    def _accounts_for_failure(self, body) -> bool:
+        """True when the handler body raises/logs/counts. Nested
+        function/lambda bodies are SKIPPED (they don't execute as part
+        of handling). Known conservatism: a raise or log inside a
+        nested try's own handler credits the outer one even though it
+        only covers that inner exception class — acceptable, since
+        partial surfacing exists and the rule prefers missing a
+        violation over flagging correct code."""
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                attr = node.func.attr
+                if (
+                    attr in self._LOG_METHODS
+                    or attr in self._ACCOUNT_METHODS
+                ):
+                    return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+
 FILE_RULES = [
     HotpathSyncRule(),
     JitHazardRule(),
     DonateUseRule(),
     ImportPurityRule(),
     LockDisciplineRule(),
+    ExceptSwallowRule(),
 ]

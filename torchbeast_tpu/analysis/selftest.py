@@ -141,6 +141,44 @@ def busy(lock, work):
         lock.release()
 '''
 
+_SWALLOW_POSITIVE = '''
+def teardown(sock, conns):
+    try:
+        sock.close()
+    except Exception:
+        pass
+    for c in conns:
+        try:
+            c.shutdown()
+        except BaseException:
+            return False
+    return True
+'''
+
+_SWALLOW_CLEAN = '''
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def teardown(sock, conns, counter):
+    try:
+        sock.close()
+    except Exception:
+        log.exception("close failed")
+    except OSError:
+        pass
+    for c in conns:
+        try:
+            c.shutdown()
+        except Exception:
+            counter.inc()
+    try:
+        risky()
+    except BaseException:
+        raise
+'''
+
 _SUPPRESSED = '''
 import jax.numpy as jnp
 
@@ -245,6 +283,11 @@ def run_selftest() -> dict:
             "torchbeast_tpu/telemetry/fixture.py",
         ),
         "LOCK-DISCIPLINE": (_LOCK_POSITIVE, _LOCK_CLEAN, "snippet.py"),
+        "EXCEPT-SWALLOW": (
+            _SWALLOW_POSITIVE,
+            _SWALLOW_CLEAN,
+            "torchbeast_tpu/runtime/fixture.py",
+        ),
     }
     for name, (positive, clean, path) in pairs.items():
         pos_report = analyze_source(positive, path=path)

@@ -220,6 +220,13 @@ def make_parser():
                              "has no workers to supervise.)")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600,
                         help="Seconds between checkpoints (reference: 10min).")
+    parser.add_argument("--learner_stall_timeout_s", type=float,
+                        default=300.0,
+                        help="Learner stall watchdog: no update "
+                             "dispatch within this deadline transitions "
+                             "health to DEGRADED and dumps thread-stack "
+                             "diagnostics; dispatches resuming recovers "
+                             "it. 0 disables the watchdog.")
     # Loss settings.
     parser.add_argument("--entropy_cost", type=float, default=0.0006)
     parser.add_argument("--entropy_cost_final", type=float, default=None,
@@ -692,6 +699,19 @@ def train(flags):
     )
     telemetry_on = tele.enabled
     reg = tele.registry
+    # Stall visibility (ISSUE 6): the sync trainer has no monitor
+    # thread, so a wedged collect (dead env worker, hung device) used
+    # to look like silence. The watchdog degrades health.state and
+    # dumps thread stacks after --learner_stall_timeout_s of no update
+    # dispatches.
+    from torchbeast_tpu.resilience import LearnerWatchdog, PipelineHealth
+
+    health = PipelineHealth(registry=reg)
+    watchdog = LearnerWatchdog(
+        getattr(flags, "learner_stall_timeout_s", 300.0),
+        health=health,
+        registry=reg,
+    )
 
     hp = hparams_from_flags(flags)
     num_actions, frame_shape, frame_dtype = _probe_env(flags)
@@ -882,6 +902,7 @@ def train(flags):
         pool.close()
         raise
     tracer = telemetry.get_tracer()
+    watchdog.start()
     try:
         while step < flags.total_steps:
             timings.reset()
@@ -955,6 +976,7 @@ def train(flags):
                 stats = flush_stats(pending)
             pending = (device_stats, step)
             timings.time("learn")
+            watchdog.ping()
 
             now = time.time()
             if now - last_log_time > 5:
@@ -1004,6 +1026,7 @@ def train(flags):
         successful = False
         raise
     finally:
+        watchdog.stop()
         # Flush the one-iteration-delayed stats so the final checkpoint
         # and return value are current even on interrupt (guarded: an
         # async XLA error may surface here instead of at dispatch).

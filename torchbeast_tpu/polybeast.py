@@ -227,19 +227,49 @@ def make_parser():
                              "dispatch. Python runtime only.")
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
-    parser.add_argument("--max_actor_reconnects", type=int, default=None,
-                        help="Elastic actors: reconnect up to N times per "
-                             "actor on env-server transport failure; the "
-                             "budget refills after a full recovered "
-                             "unroll. Default: 3 when this launcher "
-                             "supervises its own servers (a respawned "
-                             "server is useless if its actors already "
-                             "failed fast), 0 — fail fast, like the "
-                             "reference — with --no_start_servers, where "
-                             "nobody restarts a dead external server and "
-                             "reconnect attempts would only delay the "
-                             "error. App-level env errors are never "
+    parser.add_argument("--max_actor_reconnects", type=int, default=3,
+                        help="Elastic actors: reconnect (with jittered "
+                             "exponential backoff) up to N times per "
+                             "actor on env-server transport failure or "
+                             "a failed inference batch; the budget "
+                             "refills after a full recovered unroll. "
+                             "Nonzero by default — a single env-server "
+                             "blip must not permanently retire an actor "
+                             "(with external unsupervised servers the "
+                             "backoff bounds what a truly dead address "
+                             "costs). 0 = fail fast, like the "
+                             "reference. App-level env errors are never "
                              "absorbed either way.")
+    parser.add_argument("--min_live_actors", type=int, default=1,
+                        help="Graceful degradation floor: the run "
+                             "continues DEGRADED while at least this "
+                             "many actor loops are alive, and "
+                             "checkpoints-then-exits cleanly (health "
+                             "HALTED) below it — instead of hanging on "
+                             "a starved learner queue.")
+    parser.add_argument("--inference_restart_budget", type=int, default=3,
+                        help="How many times the inference supervisor "
+                             "may rebuild a poisoned DeviceStateTable "
+                             "and restart the serving threads before "
+                             "the pipeline goes HALTED "
+                             "(checkpoint-and-exit).")
+    parser.add_argument("--learner_stall_timeout_s", type=float,
+                        default=300.0,
+                        help="Learner stall watchdog: no update "
+                             "dispatch within this deadline transitions "
+                             "health to DEGRADED and dumps thread-stack "
+                             "diagnostics; dispatches resuming recovers "
+                             "it. 0 disables the watchdog.")
+    parser.add_argument("--chaos_plan", default=None,
+                        help="Arm a deterministic fault-injection plan "
+                             "(JSON, see resilience/chaos.py: seeded "
+                             "FaultPlan with step/time-triggered "
+                             "env-server SIGKILL, transport sever/"
+                             "blackhole/delay, shm-ring corruption, "
+                             "state-table poisoning, SIGTERM "
+                             "preemption). Injected faults are counted "
+                             "in telemetry so recovery can be asserted "
+                             "exactly (scripts/chaos_run.py).")
     parser.add_argument("--checkpoint_interval_s", type=int, default=600)
     telemetry.add_arguments(parser)
     # Loss / optimizer (same knobs as monobeast).
@@ -281,6 +311,13 @@ def train(flags):
         # batch arena (and the native learner path predates supersteps).
         raise RuntimeError(
             "--superstep_k > 1 is not supported with --native_runtime; "
+            "use the Python runtime"
+        )
+    if getattr(flags, "chaos_plan", None) and flags.native_runtime:
+        # The C++ pool owns its own connections: the fault-wrapping
+        # transport (sever/delay/corrupt injectors) cannot interpose.
+        raise RuntimeError(
+            "--chaos_plan is not supported with --native_runtime; "
             "use the Python runtime"
         )
 
@@ -339,6 +376,25 @@ def train(flags):
     )
     telemetry_on = tele.enabled
     reg = tele.registry
+    # Pipeline health (ISSUE 6): HEALTHY/DEGRADED/HALTED as the
+    # `health.state` gauge. Actor attrition degrades the run until the
+    # --min_live_actors floor; a halt (floor crossed, or the inference
+    # restart budget exhausted) checkpoints and exits cleanly instead
+    # of hanging on a starved learner queue.
+    from torchbeast_tpu.resilience import (
+        ChaosController,
+        FaultPlan,
+        InferenceSupervisor,
+        LearnerWatchdog,
+        PipelineHealth,
+    )
+
+    health = PipelineHealth(registry=reg)
+    chaos = None
+    if getattr(flags, "chaos_plan", None):
+        chaos = ChaosController(
+            FaultPlan.from_json(flags.chaos_plan), registry=reg
+        )
     # All hosts resume from the LEAD's checkpoint (shared filesystem, as
     # with the reference's savedir convention).
     checkpoint_path = os.path.join(
@@ -378,6 +434,8 @@ def train(flags):
             # the reap paths below always terminate the CURRENT group.
             server_procs = server_supervisor.processes
             server_supervisor.start_watch()
+            if chaos is not None:
+                chaos.attach_servers(server_supervisor)
             time.sleep(0.5)
         elif getattr(flags, "env_seed", None) is not None:
             log.warning(
@@ -787,6 +845,15 @@ def train(flags):
                 },
             )
 
+        if chaos is not None:
+            chaos.attach_state_table(state_table)
+
+            def _chaos_step():
+                with state_lock:
+                    return state["step"]
+
+            chaos.set_step_fn(_chaos_step)
+
         # Per-env-step wire accounting for the acting path. Exported as
         # telemetry gauges + a static `acting_path` block on every
         # telemetry.jsonl line (benchmarks/tpu_e2e_async.py consumes the
@@ -847,59 +914,84 @@ def train(flags):
                 len(buckets), time.time() - t0,
             )
 
-        inference_threads = [
-            threading.Thread(
-                target=inference_loop,
-                args=(
-                    inference_batcher,
-                    act_fn,
-                    flags.max_inference_batch_size,
-                ),
-                # Pipelined dispatch only with a single consumer thread: its
-                # held-reply optimization is unsafe with several threads
-                # draining one batcher (runtime/inference.py docstring);
-                # with >1 threads the overlap comes from the threads.
-                kwargs={
-                    "lock": None,
-                    "pipelined": flags.num_inference_threads == 1,
-                    "state_table": state_table,
-                },
-                daemon=True,
-                name=f"inference-{i}",
+        def _serve_loop():
+            # Pipelined dispatch only with a single consumer thread: its
+            # held-reply optimization is unsafe with several threads
+            # draining one batcher (runtime/inference.py docstring);
+            # with >1 threads the overlap comes from the threads.
+            inference_loop(
+                inference_batcher,
+                act_fn,
+                flags.max_inference_batch_size,
+                lock=None,
+                pipelined=flags.num_inference_threads == 1,
+                state_table=state_table,
             )
-            for i in range(flags.num_inference_threads)
-        ]
 
-        max_reconnects = flags.max_actor_reconnects
-        if max_reconnects is None:
-            # Supervision-aware default: reconnects only help when
-            # someone restarts the dead server. With external servers
-            # (--no_start_servers) a reconnect would retry against a
-            # dead address for the full connect deadline — fail fast
-            # instead, like the reference.
-            supervised = (
-                flags.start_servers
-                and getattr(flags, "max_server_restarts", 10) > 0
-            )
-            max_reconnects = 3 if supervised else 0
+        # Supervised serving threads (ISSUE 6): a poisoned state table
+        # no longer ends the run — the supervisor rebuilds it from
+        # initial state and restarts the thread, up to
+        # --inference_restart_budget times; exhaustion goes HALTED
+        # (checkpoint-and-exit below) instead of wedging the actors.
+        infer_supervisor = InferenceSupervisor(
+            _serve_loop,
+            num_threads=flags.num_inference_threads,
+            state_table=state_table,
+            restart_budget=getattr(flags, "inference_restart_budget", 3),
+            health=health,
+            registry=reg,
+        )
+
         pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
         pool_kwargs = {}
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
         if not flags.native_runtime:
             pool_kwargs["max_frame_bytes"] = flags.max_frame_bytes
+            if chaos is not None:
+                pool_kwargs["transport_wrap"] = chaos.wrap_transport
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
             inference_batcher=inference_batcher,
             env_server_addresses=addresses,
             initial_agent_state=model.initial_state(1),
-            max_reconnects=max_reconnects,
+            max_reconnects=flags.max_actor_reconnects,
             **pool_kwargs,
         )
         actor_thread = threading.Thread(
             target=actors.run, daemon=True, name="actorpool"
         )
+
+        # Learner stall watchdog: the learner loop pings per dispatch;
+        # silence past the deadline -> DEGRADED + a thread-stack dump
+        # with pipeline occupancy, so "where is it stuck" is in the log
+        # before anyone has to attach a debugger.
+        def _stall_diagnostics():
+            return {
+                "learner_queue": learner_queue.size(),
+                "inference_batcher": inference_batcher.size(),
+                "live_actors": getattr(
+                    actors, "live_actors", lambda: -1
+                )(),
+            }
+
+        watchdog = LearnerWatchdog(
+            getattr(flags, "learner_stall_timeout_s", 300.0),
+            health=health,
+            dump_fn=_stall_diagnostics,
+            registry=reg,
+        )
+
+        # Fresh health/liveness gauges on every exported line, the
+        # final shutdown write included.
+        if telemetry_on:
+            g_live = reg.gauge("actor.live")
+            tele.add_tick_callback(
+                lambda: g_live.set(
+                    getattr(actors, "live_actors", lambda: -1)()
+                )
+            )
 
         # Stage latencies (dequeue/learn) become learner.* histograms
         # in the snapshot; with telemetry off, a private registry keeps
@@ -1029,6 +1121,7 @@ def train(flags):
                             * flags.batch_size
                         )
                         now_step = state["step"]
+                watchdog.ping()
                 if pending is not None:
                     flush(pending)
                 pending = (train_stats, now_step, release)
@@ -1051,34 +1144,93 @@ def train(flags):
     # reaped) — a failure here must run that full path, not just the
     # server reap.
     try:
-        for t in inference_threads:
-            t.start()
+        infer_supervisor.start()
         actor_thread.start()
         prefetcher.start()
         learner_thread.start()
+        watchdog.start()
+        if chaos is not None:
+            chaos.start()
 
         if flags.profile_dir:
             jax.profiler.start_trace(flags.profile_dir)
 
+        num_live_floor = max(1, min(flags.min_live_actors, num_actors))
+        degraded_dead = 0  # dead-actor count already reported
         last_checkpoint = time.time()
         last_step, last_time = state["step"], time.time()
         while not state["done"]:
-            time.sleep(5)
-            pool_errors = getattr(actors, "errors", [])
-            if pool_errors and not state["done"]:
+            # A halt cuts the monitor sleep short: HALTED must reach
+            # the checkpoint-and-exit path now, not a tick later.
+            health.halted.wait(timeout=5)
+            if state["done"]:
+                break
+            # Graceful degradation (ISSUE 6): individual actor deaths
+            # DEGRADE the run instead of ending it; crossing the
+            # --min_live_actors floor halts it cleanly. The native pool
+            # has no liveness tracking — its first error stays fatal,
+            # as before.
+            live_fn = getattr(actors, "live_actors", None)
+            if live_fn is not None:
+                live = live_fn()
+                pool_errors = getattr(actors, "errors", [])
+                dead = num_actors - live
+                # Attrition-DEGRADED is sticky: retired actors never
+                # come back, so a later stall/poison recovery must not
+                # flip the run back to HEALTHY (health.degrade sticky=).
+                if dead > degraded_dead and pool_errors:
+                    degraded_dead = dead
+                    health.degrade(
+                        f"{dead}/{num_actors} actors retired "
+                        f"(last error: {pool_errors[-1]})",
+                        key="actor_attrition",
+                        sticky=True,
+                    )
+                if live < num_live_floor:
+                    health.halt(
+                        f"live actors {live} below --min_live_actors "
+                        f"{num_live_floor}"
+                    )
+                if (
+                    not actor_thread.is_alive()
+                    and live > 0
+                    and not health.is_halted
+                    and not state["done"]
+                ):
+                    # The pool runner itself died with loops alive — a
+                    # wholesale failure, not attrition. (done-guarded:
+                    # a finish landing mid-tick must not turn into a
+                    # spurious failure.)
+                    raise RuntimeError("Actor pool exited unexpectedly")
+            else:
+                # Native pool: errors are recorded C++-side while
+                # surviving loops keep running; poll them so one dead
+                # actor surfaces within 5s. done-guarded like the code
+                # this replaced: actors erroring against reaped servers
+                # during a clean finish are expected, not failures.
+                first_error = getattr(actors, "first_error_message", None)
+                if first_error is not None and not state["done"]:
+                    msg = first_error()
+                    if msg:
+                        raise RuntimeError(f"Actor pool failed: {msg}")
+                if not actor_thread.is_alive() and not state["done"]:
+                    raise RuntimeError("Actor pool exited unexpectedly")
+            if infer_supervisor.errors:
+                # An unrecoverable serving bug (not a poisoning):
+                # surface it like the old raw threads did — checked
+                # BEFORE the halt break, because with one serving
+                # thread the supervisor halts on its own crash and a
+                # clean HALTED exit would mask the bug behind rc 0.
                 raise RuntimeError(
-                    "Actor pool failed"
-                ) from pool_errors[0]
-            # Native pool: errors are recorded C++-side while surviving
-            # loops keep running; poll them so one dead actor surfaces
-            # within 5s (same visibility as the Python pool's .errors).
-            first_error = getattr(actors, "first_error_message", None)
-            if first_error is not None and not state["done"]:
-                msg = first_error()
-                if msg:
-                    raise RuntimeError(f"Actor pool failed: {msg}")
-            if not actor_thread.is_alive() and not state["done"]:
-                raise RuntimeError("Actor pool exited unexpectedly")
+                    "Inference thread failed"
+                ) from infer_supervisor.errors[0]
+            if health.is_halted:
+                log.error(
+                    "Pipeline HALTED (%s); checkpointing and exiting "
+                    "cleanly.",
+                    "; ".join(r for _, r in health.reasons()[-3:]),
+                )
+                break
             with state_lock:
                 now_step = state["step"]
                 stats_now = dict(state["stats"])
@@ -1123,6 +1275,12 @@ def train(flags):
         successful = False
         raise
     finally:
+        if chaos is not None:
+            chaos.stop()
+            # The final telemetry line carries the injection ledger the
+            # chaos harness audits recovery counters against.
+            tele.set_static("chaos", chaos.summary())
+        watchdog.stop()
         if flags.profile_dir:
             try:
                 jax.profiler.stop_trace()
@@ -1154,11 +1312,29 @@ def train(flags):
         if server_supervisor is not None:
             server_supervisor.stop()  # before terminate: no resurrect-mid-reap
         _reap_servers(server_procs)
-    log.info("Learning finished after %d steps.", state["step"])
+    log.info(
+        "Learning finished after %d steps (health %s).",
+        state["step"], health.state_name,
+    )
     stats = dict(state["stats"])
     stats["server_restarts"] = (
         server_supervisor.restarts if server_supervisor is not None else 0
     )
+    # Recovery/health summary: what scripts/chaos_run.py asserts its
+    # exact fault accounting against (and what a log reader needs to
+    # know whether "finished" meant HEALTHY or limped-home DEGRADED).
+    stats["health"] = health.state_name
+    stats["health_reasons"] = health.reasons()
+    # reconnect_count() is the method BOTH pools expose (the C++ pool
+    # has no `reconnects` property; a getattr fallback to 0 would
+    # silently zero the native runtime's recovery summary).
+    reconnect_count = getattr(actors, "reconnect_count", None)
+    stats["actor_reconnects"] = (
+        int(reconnect_count()) if reconnect_count is not None else 0
+    )
+    stats["inference_restarts"] = infer_supervisor.restarts
+    if chaos is not None:
+        stats["chaos"] = chaos.summary()
     return stats
 
 

@@ -14,6 +14,9 @@ import multiprocessing as mp
 import threading
 import time
 
+from torchbeast_tpu import telemetry
+from torchbeast_tpu.resilience.backoff import Backoff
+
 log = logging.getLogger("torchbeast_tpu.polybeast_env")
 
 
@@ -90,6 +93,13 @@ def _serve(env_name: str, address: str, native: bool = False,
     # (INFO lines like "EnvServer listening" would otherwise be lost
     # now that import no longer calls basicConfig).
     _configure_logging()
+    # SIGTERM (reap_group's terminate, a k8s preemption) must run this
+    # child's teardown — for shm servers that is the owner-side ring
+    # unlink sweep (EnvServer.stop). The default handler kills the
+    # process without finally blocks, stranding /dev/shm segments.
+    from torchbeast_tpu.utils import install_preemption_handler
+
+    install_preemption_handler()
     # Import here: workers must never inherit JAX state.
     from torchbeast_tpu.envs import create_env
 
@@ -123,7 +133,16 @@ def _serve(env_name: str, address: str, native: bool = False,
         return
     from torchbeast_tpu.runtime.env_server import EnvServer
 
-    EnvServer(env_init, address).run()
+    server = EnvServer(env_init, address)
+    try:
+        server.run()
+    except KeyboardInterrupt:
+        log.info("Env server on %s preempted; cleaning up.", address)
+    finally:
+        # stop() severs live streams and runs the owner-side shm
+        # unlink sweep — the difference between a preempted shm server
+        # and a /dev/shm leak.
+        server.stop()
 
 
 def reap_group(procs):
@@ -157,7 +176,8 @@ class ServerSupervisor:
 
     def __init__(self, flags, ctx_name: str = "spawn",
                  pipes_basename=None, env_seed=None, max_restarts=10,
-                 poll_interval_s=1.0):
+                 poll_interval_s=1.0, backoff_factory=None,
+                 stable_s=30.0):
         self._env_name = flags.env
         self._native = getattr(flags, "native_server", False)
         self._basename = pipes_basename or flags.pipes_basename
@@ -171,6 +191,20 @@ class ServerSupervisor:
         self._stop = threading.Event()
         self._thread = None
         self._budget_logged = set()  # indices already error-logged
+        # Jittered exponential backoff per slot: a crash-looping env
+        # must not be respawned every poll tick (and N servers dying
+        # together must not restart in lockstep). A member that stayed
+        # up for `stable_s` earns its slot's backoff reset.
+        self._backoff_factory = backoff_factory or (
+            lambda: Backoff(base_s=0.25, cap_s=10.0)
+        )
+        self._stable_s = stable_s
+        self._backoffs = {}  # slot -> Backoff
+        self._respawn_at = {}  # slot -> monotonic time respawn is due
+        self._spawned_at = {}  # slot -> monotonic time of last spawn
+        self._tm_restarts = telemetry.get_registry().counter(
+            "recovery.server_restarts"
+        )
         # The group list is MUTATED IN PLACE on restart so callers that
         # captured it (the driver's reap paths) always see the current
         # members.
@@ -197,6 +231,7 @@ class ServerSupervisor:
             daemon=True,
         )
         p.start()
+        self._spawned_at[i] = time.monotonic()
         return p
 
     def start_watch(self):
@@ -220,11 +255,34 @@ class ServerSupervisor:
                         )
                         self._budget_logged.add(i)
                     continue
+                now = time.monotonic()
+                due = self._respawn_at.get(i)
+                if due is None:
+                    # First poll to see this death: schedule the
+                    # respawn through jittered backoff, not
+                    # immediately — a crash-looping env must not be
+                    # respawned every tick, and simultaneous deaths
+                    # must not restart in lockstep.
+                    bo = self._backoffs.setdefault(
+                        i, self._backoff_factory()
+                    )
+                    if now - self._spawned_at.get(i, now) >= self._stable_s:
+                        bo.reset()  # the last incarnation was healthy
+                    delay = bo.next_delay()
+                    self._respawn_at[i] = now + delay
+                    log.warning(
+                        "Env server %d died (exit %s); respawning on "
+                        "its address in %.2fs (jittered backoff).",
+                        i, p.exitcode, delay,
+                    )
+                    continue
+                if now < due:
+                    continue
                 self.restarts += 1
                 log.warning(
-                    "Env server %d died (exit %s); restarting on its "
-                    "address (restart %d/%d).",
-                    i, p.exitcode, self.restarts, self.max_restarts,
+                    "Env server %d: restarting on its address "
+                    "(restart %d/%d).",
+                    i, self.restarts, self.max_restarts,
                 )
                 try:
                     replacement = self._spawn(i)
@@ -232,13 +290,18 @@ class ServerSupervisor:
                     # Spawn failure (fd/pid pressure is exactly when
                     # servers die) must not kill the watcher thread —
                     # that would END supervision silently. Refund the
-                    # attempt and retry next poll.
+                    # attempt and retry after another backoff step.
                     self.restarts -= 1
+                    self._respawn_at[i] = (
+                        time.monotonic() + self._backoffs[i].next_delay()
+                    )
                     log.exception(
-                        "Respawn of env server %d failed; retrying on "
-                        "the next poll.", i,
+                        "Respawn of env server %d failed; backing off.",
+                        i,
                     )
                     continue
+                del self._respawn_at[i]
+                self._tm_restarts.inc()
                 if self._stop.is_set():
                     # stop() landed while we were spawning: the reap may
                     # already have iterated the group, so this member

@@ -1,0 +1,45 @@
+"""Chaos-hardened pipeline machinery (ISSUE 6 tentpole).
+
+IMPALA's headline claim is tolerance to actor failure at datacenter
+scale, and Podracer-style fleets run on preemptible TPUs where workers
+die as a matter of course — yet failure handling used to be scattered
+per-component and nothing ever exercised those paths together. This
+package is the missing layer:
+
+- `backoff`:    exponential backoff with decorrelated jitter + deadline,
+                adopted by the actor reconnect loop and env-server
+                respawn (a mass server restart must not thundering-herd
+                the listener; a dead address must not be re-dialed in a
+                tight loop).
+- `supervisor`: the pipeline health state machine
+                (HEALTHY/DEGRADED/HALTED, exported as a gauge), the
+                inference-thread supervisor that rebuilds a poisoned
+                DeviceStateTable under a bounded budget, and the
+                learner stall watchdog.
+- `chaos`:      deterministic, seeded fault injection (`FaultPlan`,
+                JSON-loadable via `--chaos_plan`): env-server SIGKILL,
+                transport sever/blackhole/delay, shm-ring corruption,
+                state-table poisoning, mid-run SIGTERM — every injected
+                fault counted in telemetry so recovery can be asserted
+                exactly (scripts/chaos_run.py).
+
+Stays importable without jax: only `supervisor` touches device state,
+and only through the DeviceStateTable handle it is given.
+"""
+
+from torchbeast_tpu.resilience.backoff import (  # noqa: F401
+    Backoff,
+    BackoffDeadline,
+)
+from torchbeast_tpu.resilience.chaos import (  # noqa: F401
+    FAULT_KINDS,
+    ChaosController,
+    FaultingTransport,
+    FaultPlan,
+    FaultSpec,
+)
+from torchbeast_tpu.resilience.supervisor import (  # noqa: F401
+    InferenceSupervisor,
+    LearnerWatchdog,
+    PipelineHealth,
+)

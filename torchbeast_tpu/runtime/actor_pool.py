@@ -25,14 +25,16 @@ takes over when Python-thread overhead shows up in profiles.
 import logging
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
 from torchbeast_tpu import nest
 from torchbeast_tpu import telemetry
+from torchbeast_tpu.resilience.backoff import Backoff
 from torchbeast_tpu.runtime import transport as transport_lib
 from torchbeast_tpu.runtime import wire
+from torchbeast_tpu.runtime.errors import StateTablePoisonedError
 from torchbeast_tpu.runtime.queues import (
     AsyncError,
     BatchingQueue,
@@ -57,9 +59,11 @@ class ActorPool:
         env_server_addresses: List[str],
         initial_agent_state: Any,
         connect_timeout_s: float = 600,
-        max_reconnects: int = 0,
+        max_reconnects: int = 3,
         state_table=None,
         max_frame_bytes: Optional[int] = None,
+        backoff_factory: Optional[Callable[[], Backoff]] = None,
+        transport_wrap: Optional[Callable] = None,
     ):
         self._unroll_length = unroll_length
         self._learner_queue = learner_queue
@@ -82,13 +86,24 @@ class ActorPool:
                 f"{len(self._addresses)} actors"
             )
         # Elastic actors (beyond the reference's fail-fast): on a TRANSPORT
-        # failure (env-server death / stream cut), an actor may reconnect
-        # up to max_reconnects times with a fresh env + reset agent state
+        # failure (env-server death / stream cut) or a failed inference
+        # batch (a recovering serving thread), an actor may retry up to
+        # max_reconnects times with a fresh env + reset agent state
         # (the partial rollout is discarded; learner batches stay valid).
+        # Retries go through jittered exponential backoff — a dead
+        # server must not be re-dialed in a tight loop, and a mass
+        # server restart must not thundering-herd the fresh listener.
         # Deterministic env errors (error frames) remain fatal.
         self._max_reconnects = max_reconnects
+        self._backoff_factory = backoff_factory or (
+            lambda: Backoff(base_s=0.1, cap_s=2.0)
+        )
+        # Chaos hook (resilience/chaos.py): wraps every fresh transport
+        # so the fault plan can sever/delay/corrupt it mid-stream.
+        self._transport_wrap = transport_wrap
         self._count = 0  # guarded-by: self._count_lock
         self._reconnects = 0  # guarded-by: self._count_lock
+        self._dead = 0  # guarded-by: self._count_lock
         self._count_lock = threading.Lock()
         self._errors: List[BaseException] = []
         # Per-connection wire accounting + request RTT (ISSUE 2).
@@ -101,6 +116,12 @@ class ActorPool:
         self._tm_rtt = reg.histogram("actor.request_rtt_s")
         self._tm_steps = reg.counter("actor.env_steps")
         self._tm_connects = reg.counter("actor.connects")
+        # Recovery accounting (ISSUE 6): the chaos harness asserts these
+        # against the injected fault counts, so each counter covers ONE
+        # failure class — transport failures (reconnects) and failed
+        # inference batches (rollout retries) never share a series.
+        self._tm_reconnects = reg.counter("recovery.actor_reconnects")
+        self._tm_retries = reg.counter("recovery.batch_retries")
         self._tracer = telemetry.get_tracer()
         # Sampled per-request pipeline traces: one in _TRACE_EVERY
         # computes rides a StageTrace through the batcher (enqueue ->
@@ -130,6 +151,13 @@ class ActorPool:
         """Method form matching the native pool's API."""
         return self.reconnects
 
+    def live_actors(self) -> int:
+        """Actor loops still running. The driver's health machine runs
+        DEGRADED while this stays >= --min_live_actors and halts (clean
+        checkpoint-and-exit) below it."""
+        with self._count_lock:
+            return len(self._addresses) - self._dead
+
     def run(self):
         """Run one loop per address; blocks until all exit. First error is
         re-raised (reference surfaces only the first future's exception,
@@ -148,7 +176,23 @@ class ActorPool:
             raise self._errors[0]
 
     def _guarded_loop(self, index: int, address: str):
-        reconnects = 0
+        try:
+            self._recovering_loop(index, address)
+        finally:
+            # Any exit — clean shutdown or a burned budget — retires
+            # this actor; live_actors() feeds the health machine.
+            with self._count_lock:
+                self._dead += 1
+
+    def _shutting_down(self) -> bool:
+        return (
+            self._inference_batcher.is_closed()
+            or self._learner_queue.is_closed()
+        )
+
+    def _recovering_loop(self, index: int, address: str):
+        failures = 0  # transport failures + batch retries, refillable
+        backoff = self._backoff_factory()
         progress = [0]  # this actor's env steps (across reconnects)
         while True:
             steps_at_connect = progress[0]
@@ -157,14 +201,32 @@ class ActorPool:
                 return
             except ClosedBatchingQueue:
                 return  # clean shutdown (reference actorpool.cc:452-459)
-            except AsyncError as e:
-                # Clean only when the pipeline is actually shutting down;
-                # a broken promise mid-training (inference failure) is real.
-                if (
-                    self._inference_batcher.is_closed()
-                    or self._learner_queue.is_closed()
-                ):
+            except (AsyncError, StateTablePoisonedError) as e:
+                # A broken inference promise mid-training — or a DIRECT
+                # table call (the unroll-boundary read_slot, the
+                # connect-time reset) landing inside the poison-to-
+                # rebuild window. During shutdown that's expected;
+                # otherwise the failure may come from a RECOVERING
+                # serving thread (state-table rebuild) — discard the
+                # partial rollout and retry the stream under the same
+                # budget/backoff as a reconnect, instead of retiring
+                # the actor for good.
+                if self._shutting_down():
                     return
+                if progress[0] - steps_at_connect >= self._unroll_length:
+                    failures = 0
+                    backoff.reset()
+                if failures < self._max_reconnects:
+                    failures += 1
+                    self._tm_retries.inc()
+                    delay = backoff.sleep()
+                    log.warning(
+                        "Actor %d (%s): inference/state-table failure "
+                        "(%s); retry %d/%d after %.2fs backoff",
+                        index, address, e, failures,
+                        self._max_reconnects, delay,
+                    )
+                    continue
                 log.exception("Actor %d (%s) failed", index, address)
                 self._errors.append(e)
                 return
@@ -174,24 +236,25 @@ class ActorPool:
                 # cut. During pipeline shutdown that's expected — exit
                 # cleanly instead of burning the reconnect budget against
                 # deliberately-stopped servers.
-                if (
-                    self._inference_batcher.is_closed()
-                    or self._learner_queue.is_closed()
-                ):
+                if self._shutting_down():
                     return
                 # A full recovery (at least one unroll streamed since the
                 # last connect) earns the budget back — long runs survive
                 # any number of spaced-out server redeploys.
                 if progress[0] - steps_at_connect >= self._unroll_length:
-                    reconnects = 0
-                if reconnects < self._max_reconnects:
-                    reconnects += 1
+                    failures = 0
+                    backoff.reset()
+                if failures < self._max_reconnects:
+                    failures += 1
                     with self._count_lock:
                         self._reconnects += 1
+                    self._tm_reconnects.inc()
+                    delay = backoff.sleep()
                     log.warning(
                         "Actor %d (%s): transport failure (%s); "
-                        "reconnect %d/%d",
-                        index, address, e, reconnects, self._max_reconnects,
+                        "reconnect %d/%d after %.2fs backoff",
+                        index, address, e, failures,
+                        self._max_reconnects, delay,
                     )
                     continue
                 log.exception("Actor %d (%s) failed", index, address)
@@ -202,15 +265,20 @@ class ActorPool:
                 self._errors.append(e)
                 return
 
-    def _connect(self, address: str):
+    def _connect(self, address: str, index: int):
         """Transport connect with retries until the deadline (the
         reference's 10-minute WaitForConnected semantics,
         actorpool.cc:354-372) — SocketTransport for tcp/unix addresses,
-        ShmTransport (handshaken rings) for shm://."""
-        return transport_lib.connect_transport(
+        ShmTransport (handshaken rings) for shm://. The chaos wrap (if
+        armed) goes on here so injected faults see every connection,
+        including post-reconnect ones."""
+        sock = transport_lib.connect_transport(
             address, timeout_s=self._connect_timeout_s,
             max_frame_bytes=self._max_frame_bytes,
         )
+        if self._transport_wrap is not None:
+            sock = self._transport_wrap(sock, index)
+        return sock
 
     @staticmethod
     def _env_outputs(msg) -> dict:
@@ -237,7 +305,7 @@ class ActorPool:
     def _loop(self, index: int, address: str, progress=None):
         progress = progress if progress is not None else [0]
         table = self._state_table
-        sock = self._connect(address)
+        sock = self._connect(address, index)
         self._tm_connects.inc()
         try:
             if table is not None:
@@ -280,7 +348,18 @@ class ActorPool:
                     else:
                         initial_agent_state = agent_state
         finally:
-            sock.close()
+            # shm connections: unlink the ring segments on every
+            # teardown. A SIGKILL'd env server can't clean up its own
+            # segments (/dev/shm would fill across chaos cycles); for a
+            # live server this merely pre-empts the unlink its stream
+            # teardown does anyway (rings are per-connection, never
+            # re-attached — see ShmRing.unlink).
+            try:
+                sweep = getattr(sock, "unlink_segments", None)
+                if sweep is not None:
+                    sweep()
+            finally:
+                sock.close()
 
     def _request(self, inputs, index: int):
         """One batcher round-trip with RTT telemetry and a sampled

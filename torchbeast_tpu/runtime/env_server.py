@@ -62,6 +62,10 @@ class EnvServer:
         self._sock = None
         self._threads = []
         self._conns = []
+        # conn -> (shm segment names) for live shm streams: stop()'s
+        # owner-side sweep unlinks whatever a stream thread didn't get
+        # to (ISSUE 6 — SIGKILL chaos must not grow /dev/shm).
+        self._ring_names = {}  # guarded-by: self._conns_lock
         self._conns_lock = threading.Lock()
         self._running = False
         # NB: env servers usually run as separate processes, so these
@@ -134,6 +138,24 @@ class EnvServer:
             except OSError:
                 pass
             conn.close()
+        # Owner-side shm sweep: give the stream threads a moment to
+        # close their rings (which unlinks them), then unlink whatever
+        # is left. A thread wedged past the join window must not strand
+        # segments in /dev/shm — unlink is safe under live mappings.
+        for t in list(self._threads):
+            t.join(timeout=2)
+        with self._conns_lock:
+            leftovers = [
+                name
+                for names in self._ring_names.values()
+                for name in names
+            ]
+            self._ring_names.clear()
+        for name in leftovers:
+            if transport_lib.unlink_segment(name):
+                log.warning(
+                    "EnvServer stop(): swept leaked shm segment %s", name
+                )
         if self._family == socket.AF_UNIX:
             try:
                 os.unlink(self._target)
@@ -158,6 +180,9 @@ class EnvServer:
                 act_ring_bytes=self._act_ring_bytes,
                 max_frame_bytes=self._max_frame_bytes,
             )
+            if self._shm:
+                with self._conns_lock:
+                    self._ring_names[conn] = stream.segment_names
             raw_env = self._env_init()
             env = Environment(raw_env)
             # The initial Step doubles as the env spec: remote learners
@@ -206,6 +231,9 @@ class EnvServer:
             with self._conns_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+                # stream.close() unlinked the rings; drop them from the
+                # stop() sweep's ledger.
+                self._ring_names.pop(conn, None)
                 self._tm_conns.set(len(self._conns))
 
 

@@ -1,0 +1,18 @@
+"""Runtime error types shared across jax-free and jax-bound modules.
+
+`StateTablePoisonedError` is raised by the (jax-heavy) DeviceStateTable
+but must be CAUGHT by the actor pool and the inference supervisor —
+both importable without jax. Defining it here keeps the catch sites
+free of a module-level jax import; `runtime.state_table` re-exports it
+as the canonical public name.
+"""
+
+
+class StateTablePoisonedError(RuntimeError):
+    """A table-mutating dispatch failed after its buffer was donated:
+    the table may be consumed and must not serve another request. The
+    inference supervisor (resilience/supervisor.py) catches exactly
+    this type to rebuild the table and restart the serving thread, and
+    the actor pool treats it as a budgeted rollout retry (the rebuild
+    is in flight); anything else that escapes a serving loop is a real
+    bug and stays fatal."""

@@ -181,6 +181,7 @@ def inference_loop(
     _h_reply = _reg.histogram("inference.reply_s")
     _c_batches = _reg.counter("inference.batches")
     _c_rows = _reg.counter("inference.rows")
+    _c_poison = _reg.counter("inference.poison_exits")
     # A Python DynamicBatcher with a telemetry_name already observes
     # inference.batch_size per dequeued batch — observing here too
     # would double-count it. The loop keeps that role only for
@@ -273,9 +274,22 @@ def inference_loop(
                 pending = None
             if state_table is not None and state_table.poisoned:
                 # The donated table buffer may already be consumed;
-                # per-batch retry would serve garbage state. Die loudly.
+                # per-batch retry would serve garbage state. Die loudly
+                # — with the TYPED error, so a supervising wrapper
+                # (resilience.InferenceSupervisor) can distinguish
+                # "rebuild the table and restart me" from a real
+                # serving bug that must stay fatal.
+                from torchbeast_tpu.runtime.errors import (
+                    StateTablePoisonedError,
+                )
+
+                _c_poison.inc()
                 log.exception("State table poisoned; inference thread exiting")
-                raise
+                if isinstance(e, StateTablePoisonedError):
+                    raise
+                raise StateTablePoisonedError(
+                    f"state table poisoned by: {type(e).__name__}: {e}"
+                ) from e
             log.exception("Inference batch failed; continuing")
             continue
         # This batch is dispatched (async); NOW reply to the previous one.

@@ -54,6 +54,14 @@ from torchbeast_tpu import nest
 from torchbeast_tpu import telemetry
 
 
+# Canonical re-export: the class lives in runtime/errors.py so the
+# jax-free catch sites (actor pool, inference supervisor) can import it
+# without pulling this module's jax dependency.
+from torchbeast_tpu.runtime.errors import (  # noqa: F401
+    StateTablePoisonedError,
+)
+
+
 def _leaves(tree) -> bool:
     return bool(jax.tree_util.tree_leaves(tree))
 
@@ -124,7 +132,8 @@ class DeviceStateTable:
             reps[bd] = rows
             return jnp.tile(leaf, reps)
 
-        self._table = jax.tree_util.tree_map(expand, self._initial)
+        self._expand = expand
+        self._table = self._fresh_table()
 
         def index(slots):
             return (slice(None),) * bd + (slots,)
@@ -164,6 +173,11 @@ class DeviceStateTable:
         self._reset_jit = jax.jit(reset, donate_argnums=(0,))
         self._gather_jit = jax.jit(gather)
 
+    def _fresh_table(self):
+        """A brand-new [.., num_slots+1, ..] table, every slot at the
+        initial state."""
+        return jax.tree_util.tree_map(self._expand, self._initial)
+
     @property
     def trash_slot(self) -> int:
         """Slot id bucket padding scatters to (never read back)."""
@@ -174,16 +188,42 @@ class DeviceStateTable:
         """True after a table-mutating dispatch failed. The table buffer
         is donated into every step/reset, so a dispatch that raises may
         already have consumed it — continuing would be a use-after-free
-        with garbage state. All further calls raise; the driver must
-        treat this as fatal (inference_loop re-raises to kill its
-        thread) rather than retry per-batch."""
+        with garbage state. All further calls raise
+        StateTablePoisonedError; the serving loop re-raises to kill its
+        thread rather than retry per-batch, and the inference
+        supervisor (resilience/supervisor.py) owns the recovery:
+        `rebuild()` + a thread restart under a bounded budget."""
         return self._table is None
+
+    def poison(self) -> None:
+        """Chaos/testing hook: put the table into the poisoned state a
+        failed donated dispatch produces (resilience/chaos.py's
+        `state_table_poison` fault). The dropped buffer is reclaimed by
+        XLA once its in-flight uses retire."""
+        with self._lock:
+            self._table = None
+
+    def rebuild(self) -> None:
+        """Recover from poisoning: a fresh table, every actor slot back
+        at the initial state. Serving threads may restart immediately
+        after. Actors whose request was in the FAILED batch re-prime
+        via their batch-retry path (partial rollout discarded, same as
+        a reconnect), so their slot state and rollout boundaries
+        re-align. Actors with NO request in flight at poison time
+        continue their current unroll against a silently-reset slot —
+        a bounded mid-unroll state glitch (at most one unroll per
+        actor per rebuild), equivalent to the episode-boundary resets
+        V-trace already absorbs; pinned acceptable by the chaos
+        harness's return-parity check."""
+        with self._lock:
+            self._table = self._fresh_table()
 
     def _require_alive(self):
         if self._table is None:
-            raise RuntimeError(
+            raise StateTablePoisonedError(
                 "DeviceStateTable is poisoned: a prior step/reset failed "
-                "after its table buffer was donated; restart the run"
+                "after its table buffer was donated; rebuild() it (the "
+                "inference supervisor does) before serving again"
             )
 
     def _put_ids(self, slots):

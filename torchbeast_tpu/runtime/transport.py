@@ -112,7 +112,32 @@ def _tracker(action: str, shm) -> None:
             getattr(shm, "_name", shm.name), "shared_memory"
         )
     except Exception:  # pragma: no cover
-        pass
+        log.debug("resource_tracker %s failed", action, exc_info=True)
+
+
+def unlink_segment(name: str) -> bool:
+    """Best-effort unlink of a SharedMemory segment by name — the crash
+    sweep for segments whose owner died without cleanup (a SIGKILL'd
+    env server). Returns True when this call removed the segment; False
+    when it was already gone (the owner, or another sweeper, got there
+    first)."""
+    from multiprocessing import shared_memory
+
+    try:
+        seg = shared_memory.SharedMemory(name=name)
+    except (FileNotFoundError, OSError):
+        return False
+    try:
+        seg.unlink()  # unregisters the attach's tracker entry too
+        return True
+    except FileNotFoundError:
+        _tracker("unregister", seg)  # nothing unlinked: rebalance
+        return False
+    finally:
+        try:
+            seg.close()
+        except BufferError:  # pragma: no cover
+            log.debug("sweep close of %s kept a view alive", name)
 
 
 class SocketTransport:
@@ -311,6 +336,24 @@ class ShmRing:
     def reader_waiting(self) -> bool:
         return self._u64[self._WAITING] != 0
 
+    def poke(self, pos: int, data: bytes) -> None:
+        """Write raw bytes into the DATA region at offset `pos` — the
+        chaos-injection/corruption-test hook (resilience/chaos.py,
+        tests/test_shm_transport.py). Never called on a healthy path."""
+        self._data[pos : pos + len(data)] = data
+
+    def unlink(self) -> None:
+        """Best-effort unlink regardless of ownership — the crash sweep
+        for a dead owner. Safe against a live peer: segments are
+        per-connection and never re-attached, so unlinking early only
+        turns the owner's own later unlink into a FileNotFoundError
+        no-op (existing mappings stay valid until unmapped)."""
+        _tracker("register", self._shm)  # balance unlink's unregister
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            _tracker("unregister", self._shm)  # nothing was unlinked
+
     # -- consumer ---------------------------------------------------------
     def has_frame(self) -> bool:
         return self._u64[self._HEAD] != self._u64[self._TAIL]
@@ -379,7 +422,10 @@ class ShmRing:
             try:
                 self._shm.unlink()
             except FileNotFoundError:
-                pass
+                # A crash sweep (unlink_segment / ring.unlink) got here
+                # first: rebalance so the tracker doesn't warn about a
+                # "leaked" segment at process exit.
+                _tracker("unregister", self._shm)
 
 
 class ShmTransport:
@@ -587,6 +633,21 @@ class ShmTransport:
 
     def recv(self) -> Any:
         return self.recv_sized()[0]
+
+    @property
+    def segment_names(self) -> Tuple[str, str]:
+        """(send ring, recv ring) SharedMemory names — what a teardown
+        sweep needs to unlink if this connection's owner is gone."""
+        return self._send_ring.name, self._recv_ring.name
+
+    def unlink_segments(self) -> None:
+        """Crash sweep: unlink both ring segments regardless of which
+        end owns them. The actor pool calls this on every shm
+        connection teardown — a SIGKILL'd env server can't clean up its
+        own segments, and for a live server the sweep only pre-empts
+        the unlink its stream teardown would do anyway."""
+        self._send_ring.unlink()
+        self._recv_ring.unlink()
 
     def close(self):
         try:

@@ -70,6 +70,7 @@ class DriverTelemetry:
         self.exporter: Optional[JsonLinesExporter] = None
         self.prometheus: Optional[PrometheusServer] = None
         self._trace_path = getattr(flags, "trace_path", None)
+        self._tick_callbacks = []
         if not self.enabled:
             return
         self.exporter = JsonLinesExporter(
@@ -99,6 +100,14 @@ class DriverTelemetry:
         if self.exporter is not None:
             self.exporter.static[key] = value
 
+    def add_tick_callback(self, fn) -> None:
+        """Run `fn()` right before EVERY snapshot write — the periodic
+        monitor ticks AND the final shutdown line. Sampled gauges
+        (live-actor count, queue depths read off live objects) stay
+        fresh on each exported line instead of freezing at whatever the
+        last monitor tick saw."""
+        self._tick_callbacks.append(fn)
+
     def write(self, extra: Optional[Dict] = None) -> None:
         """One snapshot line (monitor/log tick). Broad guard, not just
         OSError: json serialization of a bad static/extra value
@@ -106,6 +115,11 @@ class DriverTelemetry:
         never abort the training loop it watches."""
         if self.exporter is None:
             return
+        for cb in self._tick_callbacks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                log.exception("Telemetry tick callback failed")
         try:
             self.exporter.write(extra=extra)
         except Exception:  # noqa: BLE001
@@ -120,10 +134,9 @@ class DriverTelemetry:
             extra = {"final": True}
             if step is not None:
                 extra["step"] = step
-            try:
-                self.exporter.write(extra=extra)
-            except Exception:  # noqa: BLE001
-                log.exception("Final telemetry write failed")
+            # Through write(): the tick callbacks refresh sampled
+            # gauges on the final line too.
+            self.write(extra=extra)
         if self.prometheus is not None:
             try:
                 self.prometheus.stop()
