@@ -129,7 +129,23 @@ class BatchingQueue {
           if (!deque_.empty()) break;
           can_dequeue_.wait(lock);
         } else if (deadline) {
+#if defined(__SANITIZE_THREAD__)
+          // TSan builds only: a steady_clock wait_until lowers to
+          // pthread_cond_clockwait (glibc >= 2.30), which GCC 10's
+          // libtsan does not intercept — TSan then never sees the mutex
+          // released inside the wait and reports bogus double-locks/
+          // races on every subsequent queue op (observed: ~90 reports
+          // on the dynamic-batcher suite). Wait against a system-clock
+          // deadline there (pthread_cond_timedwait, which TSan models);
+          // the steady deadline above stays authoritative, and the
+          // wall-clock jump sensitivity this introduces is acceptable
+          // in a sanitizer lane.
+          can_dequeue_.wait_until(
+              lock, std::chrono::system_clock::now() +
+                        (*deadline - std::chrono::steady_clock::now()));
+#else
           can_dequeue_.wait_until(lock, *deadline);
+#endif
         } else {
           can_dequeue_.wait(lock);
         }

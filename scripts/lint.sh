@@ -1,0 +1,17 @@
+#!/bin/bash
+# beastlint pre-commit wrapper: lint only the files changed vs a git ref
+# (default HEAD — staged + unstaged + untracked), with the whole-program
+# graph and parity anchors still built repo-wide.
+#
+#   scripts/lint.sh              # lint your working-tree changes
+#   scripts/lint.sh origin/main  # lint everything since origin/main
+#
+# Wire it as a pre-commit hook with:
+#   ln -s ../../scripts/lint.sh .git/hooks/pre-commit
+#
+# Exit codes match the analyzer: 0 clean, 1 findings, 2 internal error.
+set -euo pipefail
+# rev-parse, not dirname: invoked as .git/hooks/pre-commit (a symlink),
+# $0's directory is .git/hooks/ and dirname does not resolve symlinks.
+cd "$(git rev-parse --show-toplevel)"
+exec python -m torchbeast_tpu.analysis --ci --diff "${1:-HEAD}"

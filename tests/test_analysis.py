@@ -756,15 +756,17 @@ class TestSelftestAndGate:
         assert set(verdict["rules"]) == {
             "HOTPATH-SYNC", "JIT-HAZARD", "DONATE-USE", "IMPORT-PURITY",
             "LOCK-DISCIPLINE", "EXCEPT-SWALLOW", "WIRE-PARITY",
-            "FLAG-PARITY",
+            "FLAG-PARITY", "RACE", "LOCK-ORDER", "HOTPATH-SYNC-XPROC",
         }
         for name, checks in verdict["rules"].items():
             assert checks["positive"] and checks["clean"], (name, checks)
 
     def test_ci_gate_clean_and_fast(self):
-        """THE acceptance gate: `python -m torchbeast_tpu.analysis --ci`
-        exits 0 on the repo (empty baseline, reasoned suppressions only)
-        and the analysis pass itself stays under ~10s."""
+        """THE acceptance gate (ISSUE 5, re-pinned by ISSUE 7 with the
+        whole-program graph layer active): `python -m
+        torchbeast_tpu.analysis --ci` exits 0 on the repo (empty
+        baseline, reasoned suppressions only, all three concurrency
+        rules running) in under the 15s budget on this container."""
         t0 = time.monotonic()
         proc = subprocess.run(
             [sys.executable, "-m", "torchbeast_tpu.analysis",
@@ -780,8 +782,13 @@ class TestSelftestAndGate:
         # Every surviving suppression carries a reason (the engine also
         # enforces this as SUPPRESS-REASON findings — belt and braces).
         assert all(s["reason"] for s in report["suppressed"])
-        assert report["elapsed_s"] < 10, report["elapsed_s"]
-        assert wall < 60  # import + scan, generous for a loaded sandbox
+        # ISSUE 7 acceptance: < 15s repo-wide WITH the graph layer (the
+        # RACE burn-down suppressions prove the concurrency rules ran).
+        assert report["elapsed_s"] < 15, report["elapsed_s"]
+        assert any(
+            s["rule"] == "RACE" for s in report["suppressed"]
+        ), "concurrency rules did not run in the gate"
+        assert wall < 90  # import + scan, generous for a loaded sandbox
 
     def test_cli_exits_nonzero_on_findings(self, tmp_path):
         bad = tmp_path / "bad.py"
@@ -835,3 +842,39 @@ class TestSanitizerWiring:
 
     def test_ubsan_wire_smoke(self):
         self._run_sanitized("undefined")
+
+    def _run_sanitized_filter(self, sanitizer, filt):
+        proc = subprocess.run(
+            ["bash", "scripts/build_native.sh",
+             f"--sanitize={sanitizer}", f"--filter={filt}"],
+            capture_output=True, text=True, cwd=REPO, timeout=600,
+        )
+        if proc.returncode != 0 and (
+            "cannot find" in proc.stderr
+            or "unrecognized" in proc.stderr
+            or "Shadow memory" in proc.stderr
+            or "unsupported" in proc.stderr.lower()
+        ):
+            pytest.skip(
+                f"{sanitizer} sanitizer unavailable in this toolchain/"
+                f"sandbox: {proc.stderr.strip().splitlines()[-1]}"
+            )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "FILTERED NATIVE CORE TESTS PASSED" in proc.stdout
+        # TSan reports land on stderr; rc is already non-zero when any
+        # race fires, but pin the absence explicitly so a future
+        # `halt_on_error=0` env can't mask one.
+        assert "ThreadSanitizer" not in proc.stderr, proc.stderr
+
+    def test_tsan_queue_suites(self):
+        """ISSUE 7 satellite: the C++ BatchingQueue suites (incl. the
+        multi-producer stress test) run clean under ThreadSanitizer."""
+        self._run_sanitized_filter("thread", "queue")
+
+    def test_tsan_batcher_suites(self):
+        """The batching/dynamic-batcher suites under TSan. Regression
+        for the csrc/queues.h timed wait: a steady_clock wait_until
+        lowers to pthread_cond_clockwait, which this toolchain's TSan
+        does not intercept — the old code produced ~90 bogus
+        double-lock/race reports on this exact suite."""
+        self._run_sanitized_filter("thread", "atch")

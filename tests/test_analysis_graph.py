@@ -1,0 +1,778 @@
+"""Whole-program layer tests (ISSUE 7): module/call/thread-root graph
+construction over synthetic multi-module fixtures, the thread-root
+enumeration pinned against a grep-derived ground truth, the repo's real
+lock-ordering edge set, the RACE / LOCK-ORDER / HOTPATH-SYNC-XPROC rules
+beyond their selftest fixtures, the extended FLAG-PARITY groups in
+anger, and the `--diff` mode plumbing."""
+
+import os
+import re
+import subprocess
+import sys
+
+from torchbeast_tpu import analysis
+from torchbeast_tpu.analysis import analyze_sources
+from torchbeast_tpu.analysis import config as lint_config
+from torchbeast_tpu.analysis import graph as graph_mod
+from torchbeast_tpu.analysis import summaries as summaries_mod
+from torchbeast_tpu.analysis.engine import FileContext, run_rules
+from torchbeast_tpu.analysis.rules import (
+    CONCURRENCY_RULES,
+    FILE_RULES,
+    LockOrderRule,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _program(sources):
+    return graph_mod.build_program(
+        [FileContext(path, src) for path, src in sources.items()]
+    )
+
+
+def _repo_program():
+    files = analysis.discover_files(["."], REPO)
+    ctxs = [
+        c for c in (analysis.load_context(f, REPO) for f in files) if c
+    ]
+    scoped = [
+        c for c in ctxs
+        if any(
+            c.path.startswith(p + "/") or c.path == p
+            for p in lint_config.CONCURRENCY_PATHS
+        )
+    ]
+    return graph_mod.get_program(scoped)
+
+
+def _rules(report, name):
+    return [f for f in report.findings if f.rule == name]
+
+
+# ---------------------------------------------------------------------------
+# Call graph over synthetic multi-module fixtures
+
+
+class TestCallGraph:
+    WORKER = (
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self, loop_fn):\n"
+        "        self._loop_fn = loop_fn\n"
+        "        self._lock = threading.Lock()\n"
+        "    def run(self):\n"
+        "        self._loop_fn()\n"
+        "    def helper(self):\n"
+        "        return 1\n"
+    )
+
+    def test_cross_module_method_resolution(self):
+        prog = _program({
+            "torchbeast_tpu/wk.py": self.WORKER,
+            "torchbeast_tpu/drv.py": (
+                "from torchbeast_tpu.wk import Worker\n"
+                "def main():\n"
+                "    w = Worker(None)\n"
+                "    w.helper()\n"
+            ),
+        })
+        edges = prog.call_edges.get("torchbeast_tpu/drv.py::main", set())
+        assert "torchbeast_tpu/wk.py::Worker.helper" in edges
+        assert "torchbeast_tpu/wk.py::Worker.__init__" in edges
+
+    def test_reexport_through_package_init(self):
+        prog = _program({
+            "torchbeast_tpu/pkg/__init__.py": (
+                "from torchbeast_tpu.pkg.impl import Worker\n"
+            ),
+            "torchbeast_tpu/pkg/impl.py": (
+                "class Worker:\n"
+                "    def helper(self):\n"
+                "        return 1\n"
+            ),
+            "torchbeast_tpu/drv.py": (
+                "import torchbeast_tpu.pkg as pkg\n"
+                "def main():\n"
+                "    w = pkg.Worker()\n"
+                "    w.helper()\n"
+            ),
+        })
+        edges = prog.call_edges.get("torchbeast_tpu/drv.py::main", set())
+        assert "torchbeast_tpu/pkg/impl.py::Worker.helper" in edges
+
+    def test_constructor_callable_binding(self):
+        """`Worker(serve)` + `__init__` storing the param means
+        `self._loop_fn()` dispatches to `serve` — the
+        InferenceSupervisor pattern."""
+        prog = _program({
+            "torchbeast_tpu/wk.py": self.WORKER,
+            "torchbeast_tpu/drv.py": (
+                "from torchbeast_tpu.wk import Worker\n"
+                "def serve():\n"
+                "    return 2\n"
+                "def main():\n"
+                "    w = Worker(serve)\n"
+                "    w.run()\n"
+            ),
+        })
+        edges = prog.call_edges.get("torchbeast_tpu/wk.py::Worker.run",
+                                    set())
+        assert "torchbeast_tpu/drv.py::serve" in edges
+
+    def test_nested_def_and_local_alias(self):
+        prog = _program({
+            "torchbeast_tpu/drv.py": (
+                "def train():\n"
+                "    def learner_loop():\n"
+                "        return tick()\n"
+                "    def tick():\n"
+                "        return 1\n"
+                "    learner_loop()\n"
+            ),
+        })
+        qual = "torchbeast_tpu/drv.py::train.learner_loop"
+        assert "torchbeast_tpu/drv.py::train.tick" in (
+            prog.call_edges.get(qual, set())
+        )
+        assert qual in prog.call_edges.get(
+            "torchbeast_tpu/drv.py::train", set()
+        )
+
+    def test_getattr_property_dispatch(self):
+        prog = _program({
+            "torchbeast_tpu/wk.py": (
+                "import threading\n"
+                "class Table:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._t = 1\n"
+                "    @property\n"
+                "    def poisoned(self):\n"
+                "        return self._t is None\n"
+            ),
+            "torchbeast_tpu/drv.py": (
+                "from torchbeast_tpu.wk import Table\n"
+                "def main():\n"
+                "    t = Table()\n"
+                "    return getattr(t, 'poisoned', False)\n"
+            ),
+        })
+        assert "torchbeast_tpu/wk.py::Table.poisoned" in (
+            prog.call_edges.get("torchbeast_tpu/drv.py::main", set())
+        )
+
+
+# ---------------------------------------------------------------------------
+# Thread roots
+
+
+class TestThreadRoots:
+    def test_spawn_site_and_reachability(self):
+        prog = _program({
+            "torchbeast_tpu/wk.py": (
+                "import threading\n"
+                "class Pump:\n"
+                "    def __init__(self):\n"
+                "        self._thread = threading.Thread("
+                "target=self._drain)\n"
+                "    def start(self):\n"
+                "        self._thread.start()\n"
+                "    def _drain(self):\n"
+                "        self._step()\n"
+                "    def _step(self):\n"
+                "        pass\n"
+            ),
+        })
+        [site] = prog.spawn_sites
+        assert site.kind == "thread" and not site.multi
+        assert site.target == "torchbeast_tpu/wk.py::Pump._drain"
+        [root_id] = [
+            r for r in prog.roots if r != graph_mod.DRIVER_ROOT
+        ]
+        reach = {
+            q for q, roots in prog.func_roots.items() if root_id in roots
+        }
+        assert "torchbeast_tpu/wk.py::Pump._step" in reach
+
+    def test_loop_spawn_is_multi_instance(self):
+        prog = _program({
+            "torchbeast_tpu/wk.py": (
+                "import threading\n"
+                "class Pool:\n"
+                "    def run(self, n):\n"
+                "        ts = [\n"
+                "            threading.Thread(target=self._loop)\n"
+                "            for _ in range(n)\n"
+                "        ]\n"
+                "        for t in ts:\n"
+                "            t.start()\n"
+                "    def _loop(self):\n"
+                "        pass\n"
+            ),
+        })
+        [site] = prog.spawn_sites
+        assert site.multi, "comprehension spawn must be multi-instance"
+
+    def test_process_target_is_a_root(self):
+        prog = _program({
+            "torchbeast_tpu/wk.py": (
+                "import multiprocessing as mp\n"
+                "def _serve():\n"
+                "    pass\n"
+                "def main():\n"
+                "    p = mp.get_context('spawn').Process(target=_serve)\n"
+                "    p.start()\n"
+            ),
+        })
+        [site] = prog.spawn_sites
+        assert site.kind == "process"
+        assert site.target == "torchbeast_tpu/wk.py::_serve"
+
+    def test_driver_mains_merge_into_one_root(self):
+        """main/train/cli across modules are ONE thread: a process has
+        one main thread and two drivers never share a process."""
+        prog = _program({
+            "torchbeast_tpu/a.py": "def main():\n    pass\n",
+            "torchbeast_tpu/b.py": (
+                "def train():\n    pass\n"
+                "def cli():\n    train()\n"
+            ),
+        })
+        driver_roots = [
+            r for r, info in prog.roots.items() if info.kind == "driver"
+        ]
+        assert driver_roots == [graph_mod.DRIVER_ROOT]
+
+    def test_repo_thread_roots_match_grep_ground_truth(self):
+        """ISSUE 7 acceptance: the thread-root graph enumerates EVERY
+        `threading.Thread(...)` construction site in runtime/ +
+        resilience/ + the drivers, pinned against a grep over the
+        sources (so a new spawn idiom the graph misses fails here, not
+        silently)."""
+        scope_files = []
+        for rel in ("torchbeast_tpu/runtime", "torchbeast_tpu/resilience"):
+            base = os.path.join(REPO, rel)
+            scope_files += [
+                os.path.join(base, f) for f in os.listdir(base)
+                if f.endswith(".py")
+            ]
+        for rel in (
+            "torchbeast_tpu/polybeast.py",
+            "torchbeast_tpu/polybeast_env.py",
+            "torchbeast_tpu/monobeast.py",
+            "scripts/chaos_run.py",
+        ):
+            scope_files.append(os.path.join(REPO, rel))
+        expected = set()
+        for path in scope_files:
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if re.search(r"threading\.Thread\(", line):
+                        expected.add((rel, lineno))
+        assert expected, "ground truth grep found no spawn sites?"
+        prog = _repo_program()
+        got = {
+            (s.path, s.line) for s in prog.spawn_sites
+            if s.kind == "thread"
+        }
+        missing = expected - got
+        assert not missing, (
+            f"thread-root graph missed Thread() sites: {sorted(missing)}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# RACE — beyond the selftest pair
+
+
+class TestRaceRule:
+    def _analyze(self, src, path="torchbeast_tpu/fixture.py"):
+        return analyze_sources({path: src})
+
+    SHARED = (
+        "import threading\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._total = 0{annotation}\n"
+        "        self._thread = threading.Thread(target=self._drain)\n"
+        "    def start(self):\n"
+        "        self._thread.start()\n"
+        "    def _drain(self):\n"
+        "        while True:\n"
+        "            {drain_body}\n"
+        "    def snapshot(self):\n"
+        "        {snapshot_body}\n"
+        "def main():\n"
+        "    p = Pump()\n"
+        "    p.start()\n"
+        "    return p.snapshot()\n"
+    )
+
+    def test_cross_root_conflict_flagged_with_guard_hint(self):
+        src = self.SHARED.format(
+            annotation="",
+            drain_body="self._total += 1",
+            snapshot_body=(
+                "with self._lock:\n            return self._total"
+            ),
+        )
+        found = _rules(self._analyze(src), "RACE")
+        assert len(found) == 1
+        assert "_lock" in found[0].message  # dominance-inferred guard
+
+    def test_annotation_becomes_crosschecked_assertion(self):
+        src = self.SHARED.format(
+            annotation="  # guarded-by: self._lock",
+            drain_body="self._total += 1",
+            snapshot_body=(
+                "with self._lock:\n            return self._total"
+            ),
+        )
+        found = _rules(self._analyze(src), "RACE")
+        assert len(found) == 1
+        assert "annotation claims" in found[0].message
+
+    def test_common_lock_infers_guard_without_annotation(self):
+        src = self.SHARED.format(
+            annotation="",
+            drain_body=(
+                "with self._lock:\n                self._total += 1"
+            ),
+            snapshot_body=(
+                "with self._lock:\n            return self._total"
+            ),
+        )
+        assert not _rules(self._analyze(src), "RACE")
+
+    def test_immutable_after_init_exempt(self):
+        src = self.SHARED.format(
+            annotation="",
+            drain_body="use(self._total)",
+            snapshot_body="return self._total",
+        )
+        assert not _rules(self._analyze(src), "RACE")
+
+    def test_spawn_site_writes_before_start_exempt(self):
+        """The LearnerWatchdog.start() pattern: a write in the spawning
+        method BEFORE .start() happens-before the thread."""
+        src = (
+            "import threading\n"
+            "class Dog:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._seen = 0\n"
+            "        self._thread = None\n"
+            "    def start(self):\n"
+            "        self._seen = 1\n"
+            "        self._thread = threading.Thread("
+            "target=self._watch)\n"
+            "        self._thread.start()\n"
+            "    def _watch(self):\n"
+            "        return self._seen\n"
+            "def main():\n"
+            "    Dog().start()\n"
+        )
+        assert not _rules(self._analyze(src), "RACE")
+
+    def test_multi_instance_root_conflicts_with_itself(self):
+        src = (
+            "import threading\n"
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._tick = 0\n"
+            "    def run(self):\n"
+            "        ts = [\n"
+            "            threading.Thread(target=self._loop)\n"
+            "            for _ in range(4)\n"
+            "        ]\n"
+            "        for t in ts:\n"
+            "            t.start()\n"
+            "    def _loop(self):\n"
+            "        self._tick += 1\n"
+            "def main():\n"
+            "    Pool().run()\n"
+        )
+        found = _rules(self._analyze(src), "RACE")
+        assert len(found) == 1 and "_tick" in found[0].message
+
+    def test_unshared_class_exempt(self):
+        """A class with no lock and no thread-root method is
+        single-owner by construction (per-connection codecs)."""
+        src = (
+            "import threading\n"
+            "class Codec:\n"
+            "    def __init__(self):\n"
+            "        self.pos = 0\n"
+            "    def bump(self):\n"
+            "        self.pos += 1\n"
+            "def worker():\n"
+            "    Codec().bump()\n"
+            "def main():\n"
+            "    threading.Thread(target=worker).start()\n"
+            "    Codec().bump()\n"
+        )
+        assert not _rules(self._analyze(src), "RACE")
+
+    def test_module_global_race(self):
+        src = (
+            "import threading\n"
+            "_cache = None\n"
+            "def worker():\n"
+            "    global _cache\n"
+            "    _cache = 1\n"
+            "def main():\n"
+            "    threading.Thread(target=worker).start()\n"
+            "    global _cache\n"
+            "    return _cache\n"
+        )
+        found = _rules(self._analyze(src), "RACE")
+        assert len(found) == 1 and "_cache" in found[0].message
+
+    def test_repo_burn_down_is_clean_with_reasoned_suppressions(self):
+        """The ISSUE 7 burn-down contract, in anger: repo-wide RACE is
+        clean, and the surviving suppressions (the benign interleavings:
+        trace-tick sampling, watchdog ping, lazy inits, supervisor
+        single-writer fields) all carry reasons."""
+        report = analysis.analyze_paths(["."], root=REPO)
+        assert not _rules(report, "RACE"), [
+            f.render() for f in _rules(report, "RACE")
+        ]
+        race_sups = [
+            (f, s) for f, s in report.suppressed if f.rule == "RACE"
+        ]
+        assert len(race_sups) >= 5, "burn-down suppressions vanished?"
+        assert all(s.reason for _, s in race_sups)
+        sup_paths = {f.path for f, _ in race_sups}
+        assert "torchbeast_tpu/runtime/actor_pool.py" in sup_paths
+        assert "torchbeast_tpu/resilience/supervisor.py" in sup_paths
+
+
+# ---------------------------------------------------------------------------
+# LOCK-ORDER
+
+
+class TestLockOrderRule:
+    def _analyze(self, src, path="torchbeast_tpu/fixture.py"):
+        return analyze_sources({path: src})
+
+    def test_interprocedural_cycle_flagged(self):
+        """The inversion hides behind a helper call: _worker holds A and
+        calls grab_b() (which takes B); main nests B -> A directly."""
+        src = (
+            "import threading\n"
+            "class Mixer:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._thread = threading.Thread("
+            "target=self._worker)\n"
+            "    def start(self):\n"
+            "        self._thread.start()\n"
+            "    def grab_b(self):\n"
+            "        with self._b:\n"
+            "            pass\n"
+            "    def _worker(self):\n"
+            "        with self._a:\n"
+            "            self.grab_b()\n"
+            "def main():\n"
+            "    m = Mixer()\n"
+            "    m.start()\n"
+            "    with m._b:\n"
+            "        with m._a:\n"
+            "            pass\n"
+        )
+        found = _rules(self._analyze(src), "LOCK-ORDER")
+        assert found and "cycle" in found[0].message
+
+    def test_lexical_reacquisition_flagged(self):
+        """Directly nesting `with self._lock:` inside itself (no helper
+        call in between) is the same guaranteed self-deadlock —
+        regression: the lexical self-edge used to be dropped, leaving
+        only the via-helper path detected."""
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+        )
+        found = _rules(self._analyze(src), "LOCK-ORDER")
+        assert found and "self-deadlock" in found[0].message
+
+    def test_reacquisition_self_deadlock_flagged(self):
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self.inner()\n"
+            "    def inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        found = _rules(self._analyze(src), "LOCK-ORDER")
+        assert found and "self-deadlock" in found[0].message
+
+    def test_rlock_reacquisition_clean(self):
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            self.inner()\n"
+            "    def inner(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        assert not _rules(self._analyze(src), "LOCK-ORDER")
+
+    def test_condition_aliases_to_underlying_lock(self):
+        """`with self._not_empty:` HOLDS self._lock (Condition built
+        from it): nesting them is reentrant-by-aliasing, not an edge."""
+        src = (
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._not_empty = threading.Condition(self._lock)\n"
+            "    def drain(self):\n"
+            "        with self._not_empty:\n"
+            "            pass\n"
+            "    def close(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        assert not _rules(self._analyze(src), "LOCK-ORDER")
+
+    def test_repo_lock_order_edges_pinned_and_acyclic(self):
+        """The burn-down verdict, pinned: the repo's whole-program
+        lock-acquisition graph contains the three REAL nontrivial edges
+        (learner donation->state nesting; the inference supervisor's
+        recovery acquiring the health and table locks under its own) and
+        no cycles — LOCK-ORDER reports zero findings repo-wide. If a
+        future change inverts one of these orders, the cycle fails the
+        gate."""
+        prog = _repo_program()
+        trans = graph_mod.transitive_acquires(prog)
+        edges = set()
+        for e in prog.lock_edges:
+            if e.held != e.acquired:
+                edges.add((e.held, e.acquired))
+        for _, callee, _, _, held in prog.call_sites:
+            for h in held:
+                for a in trans.get(callee, ()):
+                    if a != h:
+                        edges.add((h, a))
+
+        def short(lock_id):
+            return lock_id.split("::")[-1]
+
+        named = {(short(a), short(b)) for a, b in edges}
+        assert ("train.donation_lock", "train.state_lock") in named
+        assert (
+            "InferenceSupervisor._lock", "PipelineHealth._lock"
+        ) in named
+        assert (
+            "InferenceSupervisor._lock", "DeviceStateTable._lock"
+        ) in named
+        report = run_rules(
+            prog.contexts, [], [LockOrderRule()], root=REPO,
+            known_rules=analysis.ALL_RULE_NAMES,
+        )
+        assert not _rules(report, "LOCK-ORDER"), [
+            f.render() for f in _rules(report, "LOCK-ORDER")
+        ]
+
+
+# ---------------------------------------------------------------------------
+# HOTPATH-SYNC-XPROC
+
+
+class TestXprocSync:
+    def _analyze(self, src, path="torchbeast_tpu/fixture.py"):
+        return analyze_sources({path: src})
+
+    def test_two_hop_device_return_taints_caller(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def inner(v):\n"
+            "    return jnp.tanh(v)\n"
+            "def outer(v):\n"
+            "    return inner(v) * 2\n"
+            "def to_host(x):\n"
+            "    return float(x)\n"
+            "# beastlint: hot\n"
+            "def act(env):\n"
+            "    z = outer(env)\n"
+            "    return to_host(z)\n"
+        )
+        found = _rules(self._analyze(src), "HOTPATH-SYNC-XPROC")
+        assert len(found) == 1 and "to_host" in found[0].message
+
+    def test_transitive_param_conversion(self):
+        """helper -> helper2 -> .item(): converts_params propagates."""
+        src = (
+            "import jax.numpy as jnp\n"
+            "def leaf(x):\n"
+            "    return x.item()\n"
+            "def mid(x):\n"
+            "    return leaf(x)\n"
+            "# beastlint: hot\n"
+            "def act(env):\n"
+            "    z = jnp.tanh(env)\n"
+            "    return mid(z)\n"
+        )
+        found = _rules(self._analyze(src), "HOTPATH-SYNC-XPROC")
+        assert len(found) == 1 and "mid" in found[0].message
+
+    def test_inline_findings_not_duplicated(self):
+        """A sync the inline HOTPATH-SYNC rule already flags must not
+        double-report through the summaries."""
+        src = (
+            "import jax.numpy as jnp\n"
+            "# beastlint: hot\n"
+            "def act(env):\n"
+            "    z = jnp.tanh(env)\n"
+            "    return float(z)\n"
+        )
+        report = self._analyze(src)
+        assert len(_rules(report, "HOTPATH-SYNC")) == 1
+        assert not _rules(report, "HOTPATH-SYNC-XPROC")
+
+    def test_device_get_boundary_clean(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def to_host(x):\n"
+            "    return float(x)\n"
+            "# beastlint: hot\n"
+            "def act(env):\n"
+            "    z = jnp.tanh(env)\n"
+            "    return to_host(jax.device_get(z))\n"
+        )
+        assert not _rules(
+            self._analyze(src), "HOTPATH-SYNC-XPROC"
+        )
+
+    def test_cold_caller_not_flagged(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def to_host(x):\n"
+            "    return float(x)\n"
+            "def summarize(env):\n"
+            "    return to_host(jnp.tanh(env))\n"
+        )
+        assert not _rules(
+            self._analyze(src), "HOTPATH-SYNC-XPROC"
+        )
+
+
+# ---------------------------------------------------------------------------
+# FLAG-PARITY groups + --diff mode
+
+
+class TestFlagParityGroups:
+    def test_polybeast_env_pair_in_anger(self):
+        report = analysis.analyze_paths(
+            ["torchbeast_tpu/polybeast.py",
+             "torchbeast_tpu/polybeast_env.py"],
+            root=REPO,
+        )
+        found = _rules(report, "FLAG-PARITY")
+        assert not found, [f.render() for f in found]
+
+    def test_chaos_run_pair_in_anger(self):
+        """The chaos harness's scaled-down defaults are intentional:
+        every divergence carries a reasoned inline suppression."""
+        report = analysis.analyze_paths(
+            ["torchbeast_tpu/polybeast.py", "scripts/chaos_run.py"],
+            root=REPO,
+        )
+        found = _rules(report, "FLAG-PARITY")
+        assert not found, [f.render() for f in found]
+        suppressed = [
+            (f, s) for f, s in report.suppressed
+            if f.rule == "FLAG-PARITY"
+        ]
+        flags = {f.message.split(" ")[1] for f, _ in suppressed}
+        assert {"--env", "--total_steps", "--batch_size"} <= flags
+        assert all(s.reason for _, s in suppressed)
+
+
+class TestDiffMode:
+    def test_changed_files_runs_against_real_repo(self):
+        from torchbeast_tpu.analysis.__main__ import changed_files
+
+        changed = changed_files(REPO, "HEAD")
+        assert isinstance(changed, set)
+        assert all(p.endswith(".py") for p in changed)
+
+    def test_only_paths_filters_findings_but_not_graph(self):
+        bad = (
+            "# beastlint: hot\n"
+            "def act(env):\n"
+            "    return env.item()\n"
+        )
+        clean = "def helper():\n    return 1\n"
+        ctxs = [
+            FileContext("torchbeast_tpu/bad.py", bad),
+            FileContext("torchbeast_tpu/clean.py", clean),
+        ]
+        full = run_rules(
+            ctxs, FILE_RULES, list(CONCURRENCY_RULES), root="/",
+            known_rules=analysis.ALL_RULE_NAMES,
+        )
+        assert _rules(full, "HOTPATH-SYNC")
+        filtered = run_rules(
+            ctxs, FILE_RULES, list(CONCURRENCY_RULES), root="/",
+            known_rules=analysis.ALL_RULE_NAMES,
+            only_paths={"torchbeast_tpu/clean.py"},
+        )
+        assert not filtered.findings
+
+    def test_write_baseline_rejects_diff(self, capsys):
+        """Regression: a baseline written from a changed-files-only
+        report would drop every grandfathered fingerprint in unchanged
+        files — the combination is a usage error."""
+        from torchbeast_tpu.analysis import __main__ as cli
+
+        rc = cli.main(["--write-baseline", "--diff", "HEAD"])
+        assert rc == 2
+        assert "full scan" in capsys.readouterr().err
+
+    def test_json_diff_with_no_changes_emits_json(self, monkeypatch,
+                                                  capsys):
+        """Regression: the empty-diff early return must honor --json
+        (a machine consumer piping stdout to json.loads)."""
+        import json as json_mod
+
+        from torchbeast_tpu.analysis import __main__ as cli
+
+        monkeypatch.setattr(cli, "changed_files", lambda root, ref: set())
+        rc = cli.main(["--json", "--ci", "--diff", "HEAD"])
+        out = capsys.readouterr().out.strip()
+        doc = json_mod.loads(out)
+        assert rc == 0 and doc["findings"] == [] and doc["ci"] == "PASS"
+
+    def test_cli_diff_mode_passes_on_repo(self):
+        """`--diff HEAD` (scripts/lint.sh's mode) runs end-to-end: the
+        working tree's own changes must lint clean."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchbeast_tpu.analysis",
+             "--ci", "--diff", "HEAD"],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "beastlint-ci: PASS" in proc.stdout

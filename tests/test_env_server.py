@@ -336,3 +336,23 @@ def test_env_exception_surfaces(impl):
     finally:
         sock.close()
         server.stop()
+
+
+def test_stop_before_run_never_serves():
+    """Regression (ISSUE 7 RACE burn-down): a stop() that wins the race
+    against a just-starting run() — before the listener is published —
+    must still stop it. The old code left run() binding afterwards and
+    serving forever with the stop lost."""
+    path = os.path.join(tempfile.mkdtemp(), "stopfirst")
+    server = EnvServer(lambda: CountingEnv(), f"unix:{path}")
+    server.stop()  # latches _stopped before run() ever executes
+    done = threading.Event()
+
+    def run_then_flag():
+        server.run()
+        done.set()
+
+    t = threading.Thread(target=run_then_flag, daemon=True)
+    t.start()
+    assert done.wait(timeout=10), "run() kept serving after a prior stop()"
+    assert not os.path.exists(path), "listener socket left behind"

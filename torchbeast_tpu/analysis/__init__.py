@@ -16,8 +16,24 @@ offending file:line. The rules encode the repo's real runtime contracts:
                      swallows on the failure-handling layers)
     WIRE-PARITY      runtime/wire.py == csrc/{wire,array,client}.h on the
                      dtype table, frame tags, and kMaxFrameBytes
-    FLAG-PARITY      flags shared by monobeast/polybeast agree on default
-                     and type
+    FLAG-PARITY      flags shared across driver pairs (mono/poly,
+                     poly/polybeast_env, poly/chaos_run) agree on
+                     default and type
+
+Whole-program concurrency rules (ISSUE 7) ride the module -> call ->
+thread-root graph in analysis/graph.py plus the per-function sync
+summaries in analysis/summaries.py:
+
+    RACE                cross-thread-root attribute conflicts with no
+                        common lock (guards inferred from observed
+                        `with self._lock:` dominance; `# guarded-by`
+                        annotations become cross-checked assertions)
+    LOCK-ORDER          lock-acquisition ordering cycles across roots +
+                        non-reentrant re-acquisition self-deadlocks
+    HOTPATH-SYNC-XPROC  interprocedural HOTPATH-SYNC: helpers that
+                        host-convert tainted params flag at every hot
+                        call site; device-returning helpers taint
+                        their callers
 
 See README "Static analysis" for the suppression syntax and how to add a
 rule. The package is stdlib-only by contract (enforced by its own
@@ -36,8 +52,13 @@ from .engine import (  # noqa: F401
     run_rules,
     write_baseline,
 )
-from .parity import REPO_RULES  # noqa: F401
-from .rules import FILE_RULES  # noqa: F401
+from .parity import REPO_RULES as PARITY_RULES  # noqa: F401
+from .rules import CONCURRENCY_RULES, FILE_RULES  # noqa: F401
+
+# Repo-level rules: cross-language/cross-driver parity plus the
+# whole-program concurrency rules (which share one Program model per
+# run via graph.get_program's cache).
+REPO_RULES = list(PARITY_RULES) + list(CONCURRENCY_RULES)
 
 ALL_RULE_NAMES = (
     {r.name for r in FILE_RULES}
@@ -60,8 +81,26 @@ def analyze_source(source: str, path: str = "snippet.py", rules=None):
     return report
 
 
-def analyze_paths(paths, root=None, baseline_path=None):
-    """Lint files/directories on disk with the full rule set."""
+def analyze_sources(sources, repo_rules=None):
+    """Lint a {path: source} program (multi-module fixtures): file rules
+    per context plus the repo rules (concurrency rules by default) over
+    the whole set."""
+    contexts = [FileContext(path, src) for path, src in sources.items()]
+    return run_rules(
+        contexts,
+        FILE_RULES,
+        repo_rules if repo_rules is not None else list(CONCURRENCY_RULES),
+        root="/",
+        known_rules=ALL_RULE_NAMES,
+    )
+
+
+def analyze_paths(paths, root=None, baseline_path=None, only_paths=None):
+    """Lint files/directories on disk with the full rule set.
+
+    `only_paths` (repo-relative, posix) restricts FINDINGS to those
+    files while the program graph and parity anchors still come from the
+    full `paths` scan — the `--diff` mode's contract."""
     root = root or repo_root()
     files = discover_files(paths, root)
     contexts = [c for c in (load_context(f, root) for f in files) if c]
@@ -73,4 +112,5 @@ def analyze_paths(paths, root=None, baseline_path=None):
         root=root,
         baseline=baseline,
         known_rules=ALL_RULE_NAMES,
+        only_paths=only_paths,
     )

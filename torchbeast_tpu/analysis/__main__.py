@@ -5,6 +5,12 @@
                                                        on any new finding
     python -m torchbeast_tpu.analysis --json [paths]   machine output
     python -m torchbeast_tpu.analysis --selftest       fixture verdict JSON
+    python -m torchbeast_tpu.analysis --diff REF       lint only files
+                                                       changed vs REF (graph
+                                                       built repo-wide;
+                                                       scripts/lint.sh wraps
+                                                       this as a pre-commit
+                                                       hook)
     python -m torchbeast_tpu.analysis --write-baseline grandfather current
                                                        findings (the repo's
                                                        committed baseline is
@@ -17,15 +23,34 @@ Exit codes: 0 clean, 1 findings, 2 usage/internal error.
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
-from . import analyze_paths
+from . import REPO_RULES, analyze_paths
 from .engine import repo_root, write_baseline
-from .parity import REPO_RULES
 from .rules import FILE_RULES
 
 DEFAULT_BASELINE = ".beastlint-baseline.json"
+
+
+def changed_files(root: str, ref: str):
+    """Repo-relative .py files changed vs `ref` (committed + working
+    tree + untracked) — the `--diff` scope. Raises on git failure so
+    the CLI exits 2 instead of silently linting nothing."""
+    out = subprocess.run(
+        ["git", "-C", root, "diff", "--name-only", ref, "--", "*.py"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    untracked = subprocess.run(
+        ["git", "-C", root, "ls-files", "--others",
+         "--exclude-standard", "--", "*.py"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    return {
+        line.strip() for line in (out + untracked).splitlines()
+        if line.strip()
+    }
 
 
 def main(argv=None) -> int:
@@ -45,6 +70,13 @@ def main(argv=None) -> int:
     parser.add_argument("--selftest", action="store_true",
                         help="Run the embedded rule fixtures and print a "
                              "JSON verdict.")
+    parser.add_argument("--diff", metavar="GIT_REF", default=None,
+                        help="Lint only files changed vs GIT_REF "
+                             "(committed, working tree, and untracked); "
+                             "the whole-program graph and parity "
+                             "anchors are still built repo-wide. The "
+                             "scripts/lint.sh pre-commit wrapper uses "
+                             "this.")
     parser.add_argument("--baseline", default=None,
                         help=f"Baseline file (default: <repo>/"
                              f"{DEFAULT_BASELINE}).")
@@ -66,16 +98,55 @@ def main(argv=None) -> int:
             print(f"{rule.name:16s} {lines[0] if lines else ''}")
         return 0
 
+    if args.write_baseline and args.diff is not None:
+        # A baseline written from a changed-files-only report would
+        # silently DROP every grandfathered fingerprint in unchanged
+        # files — the next full --ci run fails on intentionally
+        # baselined findings.
+        print(
+            "beastlint: --write-baseline requires a full scan; "
+            "drop --diff",
+            file=sys.stderr,
+        )
+        return 2
+
     root = repo_root()
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
     paths = args.paths or ["."]
 
     t0 = time.perf_counter()
     try:
+        only_paths = None
+        if args.diff is not None:
+            only_paths = changed_files(root, args.diff)
+            if not only_paths:
+                if args.json:
+                    doc = {
+                        "findings": [], "suppressed": [],
+                        "baselined": [], "files_scanned": 0,
+                        "elapsed_s": 0.0,
+                        "note": f"no .py files changed vs {args.diff}",
+                    }
+                    if args.ci:
+                        doc["ci"] = "PASS"
+                    print(json.dumps(doc))
+                else:
+                    print(
+                        f"beastlint: no .py files changed vs {args.diff}"
+                    )
+                    if args.ci:
+                        print("beastlint-ci: PASS")
+                return 0
         report = analyze_paths(
             paths, root=root,
             baseline_path=None if args.write_baseline else baseline_path,
+            only_paths=only_paths,
         )
+    except subprocess.CalledProcessError as e:
+        print(
+            f"beastlint: --diff failed: {e.stderr or e}", file=sys.stderr
+        )
+        return 2
     except Exception as e:  # noqa: BLE001 - CLI boundary
         print(f"beastlint: internal error: {e}", file=sys.stderr)
         return 2

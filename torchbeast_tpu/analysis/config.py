@@ -89,8 +89,44 @@ DTYPE_ITEMSIZE = {
 # FLAG-PARITY anchors: drivers whose shared flags must agree on type and
 # default. Intentional divergences carry inline suppressions at the
 # add_argument site (with the reason), not entries here — the exemption
-# should live next to the flag it exempts.
+# should live next to the flag it exempts. Each pair is checked
+# independently; findings anchor in the SECOND file of the pair.
 FLAG_PARITY_FILES = (
     "torchbeast_tpu/monobeast.py",
     "torchbeast_tpu/polybeast.py",
 )
+FLAG_PARITY_GROUPS = (
+    FLAG_PARITY_FILES,
+    # The env-server group driver shares its address/supervision flags
+    # with the learner driver (polybeast spawns ServerSupervisor from
+    # the same knobs).
+    ("torchbeast_tpu/polybeast.py", "torchbeast_tpu/polybeast_env.py"),
+    # The chaos harness builds polybeast flag lists programmatically;
+    # the flags it re-declares for itself must not silently drift from
+    # the driver's meaning (its deliberately scaled-down defaults carry
+    # inline suppressions).
+    ("torchbeast_tpu/polybeast.py", "scripts/chaos_run.py"),
+)
+
+# Whole-program concurrency analysis scope (RACE / LOCK-ORDER /
+# HOTPATH-SYNC-XPROC, analysis/graph.py): the module/call/thread-root
+# graphs are built from — and findings restricted to — these prefixes.
+# tests/ and benchmarks/ stay out: their ad-hoc threads would add roots
+# that exist only for one test's lifetime.
+CONCURRENCY_PATHS = (
+    "torchbeast_tpu",
+    "scripts",
+)
+
+# Module-level functions treated as driver main-thread roots wherever
+# they appear inside CONCURRENCY_PATHS (the driver main loops of
+# polybeast/monobeast/anakin/polybeast_env/chaos_run).
+THREAD_ROOT_FUNCTIONS = ("main", "train", "cli")
+
+# Shared by HOTPATH-SYNC (intraprocedural) and HOTPATH-SYNC-XPROC
+# (summary-based): jax.* namespaces that do HOST work (rooted there does
+# not make a value device-resident), and calls whose RESULT is host data
+# regardless of their arguments (`jax.device_get` is the explicit fetch
+# the findings recommend, so its result must never re-taint).
+HOST_JAX_NAMESPACES = ("tree_util", "tree", "dtypes", "typing")
+HOST_RETURNING_CALLS = ("jax.device_get",)

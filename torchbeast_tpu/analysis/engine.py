@@ -288,8 +288,14 @@ def run_rules(
     root: str,
     baseline: Set[str] = frozenset(),
     known_rules: Optional[Set[str]] = None,
+    only_paths: Optional[Set[str]] = None,
 ) -> Report:
     """Run every rule, apply suppressions and the baseline.
+
+    `only_paths` filters FINDINGS (and suppression hygiene) to a file
+    subset while every rule still sees the full context set — the
+    `--diff` mode: the whole-program graph and parity anchors need the
+    repo, the gate only cares about the changed files.
 
     Suppression hygiene is enforced here, not per-rule: a reasonless
     suppression, or one naming an unknown rule, is a SUPPRESS-REASON
@@ -304,6 +310,8 @@ def run_rules(
             raw.extend(rule.check(ctx))
     for rule in repo_rules:
         raw.extend(rule.check_repo(root, contexts))
+    if only_paths is not None:
+        raw = [f for f in raw if f.path in only_paths]
 
     findings: List[Finding] = []
     suppressed: List[Tuple[Finding, Suppression]] = []
@@ -321,6 +329,8 @@ def run_rules(
 
     all_rules = known_rules or set()
     for ctx in contexts:
+        if only_paths is not None and ctx.path not in only_paths:
+            continue
         for sup in ctx.suppressions:
             if not sup.reason:
                 findings.append(

@@ -1,0 +1,1240 @@
+"""beastlint whole-program layer: module graph -> call graph -> thread roots.
+
+This module turns the per-file ASTs the engine already parses into ONE
+program model the concurrency rules (RACE / LOCK-ORDER /
+HOTPATH-SYNC-XPROC, rules.py) can query:
+
+- **Module index**: repo-relative path <-> dotted module name, per-module
+  import tables (``from x import y`` / ``import x as z``), module-level
+  functions and classes. Re-exports (``runtime/__init__`` re-exporting
+  ``BatchingQueue``) are followed through import tables.
+- **Class facts**: methods, resolved program-internal bases, lock
+  attributes (``self._lock = threading.Lock()``; a ``Condition`` built
+  FROM a lock aliases to it, exactly like LOCK-DISCIPLINE), attribute
+  types (``self._queue = BatchingQueue(...)``), and callable/type
+  bindings flowed through constructors (``InferenceSupervisor(serve_loop,
+  state_table=table)`` binds ``self._loop_fn -> serve_loop`` and
+  ``self._table -> DeviceStateTable`` when ``__init__`` stores the
+  parameter on ``self``).
+- **Call graph** with class-method resolution: ``self.m()``, typed-local
+  ``obj.m()``, stored-callable ``self._loop_fn()``, property loads on
+  typed receivers, ``getattr(obj, "name", default)``, and plain/module
+  calls. Resolution is deliberately partial — an unresolvable call is a
+  missing edge, never a guess — so every downstream rule errs toward
+  silence, not noise.
+- **Thread-root graph**: every ``Thread(target=...)`` /
+  ``Process(target=...)`` spawn site (loop/comprehension spawns are
+  marked multi-instance: N threads run the same body against shared
+  ``self``), plus the configured driver entrypoints
+  (config.THREAD_ROOT_FUNCTIONS in config.CONCURRENCY_PATHS). Each
+  root's transitive callees come from a BFS over the call graph.
+- **Access + lock facts**: every ``self.attr`` / typed-local attr
+  read/write with the lexically-held lock set at that statement
+  (``with self._lock:`` blocks, ``# beastlint: holds`` annotations,
+  bare ``.acquire()`` within its statement list), every lock-acquisition
+  edge (acquire Y while holding X), and per-function lexical lock sets
+  for the interprocedural LOCK-ORDER closure.
+
+Everything here is stdlib-`ast` only (the analysis package's own
+IMPORT-PURITY contract) and built ONCE per run: rules share the Program
+via `get_program(contexts)`'s single-entry cache.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from . import config
+from .engine import FileContext
+
+# Mutating container methods: calling one on `self.attr` writes the
+# shared object even though the attribute access itself is a Load.
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "appendleft",
+    "remove", "clear", "update", "setdefault", "add", "discard",
+    "sort", "reverse",
+}
+
+# Spawn constructors matched by name suffix so both `threading.Thread`
+# and a spawn-context's `ctx.Process` register without type inference.
+_THREAD_SUFFIXES = ("Thread",)
+_PROCESS_SUFFIXES = ("Process",)
+
+
+def module_name(path: str) -> str:
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@dataclasses.dataclass
+class SpawnSite:
+    path: str
+    line: int
+    kind: str  # "thread" | "process"
+    target_text: str  # source text of the target= expression
+    target: Optional[str]  # resolved function qual, or None
+    func: Optional[str]  # enclosing function qual, or None (module level)
+    multi: bool  # spawned inside a loop/comprehension: N instances
+
+
+@dataclasses.dataclass
+class RootInfo:
+    root_id: str
+    func: str  # the root's entry function qual
+    kind: str  # "thread" | "process" | "driver"
+    spawn_func: Optional[str]  # function containing the spawn site
+    multi: bool
+
+
+@dataclasses.dataclass
+class AttrAccess:
+    owner: str  # class qual (or "<module>::path" for globals)
+    attr: str
+    kind: str  # "read" | "write"
+    path: str
+    line: int
+    func: str  # enclosing function qual
+    held: FrozenSet[str]
+    in_init: bool
+    rmw: bool = False  # read-modify-write (`+=`, mutator, item store)
+
+
+@dataclasses.dataclass
+class LockEdge:
+    held: str  # lock id already held
+    acquired: str  # lock id acquired under it
+    path: str
+    line: int
+    func: str
+    via: str  # "" for a lexical nesting, else the callee qual
+
+
+class FuncInfo:
+    def __init__(self, qual, path, node, ctx, cls=None, parent=None):
+        self.qual = qual
+        self.path = path
+        self.node = node
+        self.ctx = ctx
+        self.cls = cls  # owning class qual, or None
+        self.parent = parent  # enclosing function qual for nested defs
+        args = node.args
+        self.params = [a.arg for a in args.posonlyargs + args.args]
+        self.is_property = any(
+            isinstance(d, ast.Name) and d.id == "property"
+            for d in node.decorator_list
+        )
+
+
+class ClassInfo:
+    def __init__(self, qual, path, node, ctx):
+        self.qual = qual
+        self.path = path
+        self.node = node
+        self.ctx = ctx
+        self.name = node.name
+        self.base_names = [_attr_chain(b) for b in node.bases]
+        self.bases: List[str] = []  # resolved program-internal quals
+        self.methods: Dict[str, FuncInfo] = {}
+        # attr -> frozenset of lock ids held once acquired (a Condition
+        # built from self._lock yields {cond_id, lock_id}).
+        self.lock_attrs: Dict[str, FrozenSet[str]] = {}
+        self.reentrant: Set[str] = set()  # RLock attr ids
+        self.attr_types: Dict[str, str] = {}  # attr -> class qual
+        self.attr_funcs: Dict[str, Set[str]] = {}  # attr -> func quals
+        self.init_param_attr: Dict[str, str] = {}  # param -> stored attr
+        self.guarded: Dict[str, str] = {}  # attr -> annotated lock attr
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.qual}.{attr}"
+
+
+class Program:
+    """The resolved whole-program model (build with `build_program`)."""
+
+    def __init__(self, contexts: Sequence[FileContext]):
+        self.contexts = list(contexts)
+        self.by_path: Dict[str, FileContext] = {
+            c.path: c for c in contexts
+        }
+        self.mod_to_path: Dict[str, str] = {
+            module_name(c.path): c.path for c in contexts
+        }
+        # path -> local name -> ("mod", modname) | ("from", modname, attr)
+        self.imports: Dict[str, Dict[str, Tuple]] = {}
+        # path -> name -> ("func", qual) | ("class", qual)
+        self.module_defs: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.call_edges: Dict[str, Set[str]] = {}
+        # (caller, callee, path, line, held)
+        self.call_sites: List[Tuple[str, str, str, int, FrozenSet[str]]] = []
+        self.accesses: List[AttrAccess] = []
+        self.lock_edges: List[LockEdge] = []
+        self.func_acquires: Dict[str, Set[str]] = {}
+        self.reentrant_ids: Set[str] = set()
+        self.spawn_sites: List[SpawnSite] = []
+        # func qual -> first line of a `.start()` call inside it (for
+        # the spawn-site ordering exemption: writes before the first
+        # start() happen-before the spawned thread).
+        self.start_lines: Dict[str, int] = {}
+        self.roots: Dict[str, RootInfo] = {}
+        self.func_roots: Dict[str, Set[str]] = {}
+        # (func_qual, param) -> bound function quals / class quals
+        self.param_funcs: Dict[Tuple[str, str], Set[str]] = {}
+        self.param_types: Dict[Tuple[str, str], Set[str]] = {}
+        # Caches: per-function resolved env (cleared between binding
+        # passes, stable afterwards) and flattened own-node lists.
+        self.env_cache: Dict[str, "_Env"] = {}
+        self.node_cache: Dict[str, list] = {}
+
+    def own_nodes(self, info: "FuncInfo") -> list:
+        nodes = self.node_cache.get(info.qual)
+        if nodes is None:
+            nodes = list(_own_nodes(info.node))
+            self.node_cache[info.qual] = nodes
+        return nodes
+
+    # -- name resolution ---------------------------------------------------
+
+    def resolve_module_attr(
+        self, path: str, name: str, _seen: Optional[Set] = None
+    ) -> Optional[Tuple[str, str]]:
+        """('func'|'class', qual) for `name` in module `path`, following
+        one re-export chain through import tables (cycle-guarded)."""
+        _seen = _seen or set()
+        if (path, name) in _seen:
+            return None
+        _seen.add((path, name))
+        defs = self.module_defs.get(path, {})
+        if name in defs:
+            return defs[name]
+        imp = self.imports.get(path, {}).get(name)
+        if imp is None:
+            return None
+        if imp[0] == "from":
+            target_path = self.mod_to_path.get(imp[1])
+            if target_path is None:
+                return None  # module outside the scanned program
+            return self.resolve_module_attr(target_path, imp[2], _seen)
+        return None
+
+    def _imported_module_path(self, path: str, name: str) -> Optional[str]:
+        imp = self.imports.get(path, {}).get(name)
+        if imp is None:
+            return None
+        if imp[0] == "mod":
+            return self.mod_to_path.get(imp[1])
+        if imp[0] == "from":
+            return self.mod_to_path.get(f"{imp[1]}.{imp[2]}")
+        return None
+
+    def class_method(self, cls_qual: str, name: str,
+                     _seen=None) -> Optional[FuncInfo]:
+        _seen = _seen or set()
+        if cls_qual in _seen:
+            return None
+        _seen.add(cls_qual)
+        cls = self.classes.get(cls_qual)
+        if cls is None:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for base in cls.bases:
+            found = self.class_method(base, name, _seen)
+            if found is not None:
+                return found
+        return None
+
+    def class_lock_ids(self, cls_qual: str, attr: str) -> FrozenSet[str]:
+        """Lock ids acquired by entering `with <obj-of-cls>.attr:` —
+        empty when the attribute is not a known lock."""
+        cls = self.classes.get(cls_qual)
+        while cls is not None:
+            if attr in cls.lock_attrs:
+                return cls.lock_attrs[attr]
+            cls = self.classes.get(cls.bases[0]) if cls.bases else None
+        return frozenset()
+
+    def is_lock_attr(self, cls_qual: str, attr: str) -> bool:
+        return bool(self.class_lock_ids(cls_qual, attr))
+
+
+# ---------------------------------------------------------------------------
+# Builder
+
+
+def build_program(contexts: Sequence[FileContext]) -> Program:
+    prog = Program(contexts)
+    _index_modules(prog)
+    _index_classes(prog)
+    # Constructor/call-site bindings feed attribute types, which feed
+    # better bindings: two passes reach the repo's patterns (a typed
+    # local passed into a constructor whose __init__ stores it). Envs
+    # are cached per pass (bindings change between passes) and stay
+    # cached from the final walk on for the rules/summaries layer.
+    for _ in range(2):
+        prog.env_cache.clear()
+        _bind_call_sites(prog)
+    prog.env_cache.clear()
+    _final_walk(prog)
+    _seed_roots(prog)
+    return prog
+
+
+_CACHE: List[Tuple[tuple, Program]] = []
+
+
+def get_program(contexts: Sequence[FileContext]) -> Program:
+    """Single-entry cache: the three concurrency rules in one run share
+    one Program instead of rebuilding it per rule."""
+    key = tuple(id(c) for c in contexts)
+    if _CACHE and _CACHE[0][0] == key:
+        return _CACHE[0][1]
+    prog = build_program(contexts)
+    _CACHE[:] = [(key, prog)]
+    return prog
+
+
+def _index_modules(prog: Program) -> None:
+    for ctx in prog.contexts:
+        imports: Dict[str, Tuple] = {}
+        defs: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = (
+                        "mod", alias.name,
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:
+                    pkg = module_name(ctx.path).split(".")
+                    # level 1 inside pkg/mod.py -> pkg; __init__ paths
+                    # already dropped their own name in module_name.
+                    base = pkg[: len(pkg) - node.level] if not ctx.path.endswith(
+                        "__init__.py"
+                    ) else pkg[: len(pkg) - node.level + 1]
+                    mod = ".".join(base + ([mod] if mod else []))
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = (
+                        "from", mod, alias.name,
+                    )
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = ("func", f"{ctx.path}::{node.name}")
+            elif isinstance(node, ast.ClassDef):
+                defs[node.name] = ("class", f"{ctx.path}::{node.name}")
+        prog.imports[ctx.path] = imports
+        prog.module_defs[ctx.path] = defs
+        # Index every def at every nesting depth as a function.
+        _index_defs(prog, ctx, ctx.tree.body, cls=None, parent=None)
+
+
+def _index_defs(prog, ctx, body, cls, parent) -> None:
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if cls is not None:
+                qual = f"{cls}.{node.name}"
+            elif parent is not None:
+                qual = f"{parent}.{node.name}"
+            else:
+                qual = f"{ctx.path}::{node.name}"
+            info = FuncInfo(qual, ctx.path, node, ctx, cls=cls,
+                            parent=parent)
+            prog.functions[qual] = info
+            if cls is not None:
+                prog.classes[cls].methods.setdefault(node.name, info)
+            _index_defs(prog, ctx, node.body, cls=None, parent=qual)
+        elif isinstance(node, ast.ClassDef):
+            qual = f"{ctx.path}::{node.name}"
+            if parent is not None or cls is not None:
+                continue  # nested classes: out of model
+            prog.classes[qual] = ClassInfo(qual, ctx.path, node, ctx)
+            _index_defs(prog, ctx, node.body, cls=qual, parent=None)
+
+
+def _lock_ctor(prog, ctx, value) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when `value` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    base = _attr_chain(value.func).split(".")[-1]
+    return base if base in ("Lock", "RLock", "Condition") else None
+
+
+def _index_classes(prog: Program) -> None:
+    for cls in prog.classes.values():
+        for name in cls.base_names:
+            root = name.split(".")[0]
+            resolved = prog.resolve_module_attr(cls.path, root)
+            if resolved and resolved[0] == "class":
+                cls.bases.append(resolved[1])
+        for node in ast.walk(cls.node):
+            if isinstance(node, ast.Assign):
+                target = node.targets[0] if node.targets else None
+                value = node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.value
+            else:
+                continue
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                continue
+            attr = target.attr
+            ctor = _lock_ctor(prog, cls.ctx, value)
+            if ctor in ("Lock", "RLock"):
+                cls.lock_attrs[attr] = frozenset({cls.lock_id(attr)})
+                if ctor == "RLock":
+                    cls.reentrant.add(cls.lock_id(attr))
+                continue
+            if ctor == "Condition":
+                held = {cls.lock_id(attr)}
+                if value.args:
+                    inner = value.args[0]
+                    if (
+                        isinstance(inner, ast.Attribute)
+                        and isinstance(inner.value, ast.Name)
+                        and inner.value.id == "self"
+                    ):
+                        held.add(cls.lock_id(inner.attr))
+                cls.lock_attrs[attr] = frozenset(held)
+                continue
+            if isinstance(value, ast.Call):
+                resolved = _resolve_value_class(prog, cls.path, value.func)
+                if resolved is not None:
+                    cls.attr_types.setdefault(attr, resolved)
+            # guarded-by annotations on the attr assignment line.
+            annotation = cls.ctx.guarded_annotations.get(node.lineno)
+            if annotation is not None:
+                cls.guarded.setdefault(attr, annotation.split(".")[-1])
+        init = cls.methods.get("__init__")
+        if init is not None:
+            params = set(init.params)
+            for node in ast.walk(init.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                target = node.targets[0] if node.targets else None
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in params
+                ):
+                    cls.init_param_attr[node.value.id] = target.attr
+    for cls in prog.classes.values():
+        prog.reentrant_ids |= cls.reentrant
+
+
+def _resolve_value_class(prog, path, func_node) -> Optional[str]:
+    """Class qual when `func_node` (a call's func) names a program class."""
+    if isinstance(func_node, ast.Name):
+        resolved = prog.resolve_module_attr(path, func_node.id)
+        if resolved and resolved[0] == "class":
+            return resolved[1]
+        return None
+    chain = _attr_chain(func_node)
+    if not chain or "." not in chain:
+        return None
+    root, rest = chain.split(".", 1)
+    mod_path = prog._imported_module_path(path, root)
+    if mod_path is not None and "." not in rest:
+        resolved = prog.resolve_module_attr(mod_path, rest)
+        if resolved and resolved[0] == "class":
+            return resolved[1]
+    return None
+
+
+class _Env:
+    """Per-function local bindings: name -> class qual / function quals."""
+
+    def __init__(self, parent: Optional["_Env"] = None):
+        self.types: Dict[str, str] = dict(parent.types) if parent else {}
+        self.funcs: Dict[str, Set[str]] = (
+            {k: set(v) for k, v in parent.funcs.items()} if parent else {}
+        )
+        self.local_locks: Dict[str, FrozenSet[str]] = (
+            dict(parent.local_locks) if parent else {}
+        )
+        # name -> class qual for CLASS aliases (`pool_cls = ActorPool`,
+        # incl. through a conditional expression) — calling the alias
+        # constructs that class.
+        self.class_aliases: Dict[str, str] = (
+            dict(parent.class_aliases) if parent else {}
+        )
+
+
+def _function_env(prog, info: FuncInfo, parent: Optional[_Env]) -> _Env:
+    """Local type/callable/lock bindings visible inside `info` (straight
+    scan of its body assignments; enclosing-scope bindings inherit)."""
+    env = _Env(parent)
+    cls = prog.classes.get(info.cls) if info.cls else None
+    if cls is not None and info.params:
+        env.types.setdefault(info.params[0], cls.qual)  # self
+    for pname in info.params:
+        for t in prog.param_types.get((info.qual, pname), ()):
+            env.types.setdefault(pname, t)
+        bound = prog.param_funcs.get((info.qual, pname))
+        if bound:
+            env.funcs.setdefault(pname, set()).update(bound)
+    top = _top_function(prog, info)
+    for node in prog.own_nodes(info):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs are their own scopes (walked separately) but
+            # their NAMES are local callables here.
+            env.funcs.setdefault(node.name, set()).add(
+                f"{info.qual}.{node.name}"
+            )
+    for node in prog.own_nodes(info):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target, value = node.targets[0], node.value
+        if not isinstance(target, ast.Name):
+            continue
+        ctor = _lock_ctor(prog, info.ctx, value)
+        if ctor in ("Lock", "RLock"):
+            lid = f"{top}.{target.id}"
+            env.local_locks[target.id] = frozenset({lid})
+            if ctor == "RLock":
+                prog.reentrant_ids.add(lid)
+            continue
+        if ctor == "Condition":
+            lid = f"{top}.{target.id}"
+            held = {lid}
+            if value.args and isinstance(value.args[0], ast.Name):
+                inner = env.local_locks.get(value.args[0].id)
+                if inner:
+                    held |= set(inner)
+            env.local_locks[target.id] = frozenset(held)
+            continue
+        if isinstance(value, ast.IfExp):
+            # `pool_cls = NativePool if flags.native else ActorPool`:
+            # a class alias through a conditional — take the first
+            # branch that resolves to a program class.
+            for branch in (value.body, value.orelse):
+                cls_qual = _class_ref(prog, info.path, branch)
+                if cls_qual is not None:
+                    env.class_aliases[target.id] = cls_qual
+                    break
+            continue
+        if isinstance(value, ast.Call):
+            ctor = value.func
+            resolved = _resolve_value_class(prog, info.path, ctor)
+            if resolved is None and isinstance(ctor, ast.Name):
+                resolved = env.class_aliases.get(ctor.id)
+            if resolved is not None:
+                env.types[target.id] = resolved
+                continue
+            # v = getattr(obj, "name", default) -> bound method/property
+            if (
+                isinstance(value.func, ast.Name)
+                and value.func.id == "getattr"
+                and len(value.args) >= 2
+                and isinstance(value.args[0], ast.Name)
+                and isinstance(value.args[1], ast.Constant)
+                and isinstance(value.args[1].value, str)
+            ):
+                recv = env.types.get(value.args[0].id)
+                if recv:
+                    m = prog.class_method(recv, value.args[1].value)
+                    if m is not None:
+                        env.funcs.setdefault(target.id, set()).add(m.qual)
+        elif isinstance(value, ast.Attribute) and isinstance(
+            value.value, ast.Name
+        ):
+            recv = env.types.get(value.value.id)
+            if recv:
+                t = prog.classes.get(recv)
+                if t is not None and value.attr in t.attr_types:
+                    env.types[target.id] = t.attr_types[value.attr]
+                elif t is not None and value.attr in t.methods:
+                    env.funcs.setdefault(target.id, set()).add(
+                        t.methods[value.attr].qual
+                    )
+        elif isinstance(value, ast.Name):
+            # Aliasing: v = some_function / v = SomeClass / v = typed_local.
+            resolved = prog.resolve_module_attr(info.path, value.id)
+            if resolved and resolved[0] == "func":
+                env.funcs.setdefault(target.id, set()).add(resolved[1])
+            elif resolved and resolved[0] == "class":
+                env.class_aliases[target.id] = resolved[1]
+            elif value.id in env.types:
+                env.types[target.id] = env.types[value.id]
+    return env
+
+
+def _class_ref(prog, path: str, node) -> Optional[str]:
+    """Class qual when `node` REFERENCES (not constructs) a class."""
+    if isinstance(node, ast.Name):
+        resolved = prog.resolve_module_attr(path, node.id)
+        if resolved and resolved[0] == "class":
+            return resolved[1]
+        return None
+    if isinstance(node, ast.Attribute):
+        return _resolve_value_class(
+            prog, path, node
+        )
+    return None
+
+
+def _own_nodes(fn_node):
+    """Nodes of a function EXCLUDING nested function/class bodies."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _top_function(prog, info: FuncInfo) -> str:
+    while info.parent is not None and info.parent in prog.functions:
+        info = prog.functions[info.parent]
+    return info.qual
+
+
+def _expr_type(prog, env: _Env, node) -> Optional[str]:
+    """Class qual of a Name or single-level typed-attribute expression."""
+    if isinstance(node, ast.Name):
+        return env.types.get(node.id)
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        outer = env.types.get(node.value.id)
+        if outer is not None:
+            cls = prog.classes.get(outer)
+            if cls is not None:
+                return cls.attr_types.get(node.attr)
+    return None
+
+
+def _resolve_call_targets(prog, info: FuncInfo, env: _Env,
+                          call: ast.Call) -> Set[str]:
+    """Function quals a call may dispatch to (empty when unresolvable)."""
+    out: Set[str] = set()
+    func = call.func
+    if isinstance(func, ast.Name):
+        name = func.id
+        if name in env.funcs:
+            out |= env.funcs[name]
+        resolved = prog.resolve_module_attr(info.path, name)
+        if resolved is None and name in env.class_aliases:
+            resolved = ("class", env.class_aliases[name])
+        if resolved is not None:
+            if resolved[0] == "func":
+                out.add(resolved[1])
+            else:  # class construction -> __init__
+                m = prog.class_method(resolved[1], "__init__")
+                if m is not None:
+                    out.add(m.qual)
+        return out
+    if not isinstance(func, ast.Attribute):
+        return out
+    # super().__init__() and friends.
+    if (
+        isinstance(func.value, ast.Call)
+        and isinstance(func.value.func, ast.Name)
+        and func.value.func.id == "super"
+        and info.cls is not None
+    ):
+        cls = prog.classes.get(info.cls)
+        for base in cls.bases if cls else ():
+            m = prog.class_method(base, func.attr)
+            if m is not None:
+                out.add(m.qual)
+        return out
+    if isinstance(func.value, ast.Name):
+        recv_name = func.value.id
+        recv_type = env.types.get(recv_name)
+        if recv_type is not None:
+            t = prog.classes.get(recv_type)
+            m = prog.class_method(recv_type, func.attr)
+            if m is not None:
+                out.add(m.qual)
+            elif t is not None and func.attr in t.attr_funcs:
+                out |= t.attr_funcs[func.attr]
+            return out
+        mod_path = prog._imported_module_path(info.path, recv_name)
+        if mod_path is not None:
+            resolved = prog.resolve_module_attr(mod_path, func.attr)
+            if resolved is not None:
+                if resolved[0] == "func":
+                    out.add(resolved[1])
+                else:
+                    m = prog.class_method(resolved[1], "__init__")
+                    if m is not None:
+                        out.add(m.qual)
+        return out
+    # self._attr(...) has receiver Name 'self' (handled above);
+    # obj.attr.m(...) one level deep: typed receiver attribute.
+    if isinstance(func.value, ast.Attribute) and isinstance(
+        func.value.value, ast.Name
+    ):
+        base_type = env.types.get(func.value.value.id)
+        if base_type is not None:
+            t = prog.classes.get(base_type)
+            if t is not None:
+                inner = t.attr_types.get(func.value.attr)
+                if inner is not None:
+                    m = prog.class_method(inner, func.attr)
+                    if m is not None:
+                        out.add(m.qual)
+    return out
+
+
+def _resolve_constructed_class(prog, info, env, call) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Name):
+        resolved = prog.resolve_module_attr(info.path, func.id)
+        if resolved and resolved[0] == "class":
+            return resolved[1]
+        return env.class_aliases.get(func.id)
+    return _resolve_value_class(prog, info.path, func)
+
+
+def _callable_descriptor(prog, info, env, node) -> Set[str]:
+    """Function quals an ARGUMENT expression denotes (for bindings)."""
+    out: Set[str] = set()
+    if isinstance(node, ast.Name):
+        if node.id in env.funcs:
+            out |= env.funcs[node.id]
+        resolved = prog.resolve_module_attr(info.path, node.id)
+        if resolved and resolved[0] == "func":
+            out.add(resolved[1])
+    elif isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ):
+        recv = env.types.get(node.value.id)
+        if recv:
+            m = prog.class_method(recv, node.attr)
+            if m is not None:
+                out.add(m.qual)
+    return out
+
+
+def _type_descriptor(prog, info, env, node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return env.types.get(node.id)
+    if isinstance(node, ast.Call):
+        return _resolve_constructed_class(prog, info, env, node)
+    return None
+
+
+def _bind_call_sites(prog: Program) -> None:
+    """Flow callables/types through constructors and plain calls:
+    `C(f, table=t)` + `__init__(self, fn, table): self._fn = fn` binds
+    `(C, _fn) -> f` and `(C, _table) -> type(t)`; calls to plain
+    functions bind `(callee, param) -> type/callable` the same way."""
+    for info in list(prog.functions.values()):
+        env = _build_env_chain(prog, info)
+        for node in prog.own_nodes(info):
+            if not isinstance(node, ast.Call):
+                continue
+            cls_qual = _resolve_constructed_class(prog, info, env, node)
+            targets: List[Tuple[FuncInfo, Optional[ClassInfo]]] = []
+            if cls_qual is not None:
+                init = prog.class_method(cls_qual, "__init__")
+                if init is not None:
+                    targets.append((init, prog.classes.get(cls_qual)))
+            else:
+                for qual in _resolve_call_targets(prog, info, env, node):
+                    callee = prog.functions.get(qual)
+                    if callee is not None and callee.cls is None:
+                        targets.append((callee, None))
+            for callee, cls in targets:
+                params = callee.params[1:] if cls is not None else (
+                    callee.params
+                )
+                bound: List[Tuple[str, ast.AST]] = list(
+                    zip(params, node.args)
+                )
+                by_name = set(params)
+                for kw in node.keywords:
+                    if kw.arg in by_name:
+                        bound.append((kw.arg, kw.value))
+                for pname, arg in bound:
+                    funcs = _callable_descriptor(prog, info, env, arg)
+                    a_type = _type_descriptor(prog, info, env, arg)
+                    if cls is not None:
+                        attr = cls.init_param_attr.get(pname)
+                        if attr is None:
+                            continue
+                        if funcs:
+                            cls.attr_funcs.setdefault(attr, set()).update(
+                                funcs
+                            )
+                        if a_type is not None:
+                            cls.attr_types.setdefault(attr, a_type)
+                    else:
+                        key = (callee.qual, pname)
+                        if funcs:
+                            prog.param_funcs.setdefault(key, set()).update(
+                                funcs
+                            )
+                        if a_type is not None:
+                            prog.param_types.setdefault(key, set()).add(
+                                a_type
+                            )
+
+
+def _build_env_chain(prog, info: FuncInfo) -> _Env:
+    env = prog.env_cache.get(info.qual)
+    if env is not None:
+        return env
+    parent_env = None
+    if info.parent and info.parent in prog.functions:
+        parent_env = _build_env_chain(prog, prog.functions[info.parent])
+    env = _function_env(prog, info, parent_env)
+    prog.env_cache[info.qual] = env
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Final walk: accesses, call edges, lock edges, spawns
+
+
+class _FuncWalker:
+    def __init__(self, prog: Program, info: FuncInfo, env: _Env):
+        self.prog = prog
+        self.info = info
+        self.env = env
+        self.cls = prog.classes.get(info.cls) if info.cls else None
+        self.in_init = info.cls is not None and (
+            info.node.name == "__init__"
+        )
+        held: Set[str] = set()
+        holds = info.ctx.holds_annotation(info.node)
+        if holds and self.cls is not None:
+            attr = holds.split(".")[-1]
+            held |= self.prog.class_lock_ids(self.cls.qual, attr) or {
+                self.cls.lock_id(attr)
+            }
+        self.entry_held = frozenset(held)
+        self.loop_depth = 0
+        self.globals: Set[str] = set()
+        self.acquires: Set[str] = set()
+
+    # -- lock id resolution ------------------------------------------------
+
+    def _lock_ids_of(self, expr) -> FrozenSet[str]:
+        if isinstance(expr, ast.Name):
+            return self.env.local_locks.get(expr.id, frozenset())
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            recv = self.env.types.get(expr.value.id)
+            if recv is not None:
+                return self.prog.class_lock_ids(recv, expr.attr)
+        return frozenset()
+
+    def _record_acquire(self, ids: FrozenSet[str], held: FrozenSet[str],
+                        line: int, via: str = "") -> None:
+        self.acquires |= set(ids)
+        for acq in ids:
+            for h in held:
+                # h == acq is a SELF-edge: lexically re-acquiring a lock
+                # already held on this path (a Condition aliasing an
+                # outer-held lock included). Recorded like any edge —
+                # LOCK-ORDER turns non-reentrant self-edges into
+                # self-deadlock findings.
+                self.prog.lock_edges.append(
+                    LockEdge(h, acq, self.info.path, line,
+                             self.info.qual, via)
+                )
+
+    # -- statement walk ----------------------------------------------------
+
+    def walk(self) -> None:
+        self._stmts(self.info.node.body, set(self.entry_held))
+
+    def _stmts(self, stmts, held: Set[str]) -> None:
+        i = 0
+        while i < len(stmts):
+            stmt = stmts[i]
+            if isinstance(stmt, ast.With):
+                new_held = set(held)
+                for item in stmt.items:
+                    ids = self._lock_ids_of(item.context_expr)
+                    self._expr(item.context_expr, frozenset(new_held))
+                    if ids:
+                        self._record_acquire(
+                            ids, frozenset(new_held), stmt.lineno
+                        )
+                        new_held |= ids
+                    if item.optional_vars is not None:
+                        self._expr(item.optional_vars, frozenset(new_held))
+                self._stmts(stmt.body, new_held)
+            elif isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                pass  # separate scopes, walked via their own FuncInfo
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self.loop_depth += 1
+                for field in ("target", "iter", "test"):
+                    sub = getattr(stmt, field, None)
+                    if sub is not None:
+                        self._expr(sub, frozenset(held))
+                self._stmts(stmt.body, held)
+                self._stmts(stmt.orelse, held)
+                self.loop_depth -= 1
+            elif isinstance(stmt, ast.Global):
+                self.globals |= set(stmt.names)
+            else:
+                # Bare `x.acquire()` statement: held for the remainder of
+                # this statement list (LOCK-DISCIPLINE already enforces
+                # the try/finally release shape).
+                acquired = self._bare_acquire_ids(stmt)
+                if acquired:
+                    self._record_acquire(
+                        acquired, frozenset(held), stmt.lineno
+                    )
+                    held = set(held) | acquired
+                if isinstance(stmt, ast.AugAssign) and isinstance(
+                    stmt.target, ast.Attribute
+                ):
+                    # `self._x += 1` is a read-modify-write.
+                    self._attr_access(stmt.target, frozenset(held),
+                                      force_kind="write", rmw=True)
+                for _, value in ast.iter_fields(stmt):
+                    if isinstance(value, list):
+                        if value and isinstance(value[0], ast.stmt):
+                            self._stmts(value, set(held))
+                        elif value and isinstance(
+                            value[0], ast.excepthandler
+                        ):
+                            for handler in value:
+                                if handler.type is not None:
+                                    self._expr(
+                                        handler.type, frozenset(held)
+                                    )
+                                self._stmts(handler.body, set(held))
+                        else:
+                            for v in value:
+                                if isinstance(v, ast.expr):
+                                    self._expr(v, frozenset(held))
+                    elif isinstance(value, ast.expr):
+                        self._expr(value, frozenset(held))
+            i += 1
+
+    def _bare_acquire_ids(self, stmt) -> FrozenSet[str]:
+        if not isinstance(stmt, ast.Expr):
+            return frozenset()
+        call = stmt.value
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"
+        ):
+            return self._lock_ids_of(call.func.value)
+        return frozenset()
+
+    # -- expression walk ---------------------------------------------------
+
+    def _expr(self, expr, held: FrozenSet[str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ) and isinstance(node.value, ast.Attribute):
+                # `self._x[k] = v` / `del self._x[k]`: the attribute node
+                # itself carries Load ctx — upgrade to a write here.
+                self._attr_access(node.value, held, force_kind="write",
+                                  rmw=True)
+            elif isinstance(node, ast.Attribute):
+                self._attr_access(node, held)
+            elif isinstance(node, ast.Name) and node.id in self.globals:
+                kind = (
+                    "write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read"
+                )
+                self._global_access(node, kind, held)
+
+    def _global_access(self, node, kind, held) -> None:
+        self.prog.accesses.append(
+            AttrAccess(
+                f"<module>::{self.info.path}", node.id, kind,
+                self.info.path, node.lineno, self.info.qual, held,
+                self.in_init,
+            )
+        )
+
+    def _receiver_class(self, node: ast.Attribute) -> Optional[str]:
+        if not isinstance(node.value, ast.Name):
+            return None
+        return self.env.types.get(node.value.id)
+
+    def _attr_access(self, node: ast.Attribute, held: FrozenSet[str],
+                     force_kind: Optional[str] = None,
+                     rmw: bool = False) -> None:
+        owner = self._receiver_class(node)
+        if owner is None:
+            return
+        if self.prog.is_lock_attr(owner, node.attr):
+            return  # touching a lock IS how you acquire it
+        if self.prog.class_method(owner, node.attr) is not None:
+            return  # bound-method/property reference, not instance data
+        kind = force_kind or (
+            "write" if isinstance(node.ctx, (ast.Store, ast.Del))
+            else "read"
+        )
+        self.prog.accesses.append(
+            AttrAccess(owner, node.attr, kind, self.info.path,
+                       node.lineno, self.info.qual, held, self.in_init,
+                       rmw=rmw)
+        )
+
+    def _call(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        func = node.func
+        # Mutator methods on a typed attribute: self._x.append(...) and
+        # subscript stores walk through as Attribute loads; upgrade the
+        # access kind here.
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _MUTATORS
+            and isinstance(func.value, ast.Attribute)
+        ):
+            self._attr_access(func.value, held, force_kind="write",
+                              rmw=True)
+        if isinstance(func, ast.Attribute) and func.attr == "start":
+            prev = self.prog.start_lines.get(self.info.qual)
+            if prev is None or node.lineno < prev:
+                self.prog.start_lines[self.info.qual] = node.lineno
+        # Spawn sites. The constructor name comes from the last
+        # attribute segment even when the chain is rooted in a call
+        # (`mp.get_context("spawn").Process(...)`).
+        if isinstance(func, ast.Attribute):
+            base = func.attr
+        elif isinstance(func, ast.Name):
+            base = func.id
+        else:
+            base = ""
+        target_kw = next(
+            (kw.value for kw in node.keywords if kw.arg == "target"), None
+        )
+        if target_kw is not None and (
+            base.endswith(_THREAD_SUFFIXES)
+            or base.endswith(_PROCESS_SUFFIXES)
+        ):
+            kind = (
+                "thread" if base.endswith(_THREAD_SUFFIXES) else "process"
+            )
+            targets = _callable_descriptor(
+                self.prog, self.info, self.env, target_kw
+            )
+            self.prog.spawn_sites.append(
+                SpawnSite(
+                    self.info.path, node.lineno, kind,
+                    _attr_chain(target_kw) or type(target_kw).__name__,
+                    sorted(targets)[0] if targets else None,
+                    self.info.qual,
+                    multi=self.loop_depth > 0,
+                )
+            )
+        # Call edges + call sites.
+        targets = _resolve_call_targets(self.prog, self.info, self.env,
+                                        node)
+        # Property loads on typed receivers dispatch like calls — but a
+        # plain `obj.prop` read is an Attribute, handled in _attr_access
+        # only as data. Register the property edge here for the args.
+        for qual in targets:
+            self.prog.call_edges.setdefault(self.info.qual, set()).add(
+                qual
+            )
+            self.prog.call_sites.append(
+                (self.info.qual, qual, self.info.path, node.lineno, held)
+            )
+
+def _final_walk(prog: Program) -> None:
+    for info in list(prog.functions.values()):
+        env = _build_env_chain(prog, info)
+        walker = _FuncWalker(prog, info, env)
+        walker.walk()
+        prog.func_acquires[info.qual] = walker.acquires
+    _mark_comprehension_spawns(prog)
+    _property_edges(prog)
+    _inherit_call_site_locks(prog)
+
+
+def _inherit_call_site_locks(prog: Program) -> None:
+    """A helper called ONLY with lock L held runs with L held: its
+    accesses inherit the intersection of every call site's held set
+    (one level — the `_require_alive`-under-`self._lock` pattern).
+    Functions that are thread-spawn targets are exempt: their real
+    entry is the bare thread, not a locked call site."""
+    spawn_targets = {s.target for s in prog.spawn_sites if s.target}
+    by_callee: Dict[str, List[FrozenSet[str]]] = {}
+    for _, callee, _, _, held in prog.call_sites:
+        by_callee.setdefault(callee, []).append(held)
+    inherited: Dict[str, FrozenSet[str]] = {}
+    for callee, helds in by_callee.items():
+        if callee in spawn_targets:
+            continue
+        common = frozenset.intersection(*helds)
+        if common:
+            inherited[callee] = common
+    if not inherited:
+        return
+    for acc in prog.accesses:
+        extra = inherited.get(acc.func)
+        if extra:
+            acc.held = acc.held | extra
+
+
+def _mark_comprehension_spawns(prog: Program) -> None:
+    """Spawns inside list/set/generator comprehensions are multi-instance
+    (one walker pass can't see comprehension nesting cheaply: fix up by
+    locating each spawn call's comprehension ancestors per file)."""
+    by_path: Dict[str, List[SpawnSite]] = {}
+    for site in prog.spawn_sites:
+        by_path.setdefault(site.path, []).append(site)
+    for path, sites in by_path.items():
+        ctx = prog.by_path.get(path)
+        if ctx is None:
+            continue
+        comp_lines: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.ListComp, ast.SetComp,
+                                 ast.GeneratorExp, ast.DictComp)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        comp_lines.add(sub.lineno)
+        for site in sites:
+            if site.line in comp_lines:
+                site.multi = True
+
+
+def _property_edges(prog: Program) -> None:
+    """`obj.prop` loads on typed receivers dispatch to the property body:
+    add call edges so RACE sees reads inside properties from the caller's
+    roots. (Second pass: needs every function's env; reuses the binding
+    machinery rather than the full walker.)"""
+    prop_names: Dict[str, Dict[str, str]] = {}
+    for cls in prog.classes.values():
+        props = {
+            name: m.qual for name, m in cls.methods.items()
+            if m.is_property
+        }
+        if props:
+            prop_names[cls.qual] = props
+    if not prop_names:
+        return
+    for info in list(prog.functions.values()):
+        env = _build_env_chain(prog, info)
+        for node in prog.own_nodes(info):
+            recv = attr = line = None
+            if isinstance(node, ast.Attribute):
+                recv = _expr_type(prog, env, node.value)
+                attr, line = node.attr, node.lineno
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                recv = _expr_type(prog, env, node.args[0])
+                attr, line = node.args[1].value, node.lineno
+            if recv is None or attr is None:
+                continue
+            # Walk the MRO for the property table.
+            cls = prog.classes.get(recv)
+            qual = None
+            while cls is not None:
+                qual = prop_names.get(cls.qual, {}).get(attr)
+                if qual:
+                    break
+                cls = prog.classes.get(cls.bases[0]) if cls.bases else None
+            if qual:
+                prog.call_edges.setdefault(info.qual, set()).add(qual)
+                prog.call_sites.append(
+                    (info.qual, qual, info.path, line, frozenset())
+                )
+
+
+DRIVER_ROOT = "driver-main"
+
+
+def _seed_roots(prog: Program) -> None:
+    for site in prog.spawn_sites:
+        if site.target is None:
+            continue
+        short = site.target.split("::")[-1]
+        root_id = f"{short}@{site.path}:{site.line}"
+        prog.roots[root_id] = RootInfo(
+            root_id, site.target, site.kind, site.func, site.multi
+        )
+    # Every configured driver entrypoint is ONE root: a process has one
+    # main thread, and two drivers never run concurrently in the same
+    # process — treating main/train/cli (or two drivers) as distinct
+    # roots would conjure conflicts between code that is all executed
+    # by the same thread.
+    driver_entries: List[str] = []
+    for ctx in prog.contexts:
+        defs = prog.module_defs.get(ctx.path, {})
+        for name in config.THREAD_ROOT_FUNCTIONS:
+            entry = defs.get(name)
+            if entry and entry[0] == "func":
+                driver_entries.append(entry[1])
+    reach: Dict[str, Set[str]] = {}
+    for root in prog.roots.values():
+        reach[root.root_id] = _reachable(prog, root.func)
+    driver_reach: Set[str] = set()
+    for entry in driver_entries:
+        driver_reach |= _reachable(prog, entry)
+    if driver_entries:
+        prog.roots[DRIVER_ROOT] = RootInfo(
+            DRIVER_ROOT, driver_entries[0], "driver", None, False
+        )
+        reach[DRIVER_ROOT] = driver_reach
+    for root_id, quals in reach.items():
+        for qual in quals:
+            prog.func_roots.setdefault(qual, set()).add(root_id)
+
+
+def _reachable(prog: Program, start: str) -> Set[str]:
+    seen = {start}
+    stack = [start]
+    while stack:
+        cur = stack.pop()
+        for nxt in prog.call_edges.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return seen
+
+
+def transitive_acquires(prog: Program) -> Dict[str, Set[str]]:
+    """func qual -> every lock id it may acquire, directly or through
+    calls (bounded fixpoint over the call graph)."""
+    acq = {q: set(s) for q, s in prog.func_acquires.items()}
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for caller, callees in prog.call_edges.items():
+            mine = acq.setdefault(caller, set())
+            before = len(mine)
+            for callee in callees:
+                mine |= acq.get(callee, set())
+            if len(mine) != before:
+                changed = True
+    return acq

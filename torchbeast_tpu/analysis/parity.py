@@ -415,7 +415,7 @@ def check_flag_parity(
 
 
 class FlagParityRule:
-    """FLAG-PARITY: monobeast/polybeast shared flags agree on type+default."""
+    """FLAG-PARITY: flags shared across driver pairs agree on type+default."""
 
     name = "FLAG-PARITY"
 
@@ -423,11 +423,13 @@ class FlagParityRule:
         self, root: str, contexts: Sequence[FileContext]
     ) -> List[Finding]:
         by_path = {ctx.path: ctx for ctx in contexts}
-        path_a, path_b = config.FLAG_PARITY_FILES
-        ctx_a, ctx_b = by_path.get(path_a), by_path.get(path_b)
-        if ctx_a is None or ctx_b is None:
-            return []  # partial scan: parity not in scope
-        return check_flag_parity(ctx_a, ctx_b)
+        findings: List[Finding] = []
+        for path_a, path_b in config.FLAG_PARITY_GROUPS:
+            ctx_a, ctx_b = by_path.get(path_a), by_path.get(path_b)
+            if ctx_a is None or ctx_b is None:
+                continue  # partial scan: this pair not in scope
+            findings.extend(check_flag_parity(ctx_a, ctx_b))
+        return findings
 
 
 REPO_RULES = [WireParityRule(), FlagParityRule()]

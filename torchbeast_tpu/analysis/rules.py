@@ -170,14 +170,11 @@ class HotpathSyncRule:
                 break
         return tainted
 
-    # jax.* namespaces that do HOST work (pytree plumbing, dtype
-    # metadata): rooted there does not make a value device-resident.
-    _HOST_JAX_NAMESPACES = {"tree_util", "tree", "dtypes", "typing"}
-
-    # Calls that RETURN host values regardless of their (device)
-    # arguments — `jax.device_get` is the explicit fetch this rule's
-    # findings recommend, so its result must not re-taint.
-    _HOST_RETURNING_CALLS = {"jax.device_get"}
+    # jax.* namespaces that do HOST work and calls that RETURN host
+    # values regardless of their (device) arguments — shared with the
+    # interprocedural rule via config (one contract, two analyses).
+    _HOST_JAX_NAMESPACES = frozenset(config.HOST_JAX_NAMESPACES)
+    _HOST_RETURNING_CALLS = frozenset(config.HOST_RETURNING_CALLS)
 
     def _is_device(self, expr: ast.AST, tainted: Set[str]) -> bool:
         node = expr
@@ -927,6 +924,457 @@ class ExceptSwallowRule:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Whole-program concurrency rules (ISSUE 7). These are REPO rules: they
+# run over every scanned context at once, sharing one Program model
+# (analysis/graph.py) via its single-entry cache.
+
+
+def _concurrency_scope(contexts):
+    return [
+        ctx for ctx in contexts
+        if any(
+            ctx.path.startswith(prefix + "/") or ctx.path == prefix
+            for prefix in config.CONCURRENCY_PATHS
+        )
+    ]
+
+
+def _root_label(prog, root_id: str) -> str:
+    root = prog.roots.get(root_id)
+    if root is None:
+        return root_id
+    label = root_id
+    if root.multi:
+        label += " [xN]"
+    return label
+
+
+class RaceRule:
+    """RACE: cross-thread-root attribute conflicts with no common lock.
+
+    For every `self.<attr>` (and typed-local attr / declared module
+    global) the program graph maps each access to the thread roots that
+    can reach it and the lock set lexically held there. A location
+    written from one root and read/written from another — or written
+    from a multi-instance root (a spawn site inside a loop/comprehension
+    runs N copies of the same body against shared state) — must have at
+    least one lock held at EVERY conflicting access. Guards are INFERRED
+    from observed `with self._lock:` dominance; `# guarded-by`
+    annotations become cross-checked assertions (the rule reports when
+    the annotated lock is not what the conflicting paths actually hold).
+
+    Conservatism: construction (`__init__`) accesses are exempt (no
+    concurrent readers exist yet), attributes never written outside
+    `__init__` are immutable-after-construction, writes in the method
+    that spawns a root are ordered by `Thread.start()` against that
+    root, and anything the call graph cannot resolve is silence, not a
+    guess. Benign races are suppressed inline with the interleaving
+    described: `# beastlint: disable=RACE  <why the interleaving is
+    safe>`.
+    """
+
+    name = "RACE"
+
+    def check_repo(self, root: str, contexts) -> List[Finding]:
+        from . import graph as graph_mod
+
+        scoped = _concurrency_scope(contexts)
+        if not scoped:
+            return []
+        prog = graph_mod.get_program(scoped)
+        shared_owners = self._shared_owners(prog)
+        groups: Dict = {}
+        for acc in prog.accesses:
+            if acc.in_init:
+                continue
+            if acc.owner not in shared_owners:
+                continue
+            groups.setdefault((acc.owner, acc.attr), []).append(acc)
+        findings: List[Finding] = []
+        for (owner, attr), accs in sorted(groups.items()):
+            if not any(a.kind == "write" for a in accs):
+                continue  # immutable after construction
+            per_root: Dict[str, List] = {}
+            for a in accs:
+                for r in prog.func_roots.get(a.func, ()):
+                    per_root.setdefault(r, []).append(a)
+            involved_ids: Dict[int, object] = {}
+            involved_roots = set()
+            roots_list = sorted(per_root)
+            for ra in roots_list:
+                a_accs = per_root[ra]
+                for rb in roots_list:
+                    if rb == ra:
+                        continue
+                    writes_a = [
+                        a for a in a_accs
+                        if a.kind == "write"
+                        and not self._spawn_ordered(prog, a, ra, rb)
+                    ]
+                    if not writes_a:
+                        continue
+                    accs_b = [
+                        b for b in per_root[rb]
+                        if not self._spawn_ordered(prog, b, ra, rb)
+                    ]
+                    if not accs_b:
+                        continue
+                    involved_roots |= {ra, rb}
+                    for a in writes_a + accs_b:
+                        involved_ids[id(a)] = a
+                # Multi-instance root: N copies of the same body run
+                # against shared state — it conflicts with itself.
+                if prog.roots[ra].multi:
+                    own = [
+                        a for a in a_accs
+                        if not self._spawn_ordered(prog, a, ra, ra)
+                    ]
+                    if self._self_conflict(own):
+                        involved_roots.add(ra)
+                        for a in own:
+                            involved_ids[id(a)] = a
+            if not involved_roots:
+                continue
+            involved = list(involved_ids.values())
+            common = frozenset.intersection(
+                *[a.held for a in involved]
+            ) if involved else frozenset()
+            if common:
+                continue  # a lock every conflicting access holds
+            findings.append(
+                self._finding(prog, owner, attr, involved, per_root,
+                              involved_roots)
+            )
+        return findings
+
+    @staticmethod
+    def _shared_owners(prog) -> set:
+        """Classes whose instances are actually thread-shared: they own
+        a lock (you lock because you share) or one of their methods is a
+        thread-root body (the instance spans spawner and thread).
+        Everything else — per-connection codecs, per-run writers — is
+        single-owner by construction and exempt. Declared module globals
+        are always in scope."""
+        root_funcs = {r.func for r in prog.roots.values()}
+        out = set()
+        for qual, cls in prog.classes.items():
+            if cls.lock_attrs:
+                out.add(qual)
+            elif any(m.qual in root_funcs for m in cls.methods.values()):
+                out.add(qual)
+        out |= {
+            acc.owner for acc in prog.accesses
+            if acc.owner.startswith("<module>")
+        }
+        return out
+
+    @staticmethod
+    def _spawn_ordered(prog, access, ra: str, rb: str) -> bool:
+        """True when `access` is ordered against the conflict pair by
+        `Thread.start()`: it sits in the method that spawns root ra or
+        rb, before that method's first `.start()` call."""
+        for r in (ra, rb):
+            info = prog.roots[r]
+            if info.spawn_func is None or access.func != info.spawn_func:
+                continue
+            first_start = prog.start_lines.get(info.spawn_func)
+            if first_start is not None and access.line < first_start:
+                return True
+        return False
+
+    @staticmethod
+    def _self_conflict(r_accs) -> bool:
+        """Within ONE multi-instance root: a read-modify-write, a write
+        plus a read at another line, or writes at two lines conflict."""
+        writes = [a for a in r_accs if a.kind == "write"]
+        if not writes:
+            return False
+        reads = [a for a in r_accs if a.kind == "read"]
+        if any(getattr(a, "rmw", False) for a in writes):
+            return True
+        write_lines = {(a.path, a.line) for a in writes}
+        if len(write_lines) > 1:
+            return True
+        return any(
+            (a.path, a.line) not in write_lines for a in reads
+        )
+
+    def _finding(self, prog, owner, attr, involved, per_root,
+                 involved_roots) -> Finding:
+        # Majority lock (if any) names the inferred guard; the anchor is
+        # the first conflicting write that does not hold it.
+        lock_votes: Dict[str, int] = {}
+        for a in involved:
+            for lock in a.held:
+                lock_votes[lock] = lock_votes.get(lock, 0) + 1
+        candidate = max(lock_votes, key=lock_votes.get) if lock_votes else None
+        unguarded = [
+            a for a in involved
+            if candidate is None or candidate not in a.held
+        ] or involved
+        unguarded.sort(key=lambda a: (a.path, a.line))
+        anchor = next(
+            (a for a in unguarded if a.kind == "write"), unguarded[0]
+        )
+        other = next(
+            (
+                a for a in sorted(involved, key=lambda x: (x.path, x.line))
+                if (a.path, a.line) != (anchor.path, anchor.line)
+            ),
+            anchor,
+        )
+        roots_text = ", ".join(
+            sorted(_root_label(prog, r) for r in involved_roots)[:3]
+        )
+        attr_text = (
+            f"`{attr}`" if owner.startswith("<module>")
+            else f"`self.{attr}` ({owner.split('::')[-1]})"
+        )
+        cls = prog.classes.get(owner)
+        annotated = cls.guarded.get(attr) if cls is not None else None
+        if annotated is not None:
+            return Finding(
+                self.name, anchor.path, anchor.line,
+                f"annotation claims `self.{annotated}` guards "
+                f"{attr_text}, but it is not held on the path through "
+                f"{anchor.func.split('::')[-1]} (roots: {roots_text}; "
+                f"counterpart at {other.path}:{other.line})",
+            )
+        if candidate is not None:
+            guard_text = (
+                f"`{candidate.split('::')[-1].split('.')[-1]}` guards "
+                f"{lock_votes[candidate]}/{len(involved)} conflicting "
+                "accesses but not this one"
+            )
+        else:
+            guard_text = "no lock is held at any conflicting access"
+        return Finding(
+            self.name, anchor.path, anchor.line,
+            f"{attr_text} is {anchor.kind[:-1]}ten from roots "
+            f"{roots_text} with no common lock — {guard_text} "
+            f"(counterpart access at {other.path}:{other.line})"
+            if anchor.kind == "write" else
+            f"{attr_text} is accessed from roots {roots_text} with no "
+            f"common lock — {guard_text} (counterpart at "
+            f"{other.path}:{other.line})",
+        )
+
+
+class LockOrderRule:
+    """LOCK-ORDER: lock-acquisition ordering cycles across thread roots.
+
+    The program graph records every acquisition edge `A -> B` (lock B
+    acquired — lexically or anywhere inside a callee, via per-function
+    transitive-acquire summaries — while A is held). A cycle in the
+    merged graph means two roots can take the same locks in opposite
+    orders: a potential deadlock. Re-acquiring a non-reentrant lock
+    already held on the path (directly, or by calling a helper that
+    takes it) is a guaranteed self-deadlock and flags on its own.
+    """
+
+    name = "LOCK-ORDER"
+
+    def check_repo(self, root: str, contexts) -> List[Finding]:
+        from . import graph as graph_mod
+
+        scoped = _concurrency_scope(contexts)
+        if not scoped:
+            return []
+        prog = graph_mod.get_program(scoped)
+        trans = graph_mod.transitive_acquires(prog)
+        # (a, b) -> (path, line, func, via)
+        edges: Dict = {}
+        findings: List[Finding] = []
+        for e in prog.lock_edges:
+            if e.held == e.acquired:
+                if e.held not in prog.reentrant_ids:
+                    findings.append(
+                        Finding(
+                            self.name, e.path, e.line,
+                            f"`{_short_lock(e.held)}` acquired while "
+                            "already held on this path — non-reentrant "
+                            "lock, guaranteed self-deadlock",
+                        )
+                    )
+                continue
+            edges.setdefault((e.held, e.acquired),
+                             (e.path, e.line, e.func, e.via))
+        for caller, callee, path, line, held in prog.call_sites:
+            for h in held:
+                for a in trans.get(callee, ()):
+                    if a == h:
+                        if h not in prog.reentrant_ids:
+                            findings.append(
+                                Finding(
+                                    self.name, path, line,
+                                    f"`{_short_lock(h)}` is held here "
+                                    f"and re-acquired inside "
+                                    f"{callee.split('::')[-1]}() — "
+                                    "non-reentrant lock, guaranteed "
+                                    "self-deadlock",
+                                )
+                            )
+                        continue
+                    edges.setdefault((h, a), (path, line, caller, callee))
+        findings.extend(self._cycle_findings(prog, edges))
+        # One finding per distinct site+message.
+        out, seen = [], set()
+        for f in findings:
+            key = (f.path, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    def _cycle_findings(self, prog, edges) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        findings = []
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            # BFS back to `start` over the edge graph.
+            stack = [(nxt, [start, nxt]) for nxt in sorted(graph[start])]
+            found = None
+            seen: Set[str] = set()
+            while stack and found is None:
+                node, path_nodes = stack.pop()
+                if node == start:
+                    found = path_nodes
+                    break
+                if node in seen:
+                    continue
+                seen.add(node)
+                for nxt in sorted(graph.get(node, ())):
+                    stack.append((nxt, path_nodes + [nxt]))
+            if found is None:
+                continue
+            cycle_key = frozenset(found[:-1])
+            if cycle_key in reported:
+                continue
+            reported.add(cycle_key)
+            parts = []
+            for a, b in zip(found, found[1:]):
+                site = edges[(a, b)]
+                root_ids = prog.func_roots.get(site[2], set())
+                root_text = (
+                    sorted(root_ids)[0] if root_ids else "unreached"
+                )
+                via = f" via {site[3].split('::')[-1]}()" if site[3] else ""
+                parts.append(
+                    f"`{_short_lock(a)}` -> `{_short_lock(b)}` at "
+                    f"{site[0]}:{site[1]}{via} (root {root_text})"
+                )
+            first = edges[(found[0], found[1])]
+            findings.append(
+                Finding(
+                    self.name, first[0], first[1],
+                    "lock ordering cycle (potential deadlock): "
+                    + "; ".join(parts),
+                )
+            )
+        return findings
+
+
+class XprocSyncRule:
+    """HOTPATH-SYNC-XPROC: interprocedural implicit syncs in hot paths.
+
+    HOTPATH-SYNC sees `float(x)` only when `x`'s jax taint is assigned
+    in the same function. This rule escalates the same contract through
+    per-function summaries (analysis/summaries.py): a helper that
+    `.item()`s / `float()`s / `np.asarray()`s a tainted PARAMETER flags
+    at every hot call site that passes it a device value, and a helper
+    that RETURNS a device value taints its callers' assignments, so a
+    conversion two hops away is caught where the hot path commits to it.
+    Findings are disjoint from HOTPATH-SYNC by construction: anything
+    the inline taint already sees is left to the inline rule.
+    """
+
+    name = "HOTPATH-SYNC-XPROC"
+
+    def check_repo(self, root: str, contexts) -> List[Finding]:
+        from . import graph as graph_mod
+        from . import summaries as summaries_mod
+
+        scoped = _concurrency_scope(contexts)
+        if not scoped:
+            return []
+        prog = graph_mod.get_program(scoped)
+        hot = [
+            info for info in prog.functions.values()
+            if self._is_hot(prog, info)
+        ]
+        if not hot:
+            return []
+        closure: Set[str] = set()
+        stack = [info.qual for info in hot]
+        while stack:
+            cur = stack.pop()
+            if cur in closure:
+                continue
+            closure.add(cur)
+            stack.extend(prog.call_edges.get(cur, ()))
+        summaries = summaries_mod.compute_summaries(prog, only=closure)
+        inline = HotpathSyncRule()
+        findings: List[Finding] = []
+        seen = set()
+        for info in hot:
+            inline_tainted = inline._taint(self._hot_ancestor(prog, info))
+            for event in summaries_mod.analyze_hot_region(
+                prog, summaries, info
+            ):
+                if not event.via_call:
+                    if event.desc == ".item()":
+                        continue  # inline flags every hot .item()
+                    if event.name and event.name in inline_tainted:
+                        continue  # inline taint already sees this
+                key = (info.path, event.line, event.desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if event.via_call:
+                    msg = (
+                        f"{event.desc} host-converts its device-tainted "
+                        "argument — implicit device->host sync reached "
+                        "from this hot path (do the conversion behind "
+                        "an explicit jax.device_get at the boundary)"
+                    )
+                else:
+                    msg = (
+                        f"{event.desc} on `{event.name or '<expr>'}` — "
+                        "device taint flows through called helpers into "
+                        "this implicit host sync in a hot path"
+                    )
+                findings.append(
+                    Finding(self.name, info.path, event.line, msg)
+                )
+        return findings
+
+    @staticmethod
+    def _is_hot(prog, info) -> bool:
+        cur = info
+        while cur is not None:
+            if cur.ctx.is_hot_def(cur.node):
+                return True
+            cur = prog.functions.get(cur.parent) if cur.parent else None
+        return False
+
+    @staticmethod
+    def _hot_ancestor(prog, info):
+        cur = info
+        node = info.node
+        while cur is not None:
+            if cur.ctx.is_hot_def(cur.node):
+                node = cur.node
+            cur = prog.functions.get(cur.parent) if cur.parent else None
+        return node
+
+
+def _short_lock(lock_id: str) -> str:
+    return lock_id.split("::")[-1]
+
+
 FILE_RULES = [
     HotpathSyncRule(),
     JitHazardRule(),
@@ -934,4 +1382,10 @@ FILE_RULES = [
     ImportPurityRule(),
     LockDisciplineRule(),
     ExceptSwallowRule(),
+]
+
+CONCURRENCY_RULES = [
+    RaceRule(),
+    LockOrderRule(),
+    XprocSyncRule(),
 ]

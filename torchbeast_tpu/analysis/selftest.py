@@ -8,7 +8,7 @@ verdict line — the cheap CI guard that the analyzer itself still works
 import json
 import time
 
-from . import ALL_RULE_NAMES, analyze_source
+from . import ALL_RULE_NAMES, analyze_source, analyze_sources
 from .engine import FileContext, run_rules
 from .parity import check_flag_parity, check_wire_parity
 from .rules import FILE_RULES
@@ -197,6 +197,164 @@ def act(env):
     return logits.item()  # beastlint: disable=HOTPATH-SYNC
 '''
 
+# -- whole-program concurrency fixtures (ISSUE 7) ---------------------------
+# These run through the repo rules (analyze_sources), so the fixture
+# paths sit inside the concurrency scope (config.CONCURRENCY_PATHS).
+
+_RACE_POSITIVE = '''
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._thread = threading.Thread(target=self._drain)
+
+    def start(self):
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            self._total += 1
+
+    def snapshot(self):
+        return self._total
+
+
+def main():
+    pump = Pump()
+    pump.start()
+    return pump.snapshot()
+'''
+
+_RACE_CLEAN = '''
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._thread = threading.Thread(target=self._drain)
+
+    def start(self):
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                self._total += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._total
+
+
+def main():
+    pump = Pump()
+    pump.start()
+    return pump.snapshot()
+'''
+
+_LOCK_ORDER_POSITIVE = '''
+import threading
+
+
+class Mixer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._thread = threading.Thread(target=self._worker)
+
+    def start(self):
+        self._thread.start()
+
+    def _worker(self):
+        with self._a:
+            with self._b:
+                self.tick()
+
+    def tick(self):
+        pass
+
+
+def main():
+    mixer = Mixer()
+    mixer.start()
+    with mixer._b:
+        with mixer._a:
+            mixer.tick()
+'''
+
+_LOCK_ORDER_CLEAN = '''
+import threading
+
+
+class Mixer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._thread = threading.Thread(target=self._worker)
+
+    def start(self):
+        self._thread.start()
+
+    def _worker(self):
+        with self._a:
+            with self._b:
+                self.tick()
+
+    def tick(self):
+        pass
+
+
+def main():
+    mixer = Mixer()
+    mixer.start()
+    with mixer._a:
+        with mixer._b:
+            mixer.tick()
+'''
+
+_XPROC_POSITIVE = '''
+import jax.numpy as jnp
+
+
+def embed(v):
+    return jnp.tanh(v)
+
+
+def to_host(x):
+    return float(x)
+
+
+# beastlint: hot
+def act(env):
+    z = embed(env)
+    return to_host(z)
+'''
+
+_XPROC_CLEAN = '''
+import jax
+import jax.numpy as jnp
+
+
+def embed(v):
+    return jnp.tanh(v)
+
+
+def to_host(x):
+    return float(x)
+
+
+# beastlint: hot
+def act(env, n):
+    z = embed(env)
+    host = jax.device_get(z)
+    return to_host(host), to_host(n)
+'''
+
 # -- wire-parity fixtures ---------------------------------------------------
 
 _WIRE_PY = '''
@@ -299,6 +457,33 @@ def run_selftest() -> dict:
             ),
             # The seeded violation must be the ONLY rule firing: a noisy
             # fixture would hide a rule bleeding into its neighbors.
+            "isolated": all(
+                f.rule == name for f in pos_report.findings
+            ),
+        }
+
+    concurrency_pairs = {
+        "RACE": (
+            _RACE_POSITIVE, _RACE_CLEAN,
+            "torchbeast_tpu/fixture_race.py",
+        ),
+        "LOCK-ORDER": (
+            _LOCK_ORDER_POSITIVE, _LOCK_ORDER_CLEAN,
+            "torchbeast_tpu/fixture_lockorder.py",
+        ),
+        "HOTPATH-SYNC-XPROC": (
+            _XPROC_POSITIVE, _XPROC_CLEAN,
+            "torchbeast_tpu/fixture_xproc.py",
+        ),
+    }
+    for name, (positive, clean, path) in concurrency_pairs.items():
+        pos_report = analyze_sources({path: positive})
+        clean_report = analyze_sources({path: clean})
+        rules[name] = {
+            "positive": any(f.rule == name for f in pos_report.findings),
+            "clean": not any(
+                f.rule == name for f in clean_report.findings
+            ),
             "isolated": all(
                 f.rule == name for f in pos_report.findings
             ),

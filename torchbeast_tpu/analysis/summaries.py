@@ -1,0 +1,301 @@
+"""Per-function device-sync summaries for HOTPATH-SYNC-XPROC.
+
+The intraprocedural HOTPATH-SYNC rule only sees a sync written inline:
+`float(x)` where `x` was assigned from a jax expression in the SAME
+function. A helper that does the conversion — `def to_host(x): return
+float(x)` — is invisible to it at every call site. This module computes
+whole-program summaries so the cross-procedure rule can catch exactly
+that shape:
+
+    returns_device        the function's return value is device-resident
+                          regardless of its arguments (rooted in
+                          jnp/lax/jax.* or a device-returning callee)
+    returns_taint_of      param indices whose taint propagates to the
+                          return value (`def scale(x): return x * 2`)
+    converts_params       param indices that reach an implicit
+                          device->host conversion (`.item()`,
+                          `float()/int()/bool()`, `np.asarray/array`)
+                          inside the function or transitively through
+                          its callees
+
+Summaries are computed by a bounded fixpoint over the call graph
+(graph.Program supplies call resolution), using a labeled taint lattice:
+a value's label set may contain `"dev"` (device-resident now) and/or
+`"p<i>"` (tainted iff param i is). `jax.device_get` results are host —
+the explicit fetch the rules recommend must never re-taint.
+
+The same labeled walker doubles as the rule-side analysis: seeded with
+real `"dev"` labels inside a hot region, it reports conversion events
+(direct, and through callee summaries) that the inline rule cannot see.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Set, Tuple
+
+from . import config
+from .graph import (
+    Program,
+    _attr_chain,
+    _build_env_chain,
+    _own_nodes,
+    _resolve_call_targets,
+)
+
+_DEV = "dev"
+
+# Shared with the intraprocedural HOTPATH-SYNC rule via config (one
+# contract, two analyses).
+_HOST_JAX_NAMESPACES = frozenset(config.HOST_JAX_NAMESPACES)
+_HOST_RETURNING_CALLS = frozenset(config.HOST_RETURNING_CALLS)
+
+
+@dataclasses.dataclass
+class FuncSummary:
+    returns_device: bool = False
+    returns_taint_of: Set[int] = dataclasses.field(default_factory=set)
+    converts_params: Set[int] = dataclasses.field(default_factory=set)
+
+    def key(self) -> Tuple:
+        return (
+            self.returns_device,
+            frozenset(self.returns_taint_of),
+            frozenset(self.converts_params),
+        )
+
+
+@dataclasses.dataclass
+class SyncEvent:
+    """One implicit conversion the labeled walker observed."""
+
+    line: int
+    desc: str  # e.g. "float()", "helper to_host()"
+    labels: FrozenSet[str]  # labels of the converted value
+    via_call: bool  # True when the sync happens inside a callee
+    name: str = ""  # converted value's name/chain when it has one
+
+
+class _LabeledTaint:
+    """One pass of labeled taint over a single function body."""
+
+    def __init__(self, prog: Program, summaries: Dict[str, FuncSummary],
+                 info, seed_params: bool):
+        self.prog = prog
+        self.summaries = summaries
+        self.info = info
+        self.env = _build_env_chain(prog, info)
+        self.labels: Dict[str, Set[str]] = {}
+        self.events: List[SyncEvent] = []
+        if seed_params:
+            params = info.params[1:] if info.cls else info.params
+            for i, name in enumerate(params):
+                self.labels[name] = {f"p{i}"}
+
+    # -- label evaluation --------------------------------------------------
+
+    def eval(self, expr) -> Set[str]:
+        if expr is None:
+            return set()
+        if isinstance(expr, ast.Name):
+            return set(self.labels.get(expr.id, ()))
+        if isinstance(expr, ast.Call):
+            return self._call_labels(expr)
+        if isinstance(expr, ast.Attribute):
+            chain = _attr_chain(expr)
+            parts = chain.split(".") if chain else []
+            if parts:
+                if parts[0] in ("jnp", "lax"):
+                    return {_DEV}
+                if parts[0] == "jax" and len(parts) > 1 and (
+                    parts[1] not in _HOST_JAX_NAMESPACES
+                ):
+                    return {_DEV}
+                if parts[0] in self.labels:
+                    return set(self.labels[parts[0]])
+            out: Set[str] = set()
+            for child in ast.iter_child_nodes(expr):
+                out |= self.eval(child)
+            return out
+        out = set()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                out |= self.eval(child)
+        return out
+
+    def _call_labels(self, call: ast.Call) -> Set[str]:
+        chain = _attr_chain(call.func)
+        if chain in _HOST_RETURNING_CALLS:
+            return set()  # explicit fetch: host result by contract
+        self._check_conversion(call)
+        arg_labels = [self.eval(a) for a in call.args]
+        targets = self._targets(call)
+        out: Set[str] = set()
+        resolved = False
+        for qual in targets:
+            summary = self.summaries.get(qual)
+            if summary is None:
+                continue
+            resolved = True
+            if summary.returns_device:
+                out.add(_DEV)
+            for i in summary.returns_taint_of:
+                if i < len(arg_labels):
+                    out |= arg_labels[i]
+            for i in summary.converts_params:
+                if i < len(arg_labels) and arg_labels[i]:
+                    self.events.append(
+                        SyncEvent(
+                            call.lineno,
+                            f"helper {qual.split('::')[-1]}()",
+                            frozenset(arg_labels[i]),
+                            via_call=True,
+                            name=_attr_chain(call.args[i]),
+                        )
+                    )
+        if not resolved:
+            # Unknown callee: device-rooted callables (jnp.*, a stored
+            # jitted step) produce device values; the attribute branch
+            # already covers jnp/lax/jax chains via func labels.
+            func_labels = self.eval(call.func)
+            out |= func_labels & {_DEV}
+            # A method on a tainted value usually stays tainted
+            # (x.mean(), x.reshape(...)).
+            if isinstance(call.func, ast.Attribute):
+                out |= self.eval(call.func.value)
+        return out
+
+    def _targets(self, call) -> Set[str]:
+        return _resolve_call_targets(self.prog, self.info, self.env, call)
+
+    def _check_conversion(self, call: ast.Call) -> None:
+        """Direct implicit conversions (same set as HOTPATH-SYNC)."""
+        func = call.func
+        target = None
+        desc = ""
+        if isinstance(func, ast.Attribute) and func.attr == "item" and (
+            not call.args and not call.keywords
+        ):
+            target, desc = func.value, ".item()"
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int", "bool")
+            and len(call.args) == 1
+        ):
+            target, desc = call.args[0], f"{func.id}()"
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("asarray", "array")
+            and _attr_chain(func).split(".")[0] in ("np", "numpy")
+            and call.args
+        ):
+            target, desc = call.args[0], f"np.{func.attr}()"
+        if target is None:
+            return
+        labels = self.eval(target)
+        if labels:
+            self.events.append(
+                SyncEvent(call.lineno, desc, frozenset(labels),
+                          via_call=False,
+                          name=_attr_chain(target))
+            )
+
+    # -- statement pass ----------------------------------------------------
+
+    def run(self) -> Tuple[bool, Set[int]]:
+        """Process the body; returns (returns_device, returns_taint_of)."""
+        returns_device = False
+        returns_taint: Set[int] = set()
+        # Two passes: assignments may forward-reference (same bounded
+        # fixpoint HotpathSyncRule uses).
+        for _ in range(2):
+            before = {k: set(v) for k, v in self.labels.items()}
+            self.events.clear()
+            for node in _own_nodes(self.info.node):
+                if isinstance(node, ast.Assign):
+                    value_labels = self.eval(node.value)
+                    for t in node.targets:
+                        for name_node in ast.walk(t):
+                            if isinstance(name_node, ast.Name):
+                                if value_labels:
+                                    self.labels.setdefault(
+                                        name_node.id, set()
+                                    ).update(value_labels)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    value_labels = self.eval(node.value)
+                    if isinstance(node.target, ast.Name) and value_labels:
+                        self.labels.setdefault(
+                            node.target.id, set()
+                        ).update(value_labels)
+            if before == self.labels:
+                break
+        # Final event + return pass with stable labels. Each statement's
+        # DIRECT expression fields are evaluated exactly once (nested
+        # statements evaluate their own), so every call site's events
+        # are gathered once.
+        self.events.clear()
+        for node in _own_nodes(self.info.node):
+            if isinstance(node, ast.withitem):
+                self.eval(node.context_expr)
+                continue
+            if not isinstance(node, ast.stmt):
+                continue
+            if isinstance(node, ast.Return) and node.value is not None:
+                labels = self.eval(node.value)
+                if _DEV in labels:
+                    returns_device = True
+                for label in labels:
+                    if label.startswith("p"):
+                        returns_taint.add(int(label[1:]))
+                continue
+            for _, value in ast.iter_fields(node):
+                if isinstance(value, ast.expr):
+                    self.eval(value)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self.eval(v)
+        return returns_device, returns_taint
+
+
+def compute_summaries(
+    prog: Program, only: Set[str] = None
+) -> Dict[str, FuncSummary]:
+    """Bounded fixpoint over the call graph (callee summaries feed the
+    caller's labeled pass; 8 rounds cover any realistic helper depth).
+    `only` restricts the fixpoint to a subset of function quals —
+    the rule passes the closure of the hot regions, keeping the cost
+    proportional to the annotated surface, not the repo."""
+    quals = prog.functions.keys() if only is None else (
+        only & prog.functions.keys()
+    )
+    summaries: Dict[str, FuncSummary] = {q: FuncSummary() for q in quals}
+    for _ in range(8):
+        changed = False
+        for qual in quals:
+            info = prog.functions[qual]
+            walker = _LabeledTaint(prog, summaries, info,
+                                   seed_params=True)
+            returns_device, returns_taint = walker.run()
+            converts: Set[int] = set()
+            for event in walker.events:
+                for label in event.labels:
+                    if label.startswith("p"):
+                        converts.add(int(label[1:]))
+            new = FuncSummary(returns_device, returns_taint, converts)
+            if new.key() != summaries[qual].key():
+                summaries[qual] = new
+                changed = True
+        if not changed:
+            break
+    return summaries
+
+
+def analyze_hot_region(
+    prog: Program, summaries: Dict[str, FuncSummary], info
+) -> List[SyncEvent]:
+    """Run the labeled analysis over one HOT function with real seeds
+    (no param labels: a hot region's own arguments are not assumed
+    device-resident — same stance as the inline rule)."""
+    walker = _LabeledTaint(prog, summaries, info, seed_params=False)
+    walker.run()
+    return [e for e in walker.events if _DEV in e.labels]

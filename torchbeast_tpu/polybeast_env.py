@@ -231,6 +231,7 @@ class ServerSupervisor:
             daemon=True,
         )
         p.start()
+        # beastlint: disable=RACE  single-writer map: the constructor fills every slot before start_watch() creates the watcher (Thread.start publishes); afterwards _spawn runs only on the watcher thread
         self._spawned_at[i] = time.monotonic()
         return p
 
@@ -278,6 +279,7 @@ class ServerSupervisor:
                     continue
                 if now < due:
                     continue
+                # beastlint: disable=RACE  watcher-only read-modify-write; the driver's monitor reads an int that is torn-free under the GIL and only informational (stats line / chaos accounting)
                 self.restarts += 1
                 log.warning(
                     "Env server %d: restarting on its address "
@@ -308,6 +310,7 @@ class ServerSupervisor:
                     # must die here, not serve forever unreaped.
                     reap_group([replacement])
                     return
+                # beastlint: disable=RACE  single-reference slot store under the GIL; readers (driver reap, chaos injector) tolerate a momentarily stale member and re-check is_alive()/pid before acting on it
                 self.processes[i] = replacement
 
     def stop(self):

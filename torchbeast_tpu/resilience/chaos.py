@@ -242,9 +242,12 @@ class ChaosController:
             kind: reg.counter(f"chaos.{kind}.injected")
             for kind in FAULT_KINDS
         }
-        self._server_supervisor = None
-        self._state_table = None
-        self._step_fn: Callable[[], int] = lambda: 0
+        # Attached by the driver thread while the poll thread may
+        # already be reading (re-attachment after a rebuild is legal):
+        # all three ride the controller lock (RACE burn-down, ISSUE 7).
+        self._server_supervisor = None  # guarded-by: self._lock
+        self._state_table = None  # guarded-by: self._lock
+        self._step_fn: Callable[[], int] = lambda: 0  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._transports: Dict[int, FaultingTransport] = {}  # guarded-by: self._lock
         # actor -> (kind, window_end_monotonic, delay_s)
@@ -257,13 +260,16 @@ class ChaosController:
     def attach_servers(self, supervisor) -> None:
         """A polybeast_env.ServerSupervisor (or anything with a
         `.processes` list of live mp.Process members)."""
-        self._server_supervisor = supervisor
+        with self._lock:
+            self._server_supervisor = supervisor
 
     def attach_state_table(self, table) -> None:
-        self._state_table = table
+        with self._lock:
+            self._state_table = table
 
     def set_step_fn(self, fn: Callable[[], int]) -> None:
-        self._step_fn = fn
+        with self._lock:
+            self._step_fn = fn
 
     def wrap_transport(self, transport, actor_index: int):
         wrapped = FaultingTransport(transport, actor_index, self)
@@ -340,7 +346,9 @@ class ChaosController:
 
     def _run(self) -> None:
         while not self._stop.wait(self._poll_s):
-            step = self._step_fn()
+            with self._lock:
+                step_fn = self._step_fn
+            step = step_fn()
             elapsed = time.monotonic() - self._started_at
             for fault in self.plan.faults:
                 if fault.fired or fault.abandoned:
@@ -385,7 +393,8 @@ class ChaosController:
     def _inject(self, fault: FaultSpec) -> bool:
         kind = fault.kind
         if kind == "env_server_sigkill":
-            sup = self._server_supervisor
+            with self._lock:
+                sup = self._server_supervisor
             if sup is None or not getattr(sup, "processes", None):
                 return False
             proc = sup.processes[fault.target % len(sup.processes)]
@@ -416,7 +425,8 @@ class ChaosController:
                 return False
             return _corrupt_ring(ring, header=kind == "shm_corrupt_header")
         if kind == "state_table_poison":
-            table = self._state_table
+            with self._lock:
+                table = self._state_table
             if table is None:
                 return False
             table.poison()

@@ -201,8 +201,16 @@ class InferenceSupervisor:
         self._restarts = 0  # guarded-by: self._lock
         self._recovery_gen = 0  # guarded-by: self._lock
         self._exhausted = False  # guarded-by: self._lock
-        self.errors: List[BaseException] = []
+        # Appended by N serving threads, polled by the driver monitor
+        # (RACE burn-down, ISSUE 7): exposed through the locked
+        # `errors` property.
+        self._errors: List[BaseException] = []  # guarded-by: self._lock
         self._threads: List[threading.Thread] = []
+
+    @property
+    def errors(self) -> List[BaseException]:
+        with self._lock:
+            return list(self._errors)
 
     @property
     def restarts(self) -> int:
@@ -253,7 +261,8 @@ class InferenceSupervisor:
                 # Not a poisoning: a real serving bug. Record it and die
                 # loudly; actors drain their retry budgets against the
                 # survivors and the health machine degrades from there.
-                self.errors.append(e)
+                with self._lock:
+                    self._errors.append(e)
                 log.exception(
                     "Inference thread %d failed (unrecoverable)", index
                 )
@@ -344,6 +353,7 @@ class LearnerWatchdog:
         self._thread: Optional[threading.Thread] = None
 
     def ping(self) -> None:
+        # beastlint: disable=RACE  single-writer monotonic float: only the learner thread writes at runtime, the GIL makes the store atomic, and the watchdog reading one stale value merely delays stall detection by a poll tick
         self._last_ping = time.monotonic()
 
     @property

@@ -105,7 +105,9 @@ class ActorPool:
         self._reconnects = 0  # guarded-by: self._count_lock
         self._dead = 0  # guarded-by: self._count_lock
         self._count_lock = threading.Lock()
-        self._errors: List[BaseException] = []
+        # Appended by N actor threads, read by the pool runner and the
+        # driver monitor (RACE burn-down, ISSUE 7).
+        self._errors: List[BaseException] = []  # guarded-by: self._count_lock
         # Per-connection wire accounting + request RTT (ISSUE 2).
         # "up" = env-server -> this process (observations rising toward
         # the learner), "down" = actions back out — the same direction
@@ -140,7 +142,8 @@ class ActorPool:
 
     @property
     def errors(self) -> List[BaseException]:
-        return list(self._errors)
+        with self._count_lock:
+            return list(self._errors)
 
     @property
     def reconnects(self) -> int:
@@ -172,8 +175,10 @@ class ActorPool:
             t.start()
         for t in threads:
             t.join()
-        if self._errors:
-            raise self._errors[0]
+        with self._count_lock:
+            errors = list(self._errors)
+        if errors:
+            raise errors[0]
 
     def _guarded_loop(self, index: int, address: str):
         try:
@@ -228,7 +233,8 @@ class ActorPool:
                     )
                     continue
                 log.exception("Actor %d (%s) failed", index, address)
-                self._errors.append(e)
+                with self._count_lock:
+                    self._errors.append(e)
                 return
             except (ConnectionError, TimeoutError, OSError,
                     wire.WireError) as e:
@@ -258,11 +264,13 @@ class ActorPool:
                     )
                     continue
                 log.exception("Actor %d (%s) failed", index, address)
-                self._errors.append(e)
+                with self._count_lock:
+                    self._errors.append(e)
                 return
             except BaseException as e:  # noqa: BLE001
                 log.exception("Actor %d (%s) failed", index, address)
-                self._errors.append(e)
+                with self._count_lock:
+                    self._errors.append(e)
                 return
 
     def _connect(self, address: str, index: int):
@@ -366,7 +374,7 @@ class ActorPool:
         per-request StageTrace (enqueue -> batch -> reply)."""
         trace = None
         if self._traceable:
-            # Racy tick is fine: sampling cadence, not an exact count.
+            # beastlint: disable=RACE  sampling cadence, not an exact count: N actor threads may lose increments, which only shifts WHICH request gets traced
             self._trace_tick += 1
             if self._trace_tick % self._TRACE_EVERY == 0:
                 trace = self._tracer.stage("actor.request", actor=index)

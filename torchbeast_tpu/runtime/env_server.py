@@ -59,15 +59,22 @@ class EnvServer:
         self._obs_ring_bytes = obs_ring_bytes
         self._act_ring_bytes = act_ring_bytes
         self._family, self._target = parse_address(address)
-        self._sock = None
-        self._threads = []
+        # Control fields shared between run() (its own thread under
+        # start()), the per-stream threads, and stop() (caller thread):
+        # all guarded by the conns lock (RACE burn-down, ISSUE 7).
+        self._sock = None  # guarded-by: self._conns_lock
+        self._threads = []  # guarded-by: self._conns_lock
+        # Permanent stop latch: a stop() that wins the race against a
+        # just-starting run() (before the listener is published) must
+        # still stop it — run() re-checks this at publish time.
+        self._stopped = False  # guarded-by: self._conns_lock
         self._conns = []
         # conn -> (shm segment names) for live shm streams: stop()'s
         # owner-side sweep unlinks whatever a stream thread didn't get
         # to (ISSUE 6 — SIGKILL chaos must not grow /dev/shm).
         self._ring_names = {}  # guarded-by: self._conns_lock
         self._conns_lock = threading.Lock()
-        self._running = False
+        self._running = False  # guarded-by: self._conns_lock
         # NB: env servers usually run as separate processes, so these
         # land in each server's OWN process registry (the learner-side
         # mirror lives in ActorPool's wire.bytes_* counters).
@@ -80,21 +87,38 @@ class EnvServer:
     def run(self):
         """Bind and serve until stop() (reference Server.run blocks too,
         rpcenv.cc:142-156)."""
-        self._sock = socket.socket(self._family, socket.SOCK_STREAM)
+        sock = socket.socket(self._family, socket.SOCK_STREAM)
         if self._family == socket.AF_UNIX:
             try:
                 os.unlink(self._target)
             except FileNotFoundError:
                 pass
         else:
-            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(self._target)
-        self._sock.listen(16)
-        self._running = True
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(self._target)
+        sock.listen(16)
+        # Publish the listener + running flag under the lock: a stop()
+        # racing a just-starting run() either already latched _stopped
+        # (we tear down here and never serve) or sees the published
+        # socket and closes it.
+        with self._conns_lock:
+            if self._stopped:
+                sock.close()
+                if self._family == socket.AF_UNIX:
+                    try:
+                        os.unlink(self._target)
+                    except FileNotFoundError:
+                        pass
+                return
+            self._sock = sock
+            self._running = True
         log.info("EnvServer listening on %s", self._address)
-        while self._running:
+        while True:
+            with self._conns_lock:
+                if not self._running:
+                    break
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = sock.accept()
             except OSError:
                 break  # socket closed by stop()
             # Register the conn BEFORE spawning its thread so a concurrent
@@ -110,24 +134,29 @@ class EnvServer:
             t.start()
             # Prune finished stream threads so reconnect-heavy workloads
             # don't grow this list unboundedly.
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            with self._conns_lock:
+                self._threads = [
+                    x for x in self._threads if x.is_alive()
+                ] + [t]
 
     def start(self):
         """Non-blocking run() in a daemon thread."""
         t = threading.Thread(target=self.run, daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._conns_lock:
+            self._threads.append(t)
 
     def stop(self):
         with self._conns_lock:
+            self._stopped = True
             self._running = False
-        if self._sock is not None:
+            sock = self._sock
+        if sock is not None:
             try:
-                self._sock.shutdown(socket.SHUT_RDWR)
+                sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-            self._sock.close()
+            sock.close()
         # Sever live streams too — stop() means stop, and clients with
         # reconnect enabled treat the cut as a transport failure.
         with self._conns_lock:
@@ -142,7 +171,11 @@ class EnvServer:
         # close their rings (which unlinks them), then unlink whatever
         # is left. A thread wedged past the join window must not strand
         # segments in /dev/shm — unlink is safe under live mappings.
-        for t in list(self._threads):
+        # (Joins happen OUTSIDE the conns lock: a stream thread's
+        # teardown takes it to deregister.)
+        with self._conns_lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2)
         with self._conns_lock:
             leftovers = [

@@ -19,10 +19,12 @@ _module = None
 def import_native() -> Optional[object]:
     global _cached, _module
     if not _cached:
+        # beastlint: disable=RACE  idempotent lazy import: two racing threads both import the (interpreter-cached) module and store identical results; each store is GIL-atomic
         _cached = True
         try:
             import _tbt_core
 
+            # beastlint: disable=RACE  same benign double-init as _cached above: both racers store the same module object
             _module = _tbt_core
         except ImportError:
             _module = None

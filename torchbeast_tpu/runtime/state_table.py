@@ -192,8 +192,13 @@ class DeviceStateTable:
         StateTablePoisonedError; the serving loop re-raises to kill its
         thread rather than retry per-batch, and the inference
         supervisor (resilience/supervisor.py) owns the recovery:
-        `rebuild()` + a thread restart under a bounded budget."""
-        return self._table is None
+        `rebuild()` + a thread restart under a bounded budget.
+
+        Read under the table lock (cold path: exception handling and
+        supervisor recovery only), so a concurrent poison/rebuild is
+        seen whole rather than half-observed (RACE burn-down, ISSUE 7)."""
+        with self._lock:
+            return self._table is None
 
     def poison(self) -> None:
         """Chaos/testing hook: put the table into the poisoned state a

@@ -136,7 +136,9 @@ def _instruments():
         from torchbeast_tpu import telemetry
 
         reg = telemetry.get_registry()
+        # beastlint: disable=RACE  benign double-init: the registry's get-or-create is idempotent, so racing encoder threads store the SAME instrument object; each store is GIL-atomic
         _tm_encode = reg.histogram("wire.encode_s")
+        # beastlint: disable=RACE  same idempotent lazy-init as _tm_encode above
         _tm_decode = reg.histogram("wire.decode_s")
     return _tm_encode, _tm_decode
 
