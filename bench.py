@@ -589,6 +589,47 @@ def run_bench(child_deadline: float):
             f"({remaining():.0f}s left)\n"
         )
 
+    # Learner bytes-moved accounting (ISSUE 8): XLA-reported bytes
+    # accessed per update, f32 vs --precision bf16_train, from the
+    # dtype-faithful lowered HLO (lowering-only — no compile, cheap on
+    # any host; methodology in benchmarks/learner_bench.py). ONE
+    # measurement implementation, shared with the committed artifact.
+    def measure_learner_bytes():
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "learner_bench",
+            os.path.join(_REPO, "benchmarks", "learner_bench.py"),
+        )
+        lb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lb)
+        rows, _ = lb.measure_bytes(
+            "mlp", ks=[1], t=lb.BYTES_T, b=lb.BYTES_B
+        )
+        by_prec = {
+            r["precision"]: r["bytes_accessed"]
+            for r in rows
+            if r["k"] == 1 and r["bytes_accessed"]
+        }
+        f32_b = by_prec.get("f32")
+        bf16_b = by_prec.get("bf16_train")
+        reduction = f32_b / bf16_b if f32_b and bf16_b else None
+        return f32_b, bf16_b, reduction
+
+    hbm_f32 = hbm_bf16 = hbm_reduction = None
+    if remaining() > 30:
+        try:
+            hbm_f32, hbm_bf16, hbm_reduction = measure_learner_bytes()
+        except Exception as e:  # diagnostic only — never sink the bench
+            sys.stderr.write(
+                f"bench: learner bytes measurement failed: {e}\n"
+            )
+    else:
+        sys.stderr.write(
+            f"bench: skipping learner bytes phase "
+            f"({remaining():.0f}s left)\n"
+        )
+
     result = _base_result(**_live_fields())
     result.update({
         "value": round(frames_per_sec, 1),
@@ -670,6 +711,31 @@ def run_bench(child_deadline: float):
         )
         if learner_updates_sps and prev_learner
         and prev_learner_platform == platform
+        else None
+    )
+    # Bytes-moved regression visibility (ISSUE 8), same _prev/_delta
+    # convention against the committed learner_bench artifact's
+    # small-MLP K=1 reduction. The lowered-HLO figure is platform-
+    # neutral (no platform match required): a delta here means the
+    # learner's byte diet itself changed, not the machine.
+    result["learner_hbm_bytes_per_update"] = hbm_f32
+    result["learner_hbm_bytes_per_update_bf16"] = hbm_bf16
+    result["learner_hbm_bytes_reduction"] = (
+        round(hbm_reduction, 3) if hbm_reduction else None
+    )
+    prev_hbm = None
+    try:
+        prev_hbm = lb_art.get("acceptance", {}).get("bytes", {}).get(
+            "mlp_update_reduction_k1"
+        )
+    except Exception:
+        pass
+    result["learner_hbm_bytes_reduction_prev"] = (
+        round(prev_hbm, 3) if prev_hbm else None
+    )
+    result["learner_hbm_bytes_reduction_delta_pct"] = (
+        round(100.0 * (hbm_reduction - prev_hbm) / prev_hbm, 1)
+        if hbm_reduction and prev_hbm
         else None
     )
     if not on_accel:

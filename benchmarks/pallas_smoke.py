@@ -82,6 +82,61 @@ def attention_case(b, t, h, d, m, seed=0, interpret=False):
     }
 
 
+def vtrace_case(t, b, seed=0, interpret=False):
+    """The fused V-trace targets kernel (ops/pallas_vtrace.py) vs the
+    sequential-scan reference — vs AND pg_advantages from one kernel."""
+    from torchbeast_tpu.ops import vtrace
+
+    rng = np.random.default_rng(seed)
+    inputs = dict(
+        log_rhos=jnp.asarray(
+            rng.uniform(-2.5, 2.5, (t, b)).astype(np.float32)
+        ),
+        discounts=jnp.asarray(
+            ((rng.random((t, b)) > 0.1) * 0.99).astype(np.float32)
+        ),
+        rewards=jnp.asarray(
+            rng.standard_normal((t, b)).astype(np.float32)
+        ),
+        values=jnp.asarray(
+            (rng.standard_normal((t, b)) * 2).astype(np.float32)
+        ),
+        bootstrap_value=jnp.asarray(
+            (rng.standard_normal((b,)) * 2).astype(np.float32)
+        ),
+    )
+    ref = vtrace.from_importance_weights(
+        **inputs, scan_impl="sequential"
+    )
+    os.environ.pop("TORCHBEAST_VTRACE_PALLAS_COMPILE", None)
+    if not interpret:
+        # Force the compiled kernel even off-TPU so a CPU run fails
+        # cleanly per-case, exactly as the attention/pool cases do.
+        os.environ["TORCHBEAST_VTRACE_PALLAS_COMPILE"] = "1"
+    try:
+        t0 = time.perf_counter()
+        ours = vtrace.from_importance_weights(
+            **inputs, scan_impl="pallas"
+        )
+        jax.block_until_ready(ours.vs)
+        compile_s = time.perf_counter() - t0
+    finally:
+        os.environ.pop("TORCHBEAST_VTRACE_PALLAS_COMPILE", None)
+    err = max(
+        float(jnp.max(jnp.abs(ours.vs - ref.vs))),
+        float(jnp.max(jnp.abs(ours.pg_advantages - ref.pg_advantages))),
+    )
+    scale = float(jnp.max(jnp.abs(ref.vs))) or 1.0
+    return {
+        "kernel": "vtrace_targets",
+        "shape": f"T{t} B{b}",
+        "max_abs_err": err,
+        "rel_err": err / scale,
+        "compile_s": round(compile_s, 2),
+        "ok": bool(err / scale < 5e-5),
+    }
+
+
 def pool_case(shape, seed=0, interpret=False):
     from torchbeast_tpu.ops.pallas_pool import pool_bwd
 
@@ -142,6 +197,10 @@ def main() -> None:
             ("pool-test",
              lambda: pool_case((2, 21, 21, 32), interpret=itp))
         )
+        cases.append(
+            ("vtrace-test",
+             lambda: vtrace_case(13, 8, interpret=itp))
+        )
     if "chip" in sizes:
         # Flagship shapes: the transformer's RL-unroll attention
         # (models/transformer.py defaults) and the deep trunk's stage-1
@@ -153,6 +212,11 @@ def main() -> None:
         cases.append(
             ("pool-chip",
              lambda: pool_case((8, 84, 84, 32), interpret=itp))
+        )
+        # Flagship unroll/batch — the learner's default-path shape.
+        cases.append(
+            ("vtrace-chip",
+             lambda: vtrace_case(80, 32, interpret=itp))
         )
 
     results, failures = [], []

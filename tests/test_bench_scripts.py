@@ -40,7 +40,9 @@ def test_pallas_smoke_interpret_rehearsal(tmp_path):
     assert out["interpret"] is True
     # A CPU interpreter pass must NOT claim the Mosaic box is checked.
     assert out["mosaic"] is False
-    assert {c["case"] for c in out["cases"]} == {"attn-test", "pool-test"}
+    assert {c["case"] for c in out["cases"]} == {
+        "attn-test", "pool-test", "vtrace-test",
+    }
 
 
 def test_pallas_smoke_compiled_cpu_fails_cleanly():
@@ -51,7 +53,9 @@ def test_pallas_smoke_compiled_cpu_fails_cleanly():
     assert proc.returncode == 1
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["ok"] is False
-    assert set(out["failures"]) == {"attn-test", "pool-test"}
+    assert set(out["failures"]) == {
+        "attn-test", "pool-test", "vtrace-test",
+    }
     for case in out["cases"]:
         assert "error" in case and "traceback" in case
 
@@ -222,6 +226,32 @@ def test_learner_bench_selftest(tmp_path):
         # The host-sync contract: EXACTLY updates / K stats round-trips.
         assert row["host_syncs"] * row["k"] == row["updates"]
     assert out["acceptance"]["mlp_speedup_ktop_vs_k1"] > 0
+
+    # Bytes-moved block (ISSUE 8): full (config, K, precision) matrix
+    # with XLA-reported figures, fwd_bwd rows per (config, precision),
+    # and the f32/bf16_train reductions surfaced in the acceptance.
+    bytes_block = out["results"]["bytes"]
+    update_rows = {
+        (r["config"], r["k"], r["precision"])
+        for r in bytes_block["update"]
+    }
+    assert update_rows == {
+        (c, k, p)
+        for c in ("mlp", "lstm")
+        for k in (1, 2)
+        for p in ("f32", "bf16_train")
+    }
+    for r in bytes_block["update"] + bytes_block["fwd_bwd"]:
+        assert r["bytes_accessed"] is None or r["bytes_accessed"] > 0
+    red = bytes_block["reductions"]
+    for config in ("mlp", "lstm"):
+        assert f"{config}_fwd_bwd_reduction" in red
+        assert f"{config}_update_reduction_k1" in red
+        # bf16_train must MOVE the metric in the right direction even
+        # at the selftest's tiny shape (the >=1.8x/1.7x acceptance
+        # floors apply to the full run's flagship shape).
+        assert red[f"{config}_fwd_bwd_reduction"] > 1.0
+    assert out["acceptance"]["bytes"] == red
 
     # Telemetry block embedded like the other benches, with the
     # superstep instrumentation populated.

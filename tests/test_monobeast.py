@@ -71,6 +71,33 @@ def test_test_mode(tmp_path):
     assert all(r == 200.0 for r in returns)
 
 
+def test_bf16_train_learns_catch(tmp_path):
+    """--precision bf16_train LEARNING smoke, tier-1 by design (ISSUE
+    8): bf16-resident params + bf16 staged batch + bf16 second moment
+    must still solve Catch (return 1.0 measured in the calibration run;
+    gated at 0.5 — well above the ~-0.3 chance floor — to absorb
+    CPU-container seed noise). The f32 twin of this config is the slow
+    test_mono_learns_catch; this is the one end-to-end proof that the
+    precision policy changes bytes, not the algorithm."""
+    flags = monobeast.make_parser().parse_args([
+        "--env", "Catch",
+        "--model", "mlp",
+        "--num_actors", "16",
+        "--batch_size", "16",
+        "--unroll_length", "9",
+        "--total_steps", "60000",
+        "--serial_envs",
+        "--learning_rate", "2e-3",
+        "--entropy_cost", "0.01",
+        "--savedir", str(tmp_path),
+        "--xpid", "catch-bf16",
+        "--checkpoint_interval_s", "100000",
+        "--precision", "bf16_train",
+    ])
+    stats = monobeast.train(flags)
+    assert stats.get("mean_episode_return", -1.0) > 0.5
+
+
 @pytest.mark.slow
 def test_mono_learns_catch(tmp_path):
     """End-to-end learning check on a real task: the sync driver must

@@ -95,6 +95,62 @@ def test_from_importance_weights_matches_ground_truth(
     np.testing.assert_allclose(out.pg_advantages, gt_pg, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("impl", ["associative", "pallas"])
+@pytest.mark.parametrize("t", [1, 80, 4000])
+@pytest.mark.parametrize(
+    "clip_rho,clip_pg_rho", [(1.0, 1.0), (3.7, 2.2), (None, None)]
+)
+def test_scan_impl_parity_matrix(impl, t, clip_rho, clip_pg_rho):
+    """The default-path promotion contract (ISSUE 8): every scan impl
+    agrees with the sequential reference across unroll lengths (T=1
+    edge, the T=80 flagship, the 4000-shaped long-context case) and
+    every clip setting. f32 inputs: float-reassociation tolerance only
+    (1e-4 at T=4000 where products of thousands of terms reassociate;
+    1e-5 below). The pallas rows run the fused kernel under the
+    interpreter — numerics-identical to the compiled kernel."""
+    rng = np.random.default_rng(11 + t)
+    b = 2 if t == 4000 else 4
+    inputs = _random_inputs(rng, (t, b))
+    inputs = {k: jnp.asarray(v, jnp.float32) for k, v in inputs.items()}
+    seq = vtrace.from_importance_weights(
+        **inputs, clip_rho_threshold=clip_rho,
+        clip_pg_rho_threshold=clip_pg_rho, scan_impl="sequential",
+    )
+    out = vtrace.from_importance_weights(
+        **inputs, clip_rho_threshold=clip_rho,
+        clip_pg_rho_threshold=clip_pg_rho, scan_impl=impl,
+    )
+    tol = 1e-4 if t == 4000 else 1e-5
+    np.testing.assert_allclose(out.vs, seq.vs, rtol=tol, atol=tol)
+    np.testing.assert_allclose(
+        out.pg_advantages, seq.pg_advantages, rtol=tol, atol=tol
+    )
+
+
+@pytest.mark.parametrize("impl", ["sequential", "associative", "pallas"])
+def test_bf16_inputs_upcast_to_documented_tolerance(impl):
+    """bf16-stored batch leaves reach V-trace half-width and are upcast
+    on entry (the f32-accumulate contract): every impl must land within
+    bf16's input-rounding tolerance (~2^-8 relative, documented in the
+    README precision table) of the all-f32 sequential solve — and all
+    impls must agree with each other far TIGHTER, since they share the
+    same upcast inputs."""
+    rng = np.random.default_rng(5)
+    inputs = _random_inputs(rng, (40, 4))
+    f32 = {k: jnp.asarray(v, jnp.float32) for k, v in inputs.items()}
+    b16 = {k: v.astype(jnp.bfloat16) for k, v in f32.items()}
+    ref = vtrace.from_importance_weights(**f32, scan_impl="sequential")
+    out = vtrace.from_importance_weights(**b16, scan_impl=impl)
+    assert out.vs.dtype == jnp.float32  # upcast-on-entry contract
+    np.testing.assert_allclose(out.vs, ref.vs, rtol=2e-2, atol=5e-2)
+    seq_b16 = vtrace.from_importance_weights(
+        **b16, scan_impl="sequential"
+    )
+    np.testing.assert_allclose(
+        out.vs, seq_b16.vs, rtol=1e-5, atol=1e-5
+    )
+
+
 def test_associative_scan_matches_sequential_long_t():
     """The log-depth associative solve must agree with the sequential
     scan well past the reference's unrolls (T=1024 — long-context

@@ -17,20 +17,19 @@ total_steps environment frames.
 """
 
 import time
-from typing import Any, Dict, NamedTuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
+from torchbeast_tpu import precision as precision_lib
 from torchbeast_tpu import telemetry
 
 from torchbeast_tpu.ops import (
-    compute_baseline_loss,
     compute_entropy_loss,
-    compute_policy_gradient_loss,
-    vtrace,
+    vtrace_policy_losses,
 )
 
 
@@ -55,9 +54,30 @@ class HParams(NamedTuple):
     total_steps: int = 100_000_000
     unroll_length: int = 80
     batch_size: int = 8
-    # "sequential" (lax.scan, right for T<=80) or "associative"
-    # (lax.associative_scan, O(log T) depth — long-unroll configs).
-    vtrace_impl: str = "sequential"
+    # V-trace backward recursion: "associative" (lax.associative_scan,
+    # O(log T) depth — the default; 2.56x at T=4000 and within noise at
+    # T=80, vtrace_scan_bench.md), "sequential" (lax.scan, the
+    # reference formulation), or "pallas" (the fused single-kernel
+    # variant — TPU-compiled, interpreted elsewhere).
+    vtrace_impl: str = "associative"
+    # RMSprop second-moment STORAGE dtype: "f32" or "bf16". The EMA is
+    # always accumulated in f32 (the precision module's f32-accumulate
+    # contract); bf16 halves the optimizer-state bytes each update
+    # reads and writes. Set by --precision bf16_train.
+    opt_state_dtype: str = "f32"
+    # Resident param dtype: "f32", or "bf16" (--precision bf16_train) —
+    # the params the forward/backward and the acting path read are
+    # bfloat16 (halving every weight read AND the gradient arrays the
+    # backward writes), while the optimizer state carries the float32
+    # MASTER copy that every update reads-modifies-writes in f32
+    # (learner._bf16_resident_params). Resident params are re-derived
+    # from the master each update: bf16 rounding never compounds.
+    param_dtype: str = "f32"
+    # Opt-in factored second moment (row/col EMAs for matrices — an
+    # Adafactor-style O(n+m) approximation of the O(nm) accumulator,
+    # with the torch denominator form): the aggressive optimizer-state
+    # compression lever beyond bf16 storage.
+    opt_factored: bool = False
 
 
 def updates_horizon(hp: HParams) -> int:
@@ -68,54 +88,262 @@ def updates_horizon(hp: HParams) -> int:
 
 
 def _scale_by_rms_torch(
-    decay: float, eps: float
+    decay: float, eps: float, state_dtype=None
 ) -> optax.GradientTransformation:
     """optax.scale_by_rms with TORCH denominator semantics:
     g / (sqrt(v) + eps), not g / sqrt(v + eps). Used on optax < 0.2.4,
     where rmsprop has no eps_in_sqrt knob (the two differ materially at
     this model's eps=0.01; see google-deepmind/optax#532). Pinned
     against torch.optim.RMSprop by test_rmsprop_matches_torch_semantics.
-    """
+
+    `state_dtype` (e.g. jnp.bfloat16) compacts the STORED second moment;
+    the EMA itself is accumulated in the gradient dtype (f32) every
+    update — decay*nu + (1-decay)*g^2 runs full-width, only the write
+    back to HBM narrows (the precision module's f32-accumulate
+    contract; parity-to-tolerance pinned by test)."""
 
     def init_fn(params):
         return optax.ScaleByRmsState(
-            nu=jax.tree_util.tree_map(jnp.zeros_like, params)
+            nu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, state_dtype or p.dtype),
+                params,
+            )
         )
 
     def update_fn(updates, state, params=None):
         del params
-        nu = jax.tree_util.tree_map(
-            lambda g, n: decay * n + (1.0 - decay) * jnp.square(g),
+        nu_f = jax.tree_util.tree_map(
+            lambda g, n: decay * n.astype(jnp.float32)
+            + (1.0 - decay) * jnp.square(g.astype(jnp.float32)),
             updates,
             state.nu,
         )
         updates = jax.tree_util.tree_map(
-            lambda g, n: g / (jnp.sqrt(n) + eps), updates, nu
+            lambda g, n: g.astype(jnp.float32) / (jnp.sqrt(n) + eps),
+            updates, nu_f,
+        )
+        nu = (
+            jax.tree_util.tree_map(
+                lambda n: n.astype(state_dtype), nu_f
+            )
+            if state_dtype is not None
+            else nu_f
         )
         return updates, optax.ScaleByRmsState(nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class _FactoredLeaf(NamedTuple):
+    """Per-leaf factored second moment: row/col EMAs for ndim>=2 leaves
+    (O(n+m) state), the full accumulator for vectors/scalars (tiny
+    anyway). Exactly one of (row, col) / nu is populated; the other side
+    carries zero-size placeholders so the pytree structure is uniform."""
+
+    row: jnp.ndarray
+    col: jnp.ndarray
+    nu: jnp.ndarray
+
+
+class FactoredRmsState(NamedTuple):
+    leaves: Tuple[_FactoredLeaf, ...]
+
+
+def _scale_by_factored_rms_torch(
+    decay: float, eps: float
+) -> optax.GradientTransformation:
+    """Factored torch-denominator RMS scaling (opt-in via
+    HParams.opt_factored): matrices keep row- and column-mean EMAs of
+    g^2 instead of the full elementwise accumulator — state shrinks
+    from O(n*m) to O(n+m) — and the denominator uses the rank-1
+    reconstruction v_hat = (r x c) / mean(r) (Adafactor's estimator,
+    arXiv:1804.04235) inside the same g / (sqrt(v) + eps) form. NOT
+    torch-parity (it is an approximation by construction); vectors and
+    scalars keep the exact accumulator."""
+
+    def _factored(shape) -> bool:
+        return len(shape) >= 2
+
+    def init_fn(params):
+        leaves = []
+        for p in jax.tree_util.tree_leaves(params):
+            if _factored(p.shape):
+                leaves.append(_FactoredLeaf(
+                    row=jnp.zeros(p.shape[:-1], jnp.float32),
+                    col=jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                  jnp.float32),
+                    nu=jnp.zeros((0,), jnp.float32),
+                ))
+            else:
+                leaves.append(_FactoredLeaf(
+                    row=jnp.zeros((0,), jnp.float32),
+                    col=jnp.zeros((0,), jnp.float32),
+                    nu=jnp.zeros(p.shape, jnp.float32),
+                ))
+        return FactoredRmsState(leaves=tuple(leaves))
+
+    def update_fn(updates, state, params=None):
+        del params
+        flat, treedef = jax.tree_util.tree_flatten(updates)
+        new_leaves = []
+        new_flat = []
+        for g, s in zip(flat, state.leaves):
+            g2 = jnp.square(g.astype(jnp.float32))
+            if _factored(g.shape):
+                row = decay * s.row + (1.0 - decay) * g2.mean(axis=-1)
+                col = decay * s.col + (1.0 - decay) * g2.mean(axis=-2)
+                # Rank-1 reconstruction; mean(row) == mean(col) == the
+                # EMA of mean(g^2), so the estimator is exact for
+                # rank-1 g^2 and an upper-biased smooth estimate
+                # otherwise.
+                scale = jnp.maximum(
+                    row.mean(axis=-1, keepdims=True), 1e-30
+                )
+                v_hat = (
+                    (row / scale)[..., None] * col[..., None, :]
+                )
+                new_flat.append(
+                    (g / (jnp.sqrt(v_hat) + eps)).astype(g.dtype)
+                )
+                new_leaves.append(_FactoredLeaf(row=row, col=col,
+                                                nu=s.nu))
+            else:
+                nu = decay * s.nu + (1.0 - decay) * g2
+                new_flat.append(
+                    (g / (jnp.sqrt(nu) + eps)).astype(g.dtype)
+                )
+                new_leaves.append(_FactoredLeaf(row=s.row, col=s.col,
+                                                nu=nu))
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_flat),
+            FactoredRmsState(leaves=tuple(new_leaves)),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _clip_by_global_norm_f32(
+    max_norm: float,
+) -> optax.GradientTransformation:
+    """optax.clip_by_global_norm with the norm ACCUMULATED in float32
+    and float32 outputs — the bf16-resident-grads path. The stock
+    transform would sum squared bf16 values in bf16 (an f32-accumulate
+    violation); here each grad leaf is read half-width and widened in
+    registers before the reduction. The f32 policy keeps the stock
+    transform (identical-by-construction there, so the torch-parity
+    pins never depend on this code)."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        updates = jax.tree_util.tree_map(
+            lambda t: t.astype(jnp.float32), updates
+        )
+        g_norm = optax.global_norm(updates)
+        trigger = jnp.squeeze(g_norm < max_norm)
+
+        def clip_fn(t):
+            return jax.lax.select(trigger, t, (t / g_norm) * max_norm)
+
+        return jax.tree_util.tree_map(clip_fn, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class MasterParamsState(NamedTuple):
+    """Optimizer state for bf16-resident training: the float32 MASTER
+    copy of the params plus the wrapped transform's own state."""
+
+    master: Any
+    inner: Any
+
+
+def _bf16_resident_params(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """bf16-resident params with an f32 master (--precision bf16_train).
+
+    The params the update step (and the acting path) carries are
+    bfloat16 — every forward/backward weight read is half-width and the
+    backward emits bf16 gradient arrays. The float32 master lives in
+    the optimizer state: each update upcasts nothing wholesale (the
+    inner transform reads the bf16 grads and accumulates in f32 — see
+    _scale_by_rms_torch), applies the f32 update to the MASTER, and
+    emits the delta that rebases the resident bf16 params onto the new
+    master. Because the master never sees bf16 rounding, the resident
+    params are always bf16(master) to f32-addition precision — rounding
+    cannot compound across updates.
+
+    NOT a drop-in optax transform: its `update` returns the NEW MASTER
+    as the updates value (computing a params-dtype delta for the stock
+    optax.apply_updates would round-trip every leaf through two extra
+    converts and a subtract for nothing). Apply with
+    learner.apply_updates — the dispatch helper update_body uses —
+    which turns the master into resident params with ONE narrowing cast
+    per leaf. The inner transform conditions on the MASTER (torch-
+    RMSprop only reads params for structure, but momentum/weight-decay
+    style transforms need the f32 view).
+    """
+
+    def init_fn(params):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        return MasterParamsState(master=master, inner=inner.init(master))
+
+    def update_fn(updates, state, params=None):
+        del params
+        inner_updates, inner_state = inner.update(
+            updates, state.inner, state.master
+        )
+        new_master = optax.apply_updates(state.master, inner_updates)
+        return new_master, MasterParamsState(master=new_master,
+                                             inner=inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def apply_updates(params, updates, opt_state):
+    """optax.apply_updates, resident-aware: when the optimizer is the
+    bf16-resident wrapper (its state is a MasterParamsState), `updates`
+    IS the new f32 master and the resident params are one narrowing
+    cast per leaf; otherwise the stock optax apply."""
+    if isinstance(opt_state, MasterParamsState):
+        return jax.tree_util.tree_map(
+            lambda nm, p: nm.astype(p.dtype), updates, params
+        )
+    return optax.apply_updates(params, updates)
+
+
 def _rmsprop_torch(
-    learning_rate, decay: float, eps: float, momentum
+    learning_rate, decay: float, eps: float, momentum,
+    state_dtype=None, factored: bool = False,
 ) -> optax.GradientTransformation:
     """torch.optim.RMSprop as an optax chain. Prefers the upstream
     rmsprop(eps_in_sqrt=False) (optax >= 0.2.4); otherwise composes the
     identical transform from primitives that exist on 0.2.3: torch-
     denominator RMS scaling, then momentum as a plain accumulator trace
-    (torch: buf = m*buf + update; param -= lr*buf), then LR."""
-    try:
-        return optax.rmsprop(
-            learning_rate=learning_rate,
-            decay=decay,
-            eps=eps,
-            eps_in_sqrt=False,
-            momentum=momentum or None,
-        )
-    except TypeError:
-        pass
-    parts = [_scale_by_rms_torch(decay, eps)]
+    (torch: buf = m*buf + update; param -= lr*buf), then LR. Compact
+    state (`state_dtype`/`factored`) always takes the composed path —
+    upstream rmsprop has no storage-dtype knob."""
+    if state_dtype is None and not factored:
+        try:
+            return optax.rmsprop(
+                learning_rate=learning_rate,
+                decay=decay,
+                eps=eps,
+                eps_in_sqrt=False,
+                momentum=momentum or None,
+            )
+        except TypeError:
+            pass
+    if factored:
+        parts = [_scale_by_factored_rms_torch(decay, eps)]
+    else:
+        parts = [_scale_by_rms_torch(decay, eps, state_dtype)]
     if momentum:
         parts.append(optax.trace(decay=momentum, nesterov=False))
     parts.append(optax.scale_by_learning_rate(learning_rate))
@@ -129,21 +357,48 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
     that on every installed optax. The LR decays linearly to 0 over
     total_steps env frames; each optimizer step consumes T*B frames (the
     reference's LambdaLR closure, monobeast.py:395-398).
+
+    Optimizer-state compaction (the HBM-roofline levers): hp.
+    opt_state_dtype="bf16" stores the second moment half-width (f32
+    accumulate, torch-parity to bf16 rounding), hp.opt_factored swaps in
+    row/col factored EMAs (an approximation — opt-in).
     """
+    if hp.opt_state_dtype not in ("f32", "bf16"):
+        raise ValueError(
+            f"opt_state_dtype must be 'f32' or 'bf16', got "
+            f"{hp.opt_state_dtype!r}"
+        )
+    if hp.param_dtype not in ("f32", "bf16"):
+        raise ValueError(
+            f"param_dtype must be 'f32' or 'bf16', got "
+            f"{hp.param_dtype!r}"
+        )
     schedule = optax.linear_schedule(
         init_value=hp.learning_rate,
         end_value=0.0,
         transition_steps=updates_horizon(hp),
     )
-    return optax.chain(
-        optax.clip_by_global_norm(hp.grad_norm_clipping),
+    clip = (
+        _clip_by_global_norm_f32(hp.grad_norm_clipping)
+        if hp.param_dtype == "bf16"
+        else optax.clip_by_global_norm(hp.grad_norm_clipping)
+    )
+    chain = optax.chain(
+        clip,
         _rmsprop_torch(
             learning_rate=schedule,
             decay=hp.rmsprop_alpha,
             eps=hp.rmsprop_eps,
             momentum=hp.rmsprop_momentum,
+            state_dtype=(
+                jnp.bfloat16 if hp.opt_state_dtype == "bf16" else None
+            ),
+            factored=hp.opt_factored,
         ),
     )
+    if hp.param_dtype == "bf16":
+        chain = _bf16_resident_params(chain)
+    return chain
 
 
 def compute_loss(
@@ -155,6 +410,19 @@ def compute_loss(
     Models may `sow` regularization terms into the `losses` collection
     (e.g. the MoE load-balance loss, models/moe.py); every sown value is
     added to the objective. Models that sow nothing pay nothing.
+
+    Precision contract (torchbeast_tpu/precision.py): the staged batch's
+    float leaves may arrive bfloat16 (--precision bf16_train); every
+    loss-side use upcasts to f32 at point of use — XLA reads the
+    half-width array from HBM and widens in registers — and V-trace +
+    the three losses accumulate in f32. Model outputs (logits/baseline)
+    are f32 by the model head's own boundary contract.
+
+    The V-trace targets and pg/baseline losses run FUSED
+    (ops.vtrace_policy_losses, identical math to the composed
+    from_logits + loss calls, pinned by test): one action_log_probs
+    evaluation serves the importance weights and the pg cross-entropy,
+    and the advantages are consumed by their reductions in place.
     """
     (learner_outputs, _), variables = model.apply(
         params,
@@ -171,19 +439,20 @@ def compute_loss(
     bootstrap_value = learner_outputs.baseline[-1]
 
     # Shift: env/behavior fields drop slot 0, learner outputs drop slot T
-    # (reference monobeast.py:244-245).
+    # (reference monobeast.py:244-245). f32 upcasts at point of use (see
+    # docstring); int/bool leaves have no storage-dtype policy.
     target_logits = learner_outputs.policy_logits[:-1]
     values = learner_outputs.baseline[:-1]
-    behavior_logits = batch["policy_logits"][1:]
+    behavior_logits = batch["policy_logits"][1:].astype(jnp.float32)
     actions = batch["action"][1:]
-    rewards = batch["reward"][1:]
+    rewards = batch["reward"][1:].astype(jnp.float32)
     done = batch["done"][1:]
 
     if hp.reward_clipping == "abs_one":
         rewards = jnp.clip(rewards, -1.0, 1.0)
     discounts = (~done).astype(jnp.float32) * hp.discounting
 
-    vtrace_returns = vtrace.from_logits(
+    pg_loss, baseline_loss = vtrace_policy_losses(
         behavior_policy_logits=behavior_logits,
         target_policy_logits=target_logits,
         actions=actions,
@@ -193,13 +462,7 @@ def compute_loss(
         bootstrap_value=bootstrap_value,
         scan_impl=hp.vtrace_impl,
     )
-
-    pg_loss = compute_policy_gradient_loss(
-        target_logits, actions, vtrace_returns.pg_advantages
-    )
-    baseline_loss = hp.baseline_cost * compute_baseline_loss(
-        vtrace_returns.vs - values
-    )
+    baseline_loss = hp.baseline_cost * baseline_loss
     # entropy_cost may be a traced scalar (the annealed schedule from
     # make_update_step); None = the constant from hp.
     if entropy_cost is None:
@@ -210,7 +473,11 @@ def compute_loss(
     # Episode stats: fixed-shape aggregates (a boolean-mask gather would be
     # dynamic-shaped and unjittable); the host divides sum by count.
     episode_returns_sum = jnp.sum(
-        jnp.where(done, batch["episode_return"][1:], 0.0)
+        jnp.where(
+            done,
+            batch["episode_return"][1:].astype(jnp.float32),
+            0.0,
+        )
     )
     episode_count = jnp.sum(done)
 
@@ -310,10 +577,22 @@ def update_body(model, optimizer: optax.GradientTransformation, hp: HParams):
             has_aux=True,
         )
         grads, stats = grad_fn(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        stats["grad_norm"] = optax.global_norm(grads)
-        return params, opt_state, stats
+        updates, new_opt_state = optimizer.update(
+            grads, opt_state, params
+        )
+        # Resident-aware apply (module-level apply_updates): the
+        # bf16-resident optimizer hands back the new f32 master and the
+        # resident params are one narrowing cast; every other optimizer
+        # takes the stock optax apply.
+        params = apply_updates(params, updates, new_opt_state)
+        # f32 upcast before the norm reduction (no-op for f32 grads;
+        # bf16-resident runs emit bf16 grad arrays).
+        stats["grad_norm"] = optax.global_norm(
+            jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads
+            )
+        )
+        return params, new_opt_state, stats
 
     return update_step
 
@@ -407,6 +686,11 @@ def consume_staged_inputs(update_fn):
                 leaf.delete()
         return out
 
+    # AOT surface passthrough: the bytes-accessed accounting
+    # (instrument_update_step's learner.hbm_bytes_per_update gauge,
+    # precision.bytes_accessed) lowers the jitted inner step from
+    # ShapeDtypeStructs — the wrapper must not hide it.
+    wrapped.lower = getattr(update_fn, "lower", None)
     return wrapped
 
 
@@ -486,7 +770,15 @@ def instrument_update_step(update_step, registry=None, superstep_k=1):
       flush happens in the driver, so the wrapper exposes it as
       `wrapped.count_host_sync()` — drivers call it per stats fetch
       (once per K updates under supersteps, the K-fold reduction the
-      learner_bench acceptance pins).
+      learner_bench acceptance pins);
+    - learner.hbm_bytes_per_update (gauge): XLA's bytes-accessed figure
+      for ONE update, from the lowered HLO of the first dispatched
+      signature (precision.bytes_accessed — the dtype-faithful
+      accounting the --precision policies move; the lowered HLO counts
+      a superstep's scan body ONCE, so the figure is per-update at any
+      K). Computed once on a daemon thread at the first dispatch
+      (lowering is compile-free but traces the net), and only when the
+      inner jitted step is reachable (.lower).
 
     Signature-transparent: drivers swap `update_step =
     instrument_update_step(update_step, superstep_k=k)` and nothing
@@ -499,6 +791,8 @@ def instrument_update_step(update_step, registry=None, superstep_k=1):
     c_updates = reg.counter("learner.updates")
     c_host_syncs = reg.counter("learner.host_syncs")
     reg.gauge("learner.superstep_k").set(superstep_k)
+    g_hbm = reg.gauge("learner.hbm_bytes_per_update")
+    hbm_pending = [getattr(update_step, "lower", None) is not None]
 
     def wrapped(params, opt_state, batch, initial_agent_state):
         nbytes = sum(
@@ -507,6 +801,15 @@ def instrument_update_step(update_step, registry=None, superstep_k=1):
                 (batch, initial_agent_state)
             )
         )
+        if hbm_pending[0]:
+            # Single-consumer hot path (the learner thread): the flag
+            # flip is ordinary sequential code, no lock needed.
+            hbm_pending[0] = False
+            precision_lib.hbm_gauge_async(
+                update_step,
+                (params, opt_state, batch, initial_agent_state),
+                g_hbm,
+            )
         t0 = time.perf_counter()
         out = update_step(params, opt_state, batch, initial_agent_state)
         h_dispatch.observe(time.perf_counter() - t0)

@@ -27,6 +27,9 @@ class AtariNet(nn.Module):
     num_actions: int
     use_lstm: bool = False
     dtype: Any = jnp.float32
+    # Recurrent-core + policy-head compute dtype (--precision
+    # bf16_train sets bfloat16; outputs upcast at the head boundary).
+    head_dtype: Any = jnp.float32
 
     @property
     def core_output_size(self) -> int:
@@ -49,14 +52,17 @@ class AtariNet(nn.Module):
         x = nn.relu(conv(64, 3, 1)(x))
         x = x.reshape((T * B, -1))  # 7*7*64 = 3136 for 84x84 input
         x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
-        x = x.astype(jnp.float32)
+        # Trunk -> head boundary in the head's dtype (old behavior =
+        # astype(float32); bf16_train keeps the activation half-width).
+        x = x.astype(self.head_dtype)
 
         one_hot_last_action = jax.nn.one_hot(
-            inputs["last_action"].reshape(T * B), self.num_actions
+            inputs["last_action"].reshape(T * B), self.num_actions,
+            dtype=self.head_dtype,
         )
         clipped_reward = jnp.clip(
             inputs["reward"].astype(jnp.float32), -1, 1
-        ).reshape(T * B, 1)
+        ).reshape(T * B, 1).astype(self.head_dtype)
         core_input = jnp.concatenate(
             [x, clipped_reward, one_hot_last_action], axis=-1
         )
@@ -66,6 +72,7 @@ class AtariNet(nn.Module):
             use_lstm=self.use_lstm,
             hidden_size=self.core_output_size,
             num_layers=2,
+            dtype=self.head_dtype,
             name="head",
         )(core_input, inputs["done"], core_state, T, B, sample_action)
 

@@ -10,7 +10,7 @@ Core state layout matches the reference: a tuple `(h, c)`, each
 `[num_layers, B, hidden_size]` (torch nn.LSTM convention, monobeast.py:574-580).
 """
 
-from typing import Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax
@@ -24,6 +24,7 @@ class _StackedLSTMStep(nn.Module):
 
     hidden_size: int
     num_layers: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, carry, xs):
@@ -39,7 +40,7 @@ class _StackedLSTMStep(nn.Module):
         y = inp
         for layer in range(self.num_layers):
             (c_l, h_l), y = nn.OptimizedLSTMCell(
-                self.hidden_size, name=f"layer_{layer}"
+                self.hidden_size, dtype=self.dtype, name=f"layer_{layer}"
             )((c[layer], h[layer]), y)
             new_h.append(h_l)
             new_c.append(c_l)
@@ -51,10 +52,18 @@ class LSTMCore(nn.Module):
 
     __call__(core_input [T,B,D], notdone [T,B], core_state (h,c)) ->
         (core_output [T,B,H], new_core_state)
+
+    `dtype` is the COMPUTE/activation dtype (--precision bf16_train runs
+    the cell in bf16 — the T-step scan's carried state and saved
+    activations are then half-width in HBM); params stay float32 (flax
+    casts at use) and the returned core_state is upcast back to f32 at
+    the module boundary, so the slot-table/wire/checkpoint state schema
+    never changes.
     """
 
     hidden_size: int
     num_layers: int = 1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, core_input, notdone, core_state):
@@ -64,8 +73,20 @@ class LSTMCore(nn.Module):
             split_rngs={"params": False},
             in_axes=0,
             out_axes=0,
-        )(self.hidden_size, self.num_layers)
-        core_state, core_output = scan(core_state, (core_input, notdone))
+        )(self.hidden_size, self.num_layers, self.dtype)
+        # Cast the whole carry to the compute dtype so the scanned
+        # carry's input/output types agree (a mixed-dtype carry is a
+        # lax.scan type error, not a silent promotion).
+        core_state = jax.tree_util.tree_map(
+            lambda s: s.astype(self.dtype), core_state
+        )
+        core_state, core_output = scan(
+            core_state,
+            (core_input.astype(self.dtype), notdone.astype(self.dtype)),
+        )
+        core_state = jax.tree_util.tree_map(
+            lambda s: s.astype(jnp.float32), core_state
+        )
         return core_output, core_state
 
     def initial_state(self, batch_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -93,21 +114,31 @@ class RecurrentPolicyHead(nn.Module):
     polybeast_learner.py:235-264). Takes flattened `[T*B, D]` core inputs
     plus the `[T, B]` done mask, returns (AgentOutput, new_core_state) with
     `[T, B, ...]` outputs.
+
+    `dtype` is the head's compute/activation dtype (--precision
+    bf16_train extends bf16 past the trunk through the LSTM core and the
+    policy/baseline projections). The OUTPUT boundary is always float32:
+    logits and baseline upcast before sampling/return, so the loss side
+    (f32-accumulate, torchbeast_tpu/precision.py), the wire schema, and
+    action sampling see identical dtypes under every policy.
     """
 
     num_actions: int
     use_lstm: bool
     hidden_size: int
     num_layers: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, core_input, done, core_state, T, B, sample_action):
+        core_input = core_input.astype(self.dtype)
         if self.use_lstm:
             core_input = core_input.reshape(T, B, -1)
             notdone = 1.0 - done.astype(jnp.float32)
             core_output, core_state = LSTMCore(
                 hidden_size=self.hidden_size,
                 num_layers=self.num_layers,
+                dtype=self.dtype,
                 name="core",
             )(core_input, notdone, core_state)
             core_output = core_output.reshape(T * B, -1)
@@ -115,8 +146,12 @@ class RecurrentPolicyHead(nn.Module):
             core_output = core_input
             core_state = ()
 
-        policy_logits = nn.Dense(self.num_actions, name="policy")(core_output)
-        baseline = nn.Dense(1, name="baseline")(core_output)
+        policy_logits = nn.Dense(
+            self.num_actions, dtype=self.dtype, name="policy"
+        )(core_output).astype(jnp.float32)
+        baseline = nn.Dense(
+            1, dtype=self.dtype, name="baseline"
+        )(core_output).astype(jnp.float32)
 
         if sample_action:
             action = jax.random.categorical(

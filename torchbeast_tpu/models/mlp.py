@@ -18,6 +18,9 @@ class MLPNet(nn.Module):
     use_lstm: bool = False
     hidden_sizes: Sequence[int] = (128, 128)
     dtype: Any = jnp.float32
+    # Recurrent-core + policy-head compute dtype (--precision
+    # bf16_train sets bfloat16; outputs upcast at the head boundary).
+    head_dtype: Any = jnp.float32
 
     @property
     def core_size(self) -> int:
@@ -30,14 +33,19 @@ class MLPNet(nn.Module):
         x = frame.reshape((T * B, -1)).astype(self.dtype) / 255.0
         for size in self.hidden_sizes:
             x = nn.relu(nn.Dense(size, dtype=self.dtype)(x))
-        x = x.astype(jnp.float32)
+        # Trunk -> head boundary in the HEAD's dtype: under bf16_train
+        # the [T*B, D] activation (and its backward cotangent) never
+        # round-trips through f32; under the f32/bf16_compute policies
+        # this is exactly the old astype(float32) boundary.
+        x = x.astype(self.head_dtype)
 
         one_hot_last_action = jax.nn.one_hot(
-            inputs["last_action"].reshape(T * B), self.num_actions
+            inputs["last_action"].reshape(T * B), self.num_actions,
+            dtype=self.head_dtype,
         )
         clipped_reward = jnp.clip(
             inputs["reward"].astype(jnp.float32), -1, 1
-        ).reshape(T * B, 1)
+        ).reshape(T * B, 1).astype(self.head_dtype)
         core_input = jnp.concatenate(
             [x, clipped_reward, one_hot_last_action], axis=-1
         )
@@ -47,6 +55,7 @@ class MLPNet(nn.Module):
             use_lstm=self.use_lstm,
             hidden_size=self.core_size,
             num_layers=1,
+            dtype=self.head_dtype,
             name="head",
         )(core_input, inputs["done"], core_state, T, B, sample_action)
 

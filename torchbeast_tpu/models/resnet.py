@@ -22,6 +22,9 @@ class ResNetBase(nn.Module):
 
     channels: Sequence[int] = (16, 32, 32)
     dtype: Any = jnp.float32
+    # Dtype of the returned features — the trunk -> head boundary
+    # (f32 default; the head's dtype under --precision bf16_train).
+    out_dtype: Any = jnp.float32
     # Per-stage rematerialization: one value for all stages or a tuple of
     # per-stage values, each False (save everything), True (remat the whole
     # stage), or "front" (remat only the conv+pool front — drops the
@@ -104,13 +107,17 @@ class ResNetBase(nn.Module):
         x = nn.relu(x)
         x = x.reshape((T * B, -1))  # 11*11*32 = 3872 for 84x84 input
         x = nn.relu(nn.Dense(256, dtype=self.dtype, name="fc")(x))
-        return x.astype(jnp.float32)
+        return x.astype(self.out_dtype)
 
 
 class ResNet(nn.Module):
     num_actions: int
     use_lstm: bool = False
     dtype: Any = jnp.float32
+    # Recurrent-core + policy-head compute dtype (--precision
+    # bf16_train sets bfloat16: activations stay half-width past the
+    # trunk; logits/baseline/state upcast at the head boundary).
+    head_dtype: Any = jnp.float32
     remat: Any = True  # bool or per-stage tuple, see ResNetBase.remat
 
     hidden_size: int = 256
@@ -129,12 +136,13 @@ class ResNet(nn.Module):
 
         x = ResNetBase(
             channels=tuple(self.trunk_channels),
-            dtype=self.dtype, remat=self.remat, name="trunk"
+            dtype=self.dtype, out_dtype=self.head_dtype,
+            remat=self.remat, name="trunk"
         )(frame)
 
         clipped_reward = jnp.clip(
             inputs["reward"].astype(jnp.float32), -1, 1
-        ).reshape(T * B, 1)
+        ).reshape(T * B, 1).astype(self.head_dtype)
         core_input = jnp.concatenate([x, clipped_reward], axis=-1)
 
         return RecurrentPolicyHead(
@@ -142,6 +150,7 @@ class ResNet(nn.Module):
             use_lstm=self.use_lstm,
             hidden_size=self.hidden_size,
             num_layers=1,
+            dtype=self.head_dtype,
             name="head",
         )(core_input, inputs["done"], core_state, T, B, sample_action)
 

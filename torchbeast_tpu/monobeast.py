@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu import precision as precision_lib
 from torchbeast_tpu import telemetry
 from torchbeast_tpu.envs import create_env
 from torchbeast_tpu.envs.vec import ProcessEnvPool, SerialEnvPool
@@ -72,12 +73,15 @@ def make_parser():
                         help="Total environment frames to train for.")
     parser.add_argument("--batch_size", type=int, default=8,
                         help="Learner batch size.")
-    parser.add_argument("--vtrace_impl", default="sequential",
-                        choices=["sequential", "associative"],
-                        help="V-trace backward recursion: lax.scan "
-                             "(T dependent steps, right for T<=80) or "
-                             "lax.associative_scan (O(log T) depth - "
-                             "the long-unroll/long-context choice).")
+    parser.add_argument("--vtrace_impl", default="associative",
+                        choices=["sequential", "associative", "pallas"],
+                        help="V-trace backward recursion: "
+                             "lax.associative_scan (O(log T) depth, the "
+                             "default), lax.scan (the reference's "
+                             "T-dependent-steps formulation), or the "
+                             "fused Pallas kernel (vs + advantages in "
+                             "one VMEM pass; TPU-compiled, interpreted "
+                             "elsewhere).")
     parser.add_argument("--unroll_length", type=int, default=80,
                         help="The unroll length (time dimension).")
     parser.add_argument("--model", default="shallow",
@@ -86,10 +90,29 @@ def make_parser():
                              "mlp for tiny frames).")
     parser.add_argument("--use_lstm", action="store_true",
                         help="Use LSTM in the agent model.")
-    parser.add_argument("--model_dtype", default="float32",
+    parser.add_argument("--precision", default="f32",
+                        choices=["f32", "bf16_compute", "bf16_train"],
+                        help="Precision policy (torchbeast_tpu/"
+                             "precision.py): f32 everywhere; "
+                             "bf16_compute flips trunk compute to "
+                             "bfloat16; bf16_train additionally makes "
+                             "params/activations bf16-RESIDENT (f32 "
+                             "master in the optimizer state, f32 "
+                             "accumulate), stages the batch's float "
+                             "leaves as bf16, and stores the RMSprop "
+                             "second moment bf16 — the HBM-roofline "
+                             "policy.")
+    parser.add_argument("--model_dtype", default=None,
                         choices=["float32", "bfloat16"],
-                        help="Conv/fc trunk compute dtype (bfloat16 rides "
-                             "the MXU; params and losses stay float32).")
+                        help="DEPRECATED alias: bfloat16 maps to "
+                             "--precision bf16_compute (with a "
+                             "warning); conflicts with an explicit "
+                             "bf16_train.")
+    parser.add_argument("--factored_opt_state", action="store_true",
+                        help="Opt-in factored RMSprop second moment "
+                             "(row/col EMAs for matrices, Adafactor-"
+                             "style O(n+m) state; an approximation — "
+                             "not torch-parity).")
     parser.add_argument("--trunk_channels", default="",
                         help="Opt-in deep-trunk widths as a comma list "
                              "(e.g. 32,64,64). Default: the reference's "
@@ -257,6 +280,7 @@ def make_parser():
 
 
 def hparams_from_flags(flags) -> learner_lib.HParams:
+    policy = precision_lib.resolve_flags(flags)
     return learner_lib.HParams(
         discounting=flags.discounting,
         baseline_cost=flags.baseline_cost,
@@ -271,7 +295,10 @@ def hparams_from_flags(flags) -> learner_lib.HParams:
         total_steps=flags.total_steps,
         unroll_length=flags.unroll_length,
         batch_size=flags.batch_size,
-        vtrace_impl=getattr(flags, "vtrace_impl", "sequential"),
+        vtrace_impl=getattr(flags, "vtrace_impl", "associative"),
+        opt_state_dtype=policy.opt_state_dtype,
+        param_dtype=policy.param_dtype,
+        opt_factored=getattr(flags, "factored_opt_state", False),
     )
 
 
@@ -356,12 +383,23 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
     """
     import jax.numpy as jnp
 
-    dtype = (
-        jnp.bfloat16
-        if getattr(flags, "model_dtype", "float32") == "bfloat16"
-        else jnp.float32
-    )
+    policy = precision_lib.resolve_flags(flags)
+    dtype = policy.compute_dtype
     extra = {}
+    # Families whose recurrent-core/policy-head threads a compute dtype
+    # (models/cores.RecurrentPolicyHead). bf16_train on the others
+    # (transformer/pipelined) still gets bf16 trunk compute + batch/
+    # optimizer compaction; the head simply stays f32.
+    _HEAD_DTYPE_MODELS = ("shallow", "atari", "deep", "resnet", "mlp")
+    if policy.head_dtype != jnp.float32:
+        if flags.model in _HEAD_DTYPE_MODELS:
+            extra["head_dtype"] = policy.head_dtype
+        else:
+            logging.getLogger(__name__).info(
+                "--precision %s: model %s has no bf16 head path; the "
+                "recurrent core / policy head stays f32",
+                policy.name, flags.model,
+            )
     attention_impl = getattr(flags, "attention_impl", "dense")
     if attention_impl != "dense":
         if flags.model != "transformer":
@@ -643,6 +681,12 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
         dummy,
         state,
     )
+    # bf16_train: params are bf16-RESIDENT from here on — every
+    # consumer (acting, learner, checkpoint templates) sees bf16; the
+    # f32 master materializes inside optimizer.init (learner.
+    # _bf16_resident_params). Cross-precision checkpoint resume fails
+    # loudly at the template match, by design.
+    params = precision_lib.cast_params(params, policy)
     return model, params
 
 
@@ -714,6 +758,7 @@ def train(flags):
     )
 
     hp = hparams_from_flags(flags)
+    prec = precision_lib.resolve_flags(flags)
     num_actions, frame_shape, frame_dtype = _probe_env(flags)
     B = flags.num_actors
     T = flags.unroll_length
@@ -763,7 +808,10 @@ def train(flags):
             superstep_k=K, donate_batch=K > 1,
         )
         place_sub = lambda b, s: shard_batch(  # noqa: E731
-            mesh, b, s, leading_axes=1 if K > 1 else 0
+            mesh,
+            precision_lib.cast_batch(b, prec.batch_dtype),
+            precision_lib.cast_batch(s, prec.batch_dtype),
+            leading_axes=1 if K > 1 else 0,
         )
         log.info("Sync learner data-parallel over %d devices", n_dev)
     else:
@@ -784,9 +832,13 @@ def train(flags):
         # Explicit (async) placement: donation needs committed device
         # buffers — a host-numpy arg reaches the jit as an undonatable
         # transfer (and a warning); device_put also starts the H2D copy
-        # before dispatch instead of inside it.
+        # before dispatch instead of inside it. The precision policy's
+        # staging cast happens here (bf16_train: float32 leaves travel
+        # host->device half-width; the learner upcasts at point of
+        # use).
         place_sub = lambda b, s: (  # noqa: E731
-            jax.device_put(b), jax.device_put(s)
+            jax.device_put(precision_lib.cast_batch(b, prec.batch_dtype)),
+            jax.device_put(precision_lib.cast_batch(s, prec.batch_dtype)),
         )
     if telemetry_on:
         # Dispatch latency + batch transfer bytes per update (counts K

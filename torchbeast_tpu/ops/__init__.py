@@ -3,4 +3,5 @@ from torchbeast_tpu.ops.losses import (  # noqa: F401
     compute_baseline_loss,
     compute_entropy_loss,
     compute_policy_gradient_loss,
+    vtrace_policy_losses,
 )

@@ -1,0 +1,128 @@
+"""Fused Pallas kernel for the V-trace backward recursion + advantages.
+
+The pure-XLA paths (ops/vtrace.py) express the solve as lax.scan /
+lax.associative_scan and let the compiler fuse; this kernel goes one
+step further and computes BOTH outputs of the target computation —
+vs and the policy-gradient advantages — in ONE pass over the unroll,
+so the intermediate accumulator never exists outside VMEM and the
+advantage epilogue re-reads nothing from HBM:
+
+    acc_t   = delta_t + a_t * acc_{t+1}          (a_t = discount_t c_t)
+    vs_t    = acc_t + V_t
+    pgadv_t = pgrho_t * (r_t + discount_t * vs_{t+1} - V_t)
+
+vs_{t+1} is the PREVIOUS loop iteration's vs (the loop runs reverse),
+so the whole thing is one reverse fori_loop with a two-array carry.
+
+Layout: time rides the sublane axis, every trailing (batch) dim is
+flattened onto lanes — [T, B] blocks live whole in VMEM (T=4000, B=128
+f32 is 2 MiB/input; the learner's T<=80 shapes are trivial). Compiled
+on TPU; `interpret=True` (the automatic off-TPU fallback) runs the same
+kernel under the Pallas interpreter, which is how CPU CI pins numerics.
+
+Gradient story: callers stop_gradient both outputs (the V-trace
+contract, ops/vtrace.py), so the kernel needs no VJP.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _kernel(a_ref, b_ref, pgrho_ref, rew_ref, disc_ref, val_ref,
+            boot_ref, vs_ref, pg_ref, acc_ref, tp1_ref, *, T):
+    """One whole-[T, B] block; see module docstring for the recurrence.
+
+    The reverse loop's carry (the accumulator and vs_{t+1}) lives in
+    VMEM scratch refs, not fori_loop carry values — Mosaic in this jax
+    version rejects a loop that both carries values and writes refs
+    (JaxprInputEffect mismatch); a scalar-carry loop over scratch is
+    the supported formulation.
+    """
+
+    from jax.experimental import pallas as pl
+
+    acc_ref[:] = jnp.zeros_like(boot_ref[:])
+    tp1_ref[:] = boot_ref[:]
+
+    def body(i, carry):
+        t = T - 1 - i
+        idx = (pl.ds(t, 1), slice(None))
+        v_t = val_ref[idx]
+        acc = b_ref[idx] + a_ref[idx] * acc_ref[:]
+        vs_t = acc + v_t
+        pg_ref[idx] = pgrho_ref[idx] * (
+            rew_ref[idx] + disc_ref[idx] * tp1_ref[:] - v_t
+        )
+        vs_ref[idx] = vs_t
+        acc_ref[:] = acc
+        tp1_ref[:] = vs_t
+        return carry
+
+    lax.fori_loop(0, T, body, 0)
+
+
+def _targets_impl(a, deltas, clipped_pg_rhos, rewards, discounts,
+                  values, boot, *, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T = a.shape[0]
+    B = boot.shape[1]
+    return pl.pallas_call(
+        functools.partial(_kernel, T=T),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, B), jnp.float32),
+            jax.ShapeDtypeStruct((T, B), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1, B), jnp.float32),
+            pltpu.VMEM((1, B), jnp.float32),
+        ],
+        interpret=interpret,
+    )(a, deltas, clipped_pg_rhos, rewards, discounts, values, boot)
+
+
+@functools.lru_cache(maxsize=2)
+def _targets_fn(interpret: bool):
+    """custom_vjp wrapper so the kernel composes with jax.grad of the
+    surrounding loss: Pallas calls with scratch refs have no JVP rule
+    in this jax version, and V-trace's contract is no-grad anyway (the
+    reference wraps the whole computation in torch.no_grad; both
+    callers stop_gradient the outputs). The declared backward is
+    therefore ZERO for every input — correct for the stop-gradient
+    contract, and the reason this kernel must only ever be reached
+    through ops.vtrace/ops.losses (which enforce it)."""
+    impl = functools.partial(_targets_impl, interpret=interpret)
+    f = jax.custom_vjp(impl)
+
+    def fwd(*args):
+        return impl(*args), tuple(args)
+
+    def bwd(residuals, _ct):
+        return tuple(jnp.zeros_like(x) for x in residuals)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def vtrace_targets(a, deltas, clipped_pg_rhos, rewards, discounts,
+                   values, bootstrap_value, interpret: bool = False):
+    """(vs, pg_advantages), both [T, ...] f32, fused in one kernel.
+
+    a: discounts * cs; deltas: clipped_rhos * (r + disc*V_{t+1} - V).
+    Inputs may have any trailing shape (flattened onto the lane axis);
+    `interpret` runs the Pallas interpreter (the off-TPU path).
+    Gradient-free by contract (see _targets_fn).
+    """
+    shape = a.shape
+    T = shape[0]
+    flat = lambda x: x.astype(jnp.float32).reshape(T, -1)  # noqa: E731
+    boot = bootstrap_value.astype(jnp.float32).reshape(1, -1)
+    vs, pg = _targets_fn(bool(interpret))(
+        flat(a), flat(deltas), flat(clipped_pg_rhos), flat(rewards),
+        flat(discounts), flat(values), boot
+    )
+    return vs.reshape(shape), pg.reshape(shape)

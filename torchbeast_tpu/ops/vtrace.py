@@ -4,13 +4,26 @@ TPU-native formulation: the backward recursion
 
     acc_t = delta_t + discount_t * c_t * acc_{t+1},   vs = acc + V
 
-runs as a single `lax.scan(reverse=True)` over the time axis, so the whole
-target computation fuses into the learner's XLA program — no Python loop, no
-host round-trips. Behavioral parity with the reference
+is a first-order linear recurrence, so it runs by default as a
+`lax.associative_scan` over the affine maps f_t(x) = a_t x + b_t —
+O(log T) depth, 2.56x over the sequential scan at T=4000 and within
+noise at T=80 (benchmarks/artifacts/vtrace_scan_bench.md) — fused into
+the learner's XLA program. The reference's sequential `lax.scan`
+formulation stays available (`scan_impl="sequential"`), and a fused
+Pallas kernel variant (`"pallas"`, ops/pallas_vtrace.py) computes vs
+AND the pg advantages in one VMEM-resident pass (TPU-compiled,
+interpreted elsewhere).
+
+Numerics contract: V-trace is part of the f32-accumulate surface
+(torchbeast_tpu/precision.py) — inputs are upcast to float32 on entry
+whatever the batch's storage dtype, so a bf16_train run solves the
+recurrence at full precision. The three impls agree to float-
+reassociation tolerance (pinned by the tests/test_vtrace.py parity
+matrix). Behavioral parity with the reference
 (/root/reference/torchbeast/core/vtrace.py:50-139): same clipping rules
-(rho-bar for deltas, 1.0 for c, pg-rho-bar for advantages), same namedtuple
-returns, and gradients are stopped through both outputs (the reference wraps
-everything in torch.no_grad, vtrace.py:91-102).
+(rho-bar for deltas, 1.0 for c, pg-rho-bar for advantages), same
+namedtuple returns, and gradients are stopped through both outputs (the
+reference wraps everything in torch.no_grad, vtrace.py:91-102).
 """
 
 import collections
@@ -32,6 +45,8 @@ VTraceFromLogitsReturns = collections.namedtuple(
 
 VTraceReturns = collections.namedtuple("VTraceReturns", "vs pg_advantages")
 
+SCAN_IMPLS = ("sequential", "associative", "pallas")
+
 
 def action_log_probs(policy_logits, actions):
     """log pi(a_t | x_t) for integer actions.
@@ -46,6 +61,69 @@ def action_log_probs(policy_logits, actions):
     ).squeeze(-1)
 
 
+def _f32(*arrays):
+    """The f32-accumulate entry cast (see module docstring)."""
+    return tuple(jnp.asarray(a).astype(jnp.float32) for a in arrays)
+
+
+def _vs_minus_v(deltas, discounts, cs, bootstrap_value, scan_impl):
+    """Solve the backward recurrence; returns acc ([T, ...]) with
+    vs = acc + values. The shared core of the unfused targets and the
+    fused loss path (pallas solves the FUSED form elsewhere — this
+    helper never sees scan_impl='pallas')."""
+    if scan_impl == "sequential":
+
+        def scan_fn(acc, xs):
+            delta_t, discount_t, c_t = xs
+            acc = delta_t + discount_t * c_t * acc
+            return acc, acc
+
+        _, vs_minus_v_xs = lax.scan(
+            scan_fn,
+            jnp.zeros_like(bootstrap_value),
+            (deltas, discounts, cs),
+            reverse=True,
+        )
+        return vs_minus_v_xs
+    # Suffix-compose the affine maps f_t(x) = a_t x + b_t:
+    # acc_t = (f_t o f_{t+1} o ... o f_{T-1})(0). Flip to a prefix
+    # problem, combine with (q o p) (p = already-accumulated earlier
+    # flipped indices = LATER time, applied first), flip back.
+    a = jnp.flip(discounts * cs, 0)
+    b = jnp.flip(deltas, 0)
+
+    def combine(p, q):
+        pa, pb = p
+        qa, qb = q
+        return qa * pa, qa * pb + qb
+
+    _, acc = lax.associative_scan(combine, (a, b), axis=0)
+    return jnp.flip(acc, 0)
+
+
+def _check_impl(scan_impl):
+    if scan_impl not in SCAN_IMPLS:
+        raise ValueError(
+            f"scan_impl {scan_impl!r} must be one of {SCAN_IMPLS}"
+        )
+
+
+def _pallas_interpret() -> bool:
+    """The kernel compiles via Mosaic on TPU and runs the Pallas
+    interpreter everywhere else (numerically identical; how CPU CI
+    exercises the fused path). TORCHBEAST_VTRACE_PALLAS_COMPILE=1
+    forces the compiled form regardless of backend — for CROSS-lowering
+    (jax.export / .lower(lowering_platforms=("tpu",)) on a chipless
+    host), where the interpreter would otherwise be inlined into the
+    lowered module (learner_bench's bytes accounting, the Mosaic
+    lowering pin)."""
+    import os
+
+    if os.environ.get("TORCHBEAST_VTRACE_PALLAS_COMPILE"):
+        return False
+    return jax.default_backend() != "tpu"
+
+
 def from_logits(
     behavior_policy_logits,
     target_policy_logits,
@@ -56,7 +134,7 @@ def from_logits(
     bootstrap_value,
     clip_rho_threshold=1.0,
     clip_pg_rho_threshold=1.0,
-    scan_impl="sequential",
+    scan_impl="associative",
 ):
     """V-trace for softmax policies (reference vtrace.py:58-88)."""
     target_action_log_probs = action_log_probs(target_policy_logits, actions)
@@ -88,34 +166,33 @@ def from_importance_weights(
     bootstrap_value,
     clip_rho_threshold=1.0,
     clip_pg_rho_threshold=1.0,
-    scan_impl="sequential",
+    scan_impl="associative",
 ):
     """V-trace from log importance weights (reference vtrace.py:91-139).
 
     All inputs are time-major `[T, B, ...]`; `bootstrap_value` is `[B, ...]`.
-    Returns VTraceReturns(vs, pg_advantages), both gradient-stopped.
+    Returns VTraceReturns(vs, pg_advantages), both gradient-stopped and
+    float32 (inputs are upcast on entry — the f32-accumulate contract).
 
     `scan_impl` picks how the backward recursion runs on device:
 
-    - "sequential": `lax.scan(reverse=True)` — T dependent steps. The
-      right choice for the usual T<=80 unrolls (tiny per-step work;
-      scan keeps it fused and cheap).
-    - "associative": `lax.associative_scan` over the affine maps
-      f_t(x) = a_t x + b_t with a_t = discount_t * c_t, b_t = delta_t.
-      The recursion is a first-order linear recurrence, so suffix
-      composition is associative and the whole solve runs in O(log T)
-      depth instead of O(T) — the TPU-first choice for long-unroll
-      (transformer / long-context) configs where a sequential
-      1000-step chain of scalar-vector ops would serialize the loss
-      section of the step. Bit-for-bit it differs from sequential only
-      by float reassociation (parity pinned to 1e-6 in
-      tests/test_vtrace.py).
+    - "associative" (default): `lax.associative_scan` over the affine
+      maps f_t(x) = a_t x + b_t with a_t = discount_t * c_t, b_t =
+      delta_t — the recursion is a first-order linear recurrence, so
+      suffix composition solves it in O(log T) depth instead of O(T).
+      2.56x at T=4000, within noise at the usual T<=80
+      (vtrace_scan_bench.md). Differs from sequential only by float
+      reassociation (parity matrix in tests/test_vtrace.py).
+    - "sequential": `lax.scan(reverse=True)` — T dependent steps, the
+      reference formulation.
+    - "pallas": the fused single-kernel variant (ops/pallas_vtrace.py)
+      computing vs and the advantages in one VMEM-resident pass;
+      Mosaic-compiled on TPU, interpreted elsewhere.
     """
-    if scan_impl not in ("sequential", "associative"):
-        raise ValueError(
-            f"scan_impl {scan_impl!r} must be 'sequential' or "
-            "'associative'"
-        )
+    _check_impl(scan_impl)
+    log_rhos, discounts, rewards, values, bootstrap_value = _f32(
+        log_rhos, discounts, rewards, values, bootstrap_value
+    )
     rhos = jnp.exp(log_rhos)
     if clip_rho_threshold is not None:
         clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
@@ -129,42 +206,27 @@ def from_importance_weights(
     )
     deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
 
-    if scan_impl == "sequential":
-
-        def scan_fn(acc, xs):
-            delta_t, discount_t, c_t = xs
-            acc = delta_t + discount_t * c_t * acc
-            return acc, acc
-
-        _, vs_minus_v_xs = lax.scan(
-            scan_fn,
-            jnp.zeros_like(bootstrap_value),
-            (deltas, discounts, cs),
-            reverse=True,
-        )
-    else:
-        # Suffix-compose the affine maps f_t(x) = a_t x + b_t:
-        # acc_t = (f_t o f_{t+1} o ... o f_{T-1})(0). Flip to a prefix
-        # problem, combine with (q o p) (p = already-accumulated earlier
-        # flipped indices = LATER time, applied first), flip back.
-        a = jnp.flip(discounts * cs, 0)
-        b = jnp.flip(deltas, 0)
-
-        def combine(p, q):
-            pa, pb = p
-            qa, qb = q
-            return qa * pa, qa * pb + qb
-
-        _, acc = lax.associative_scan(combine, (a, b), axis=0)
-        vs_minus_v_xs = jnp.flip(acc, 0)
-
-    vs = vs_minus_v_xs + values
-
-    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
     if clip_pg_rho_threshold is not None:
         clipped_pg_rhos = jnp.minimum(rhos, clip_pg_rho_threshold)
     else:
         clipped_pg_rhos = rhos
+
+    if scan_impl == "pallas":
+        from torchbeast_tpu.ops import pallas_vtrace
+
+        vs, pg_advantages = pallas_vtrace.vtrace_targets(
+            discounts * cs, deltas, clipped_pg_rhos, rewards, discounts,
+            values, bootstrap_value, interpret=_pallas_interpret(),
+        )
+        return VTraceReturns(
+            vs=lax.stop_gradient(vs),
+            pg_advantages=lax.stop_gradient(pg_advantages),
+        )
+
+    vs = _vs_minus_v(deltas, discounts, cs, bootstrap_value,
+                     scan_impl) + values
+
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
     pg_advantages = clipped_pg_rhos * (
         rewards + discounts * vs_t_plus_1 - values
     )

@@ -84,6 +84,17 @@ def make_parallel_update_step(
     consumes its own full global batch), so K scanned collective updates
     match K sequential parallel dispatches.
 
+    Precision (--precision bf16_train, torchbeast_tpu/precision.py):
+    the staged stack's float leaves may arrive bfloat16 — shardings are
+    dtype-agnostic, shard_batch places whatever dtype the arena staged,
+    and the shared update_body upcasts at point of use (f32-accumulate;
+    grads and the all-reduce run f32). The compact optimizer state
+    (hp.opt_state_dtype="bf16") flows in through the caller's
+    make_optimizer, so opt_shardings derived by mapping leaf-wise rules
+    over opt_state keep working; the FACTORED state (hp.opt_factored)
+    does NOT mirror params leaf-wise — callers deriving EP/TP opt
+    shardings must reject that combination (polybeast does).
+
     param_shardings (optional): a params-pytree of NamedShardings (see
     parallel/tp.py) to shard weights over the mesh's `model` axis;
     defaults to fully replicated params. Optimizer state follows the same

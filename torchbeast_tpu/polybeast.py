@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from torchbeast_tpu import learner as learner_lib
+from torchbeast_tpu import precision as precision_lib
 from torchbeast_tpu import telemetry
 from torchbeast_tpu import polybeast_env
 from torchbeast_tpu.monobeast import (
@@ -86,21 +87,43 @@ def make_parser():
     parser.add_argument("--savedir", default="~/logs/torchbeast_tpu")
     parser.add_argument("--total_steps", type=int, default=100000)
     parser.add_argument("--batch_size", type=int, default=8)
-    parser.add_argument("--vtrace_impl", default="sequential",
-                        choices=["sequential", "associative"],
-                        help="V-trace backward recursion: lax.scan "
-                             "(T dependent steps, right for T<=80) or "
-                             "lax.associative_scan (O(log T) depth - "
-                             "the long-unroll/long-context choice).")
+    parser.add_argument("--vtrace_impl", default="associative",
+                        choices=["sequential", "associative", "pallas"],
+                        help="V-trace backward recursion: "
+                             "lax.associative_scan (O(log T) depth, the "
+                             "default), lax.scan (the reference's "
+                             "T-dependent-steps formulation), or the "
+                             "fused Pallas kernel (vs + advantages in "
+                             "one VMEM pass; TPU-compiled, interpreted "
+                             "elsewhere).")
     parser.add_argument("--unroll_length", type=int, default=80)
     # beastlint: disable=FLAG-PARITY  paper defaults differ: polybeast trains the deep IMPALA net, monobeast the shallow one
     parser.add_argument("--model", default="deep",
                         choices=["shallow", "deep", "mlp", "pipelined_mlp", "transformer", "pipelined_transformer"])
     parser.add_argument("--use_lstm", action="store_true")
-    parser.add_argument("--model_dtype", default="float32",
+    parser.add_argument("--precision", default="f32",
+                        choices=["f32", "bf16_compute", "bf16_train"],
+                        help="Precision policy (torchbeast_tpu/"
+                             "precision.py): f32 everywhere; "
+                             "bf16_compute flips trunk compute to "
+                             "bfloat16; bf16_train additionally makes "
+                             "params/activations bf16-RESIDENT (f32 "
+                             "master in the optimizer state, f32 "
+                             "accumulate), stages the batch's float "
+                             "leaves as bf16, and stores the RMSprop "
+                             "second moment bf16 — the HBM-roofline "
+                             "policy.")
+    parser.add_argument("--model_dtype", default=None,
                         choices=["float32", "bfloat16"],
-                        help="Conv/fc trunk compute dtype (bfloat16 rides "
-                             "the MXU; params and losses stay float32).")
+                        help="DEPRECATED alias: bfloat16 maps to "
+                             "--precision bf16_compute (with a "
+                             "warning); conflicts with an explicit "
+                             "bf16_train.")
+    parser.add_argument("--factored_opt_state", action="store_true",
+                        help="Opt-in factored RMSprop second moment "
+                             "(row/col EMAs for matrices, Adafactor-"
+                             "style O(n+m) state; an approximation — "
+                             "not torch-parity).")
     parser.add_argument("--trunk_channels", default="",
                         help="Opt-in deep-trunk widths as a comma list "
                              "(e.g. 32,64,64; default: the reference's "
@@ -447,6 +470,7 @@ def train(flags):
             )
 
         hp = hparams_from_flags(flags)
+        policy = precision_lib.resolve_flags(flags)
         num_actions, frame_shape, frame_dtype = _probe_env_via_server(
             flags, addresses[0]
         )
@@ -568,6 +592,21 @@ def train(flags):
                 from torchbeast_tpu.parallel import transformer_tp_shardings
 
                 rules.append(transformer_tp_shardings)
+            if rules and (
+                policy.param_dtype == "bf16"
+                or getattr(flags, "factored_opt_state", False)
+            ):
+                # EP/TP opt shardings map leaf-wise rules over
+                # opt_state, which must mirror params; the bf16-resident
+                # master wrapper and the factored second moment both
+                # change the state tree (parallel/dp.py documents the
+                # constraint).
+                raise RuntimeError(
+                    "--precision bf16_train / --factored_opt_state do "
+                    "not compose with --expert_parallel/--tensor_"
+                    "parallel yet (optimizer-state sharding rules need "
+                    "a params-mirroring state tree)"
+                )
             param_shardings = opt_shardings = None
             if rules:
                 from torchbeast_tpu.parallel import merge_param_shardings
@@ -1010,8 +1049,16 @@ def train(flags):
         # update_body has no batch-shaped outputs to alias, see
         # learner.donate_argnums_for).
         def _place(item):
-            batch = item["batch"]
-            initial_agent_state = item["initial_agent_state"]
+            # Precision staging cast (bf16_train): float32 leaves go
+            # half-width BEFORE the transfer. Under supersteps the
+            # arena already staged bf16 columns (cast_batch is then a
+            # no-op); the K=1 path casts here.
+            batch = precision_lib.cast_batch(
+                item["batch"], policy.batch_dtype
+            )
+            initial_agent_state = precision_lib.cast_batch(
+                item["initial_agent_state"], policy.batch_dtype
+            )
             if shard is not None:
                 return shard(batch, initial_agent_state)
             return (
@@ -1036,6 +1083,10 @@ def train(flags):
             arena = BatchArena(
                 k=superstep_k, rows=local_rows, batch_dim=1,
                 pool=prefetch_depth + 3, telemetry_name="learner_queue",
+                # bf16_train: float32 rollout leaves land in bf16 arena
+                # columns — the write-through copy IS the cast, and the
+                # staged [K, T+1, B, ...] transfer is half-width.
+                float_dtype=policy.batch_dtype,
             )
         prefetcher = DevicePrefetcher(
             learner_queue, _place, depth=prefetch_depth,

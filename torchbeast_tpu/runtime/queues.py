@@ -294,6 +294,14 @@ class BatchArena:
     batches exactly (an item straddling a batch boundary raises —
     ActorPool rollouts are one column each, so the learner queue always
     tiles). All items must share one nest structure/dtype set.
+
+    Precision staging (`float_dtype`, torchbeast_tpu/precision.py):
+    when set (e.g. ml_dtypes.bfloat16 under --precision bf16_train),
+    float32 leaves allocate their arena columns in that dtype and the
+    write-through copy IS the cast — the staged [K, T+1, B, ...] stack,
+    and with it the host->device transfer, is half-width with zero
+    extra passes. Non-f32 leaves (uint8 frames, ints, bools) are
+    untouched. The learner upcasts at point of use (f32-accumulate).
     """
 
     def __init__(
@@ -304,6 +312,7 @@ class BatchArena:
         pool: int = 5,
         grow_timeout_s: float = 5.0,
         telemetry_name: Optional[str] = None,
+        float_dtype=None,
     ):
         if k < 1:
             raise ValueError(f"superstep k must be >= 1, got {k}")
@@ -316,6 +325,9 @@ class BatchArena:
         self._k = k
         self._rows = rows
         self._batch_dim = batch_dim
+        self._float_dtype = (
+            np.dtype(float_dtype) if float_dtype is not None else None
+        )
         self._grow_timeout_s = grow_timeout_s
         self._slots = [_ArenaSlot() for _ in range(pool)]
         self._free = threading.Condition(threading.Lock())
@@ -371,7 +383,13 @@ class BatchArena:
         for leaf in item_leaves:
             shape = list(leaf.shape)
             shape[bd] = self._rows
-            arrays.append(np.empty([self._k] + shape, leaf.dtype))
+            dtype = leaf.dtype
+            if (
+                self._float_dtype is not None
+                and dtype == np.float32
+            ):
+                dtype = self._float_dtype
+            arrays.append(np.empty([self._k] + shape, dtype))
         slot.arrays = arrays
 
     # beastlint: hot
