@@ -16,12 +16,20 @@ run, mean queue depths) with the run's final telemetry snapshot
 embedded (read from {savedir}/{xpid}/telemetry.jsonl — structured
 JSON, not log scraping; the acting-path wire accounting rides its
 `acting_path` block).
+
+`--compare_native` (ISSUE 9 acceptance) runs the SAME workload twice —
+the Python runtime over sockets, then the C++ runtime over shm rings
+(slot framing + --superstep_k both legs) — and emits both columns plus
+the native/python steady-SPS ratio, gated >= 1.5x at >= 8 actors. The
+verdict is written to --artifact (default
+benchmarks/artifacts/native_parity_bench.json).
 """
 
 import argparse
 import json
 import os
 import re
+import signal
 import subprocess
 import sys
 import time
@@ -29,33 +37,27 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "artifacts",
+    "native_parity_bench.json",
+)
+
 LOG_RE = re.compile(
     r"Step (\d+) @ ([\d.]+) SPS\. Inference batcher size: (\d+)\. "
     r"Learner queue size: (\d+)\."
 )
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--total_steps", type=int, default=400_000)
-    ap.add_argument("--num_servers", type=int, default=16)
-    ap.add_argument("--num_actors", type=int, default=32)
-    ap.add_argument("--batch_size", type=int, default=8)
-    ap.add_argument("--unroll_length", type=int, default=40)
-    ap.add_argument("--model", default="shallow")
-    ap.add_argument("--env", default="Mock")
-    ap.add_argument("--native", action="store_true",
-                    help="C++ queues/pool + C++ env server")
-    ap.add_argument("--no_device_agent_state", action="store_true",
-                    help="Legacy acting path (agent state rides every "
-                         "inference request/reply) — for before/after "
-                         "comparison against the device-resident table.")
-    ap.add_argument("--out", default="/tmp/tbt_e2e.log")
-    ap.add_argument("--timeout_s", type=int, default=1500)
-    args = ap.parse_args()
-
+def run_config(args, native, shm, log_path, tag):
+    """One full polybeast run; returns the summary dict (None SPS rows
+    -> error dict)."""
     savedir = "/tmp/tbt_e2e_save"
-    xpid = f"e2e-{int(time.time())}"
+    xpid = f"e2e-{tag}-{int(time.time())}"
+    pipes = (
+        f"shm:/tmp/tbt_e2e_pipe_{tag}" if shm
+        else f"unix:/tmp/tbt_e2e_pipe_{tag}"
+    )
     cmd = [
         sys.executable, "-m", "torchbeast_tpu.polybeast",
         "--env", args.env,
@@ -65,36 +67,70 @@ def main():
         "--batch_size", str(args.batch_size),
         "--unroll_length", str(args.unroll_length),
         "--total_steps", str(args.total_steps),
+        "--superstep_k", str(args.superstep_k),
         "--savedir", savedir,
         "--xpid", xpid,
-        "--pipes_basename", "unix:/tmp/tbt_e2e_pipe",
+        "--pipes_basename", pipes,
         "--prewarm_inference",  # no mid-run compile stalls in telemetry
     ]
-    if args.native:
-        cmd += ["--native_runtime", "--native_server"]
+    if args.use_lstm:
+        cmd += ["--use_lstm"]
+    if native:
+        cmd += ["--native_runtime"]
+        if args.native_server:
+            cmd += ["--native_server"]
     if args.no_device_agent_state:
         cmd += ["--no_device_agent_state"]
 
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
+    # Each leg runs in its own process group and the WHOLE group is
+    # killed on timeout: the driver's spawned env-server children
+    # otherwise outlive the timeout kill and poison the next leg's
+    # numbers with stolen CPU (observed: 8 orphaned servers from leg 1
+    # running through leg 2 on a 2-core box flipped the verdict).
+    shm_before = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") else set()
     t0 = time.time()
     timed_out = False
     rc = None
-    with open(args.out, "w") as logf:
+    with open(log_path, "w") as logf:
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+            cwd=_REPO, start_new_session=True,
+        )
         try:
-            proc = subprocess.run(
-                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
-                timeout=args.timeout_s, cwd=_REPO,
-            )
-            rc = proc.returncode
+            rc = proc.wait(timeout=args.timeout_s)
         except subprocess.TimeoutExpired:
             # The log up to the kill still holds steady-state telemetry
             # — summarize it rather than dying without the JSON line.
             timed_out = True
+        finally:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+    # SIGKILL skips the drivers' shm hygiene — sweep segments created
+    # during this leg so they don't accumulate across legs/runs. Only
+    # names the drivers can create (psm_* from Python SharedMemory,
+    # tbtring_* from csrc/shm.h): a set-difference alone would also
+    # unlink segments an unrelated process created during the leg.
+    # psm_* is still multiprocessing's global default prefix, so this
+    # sweep — like the SPS measurement itself — assumes the box runs
+    # nothing else during a leg.
+    if os.path.isdir("/dev/shm"):
+        created = set(os.listdir("/dev/shm")) - shm_before
+        for name in created:
+            if not name.startswith(("psm_", "tbtring_")):
+                continue
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
     wall = time.time() - t0
 
     rows = []
-    with open(args.out) as f:
+    with open(log_path) as f:
         for line in f:
             m = LOG_RE.search(line)
             if m:
@@ -111,29 +147,50 @@ def main():
     )
     final_snap = snaps[-1] if snaps else None
     acting = final_snap.get("acting_path") if final_snap else None
+    # Steady SPS from the snapshot timestamps (learner step delta over
+    # wall time, first third discarded as warmup) — the per-tick log SPS
+    # samples alias the monitor cadence and read noisy on a loaded box.
+    steady_sps_telemetry = None
+    if len(snaps) >= 3:
+        mid = snaps[len(snaps) // 3]
+        if (
+            final_snap.get("step") is not None
+            and mid.get("step") is not None
+            and final_snap["time"] > mid["time"]
+        ):
+            steady_sps_telemetry = round(
+                (final_snap["step"] - mid["step"])
+                / (final_snap["time"] - mid["time"]),
+                1,
+            )
     if not rows:
-        print(json.dumps({
+        return {
             "error": f"no telemetry rows parsed (rc={rc}, "
                      f"timed_out={timed_out})",
-            "log": args.out,
-        }))
-        sys.exit(1)
+            "log": log_path,
+        }
     steady = rows[len(rows) // 2:]
     sps = [r[1] for r in steady]
     inf_q = [r[2] for r in steady]
     lrn_q = [r[3] for r in steady]
-    print(json.dumps({
+    return {
         "config": {
-            k: getattr(args, k)
-            for k in ("env", "model", "num_servers", "num_actors",
-                      "batch_size", "unroll_length", "total_steps",
-                      "native", "no_device_agent_state")
+            **{
+                k: getattr(args, k)
+                for k in ("env", "model", "use_lstm", "num_servers",
+                          "num_actors", "batch_size", "unroll_length",
+                          "total_steps", "superstep_k",
+                          "no_device_agent_state")
+            },
+            "native": native,
+            "transport": "shm" if shm else "socket",
         },
         "rc": rc,
         "timed_out": timed_out,
         "wall_s": round(wall, 1),
         "steady_sps_mean": round(sum(sps) / len(sps), 1),
         "steady_sps_max": round(max(sps), 1),
+        "steady_sps_telemetry": steady_sps_telemetry,
         "inference_q_mean": round(sum(inf_q) / len(inf_q), 2),
         "learner_q_mean": round(sum(lrn_q) / len(lrn_q), 2),
         # Acting-path wire accounting from the run's telemetry snapshot:
@@ -148,8 +205,102 @@ def main():
         },
         "telemetry_lines": len(snaps),
         "n_telemetry_rows": len(rows),
-        "log": args.out,
-    }))
+        "log": log_path,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--total_steps", type=int, default=400_000)
+    ap.add_argument("--num_servers", type=int, default=16)
+    ap.add_argument("--num_actors", type=int, default=32)
+    ap.add_argument("--batch_size", type=int, default=8)
+    ap.add_argument("--unroll_length", type=int, default=40)
+    ap.add_argument("--superstep_k", type=int, default=1,
+                    help="Learner superstep K (both runtimes).")
+    ap.add_argument("--model", default="shallow")
+    ap.add_argument("--use_lstm", action="store_true",
+                    help="Recurrent core — exercises the device state "
+                         "table (slot framing) on the acting path.")
+    ap.add_argument("--env", default="Mock")
+    ap.add_argument("--native", action="store_true",
+                    help="C++ queues/pool (+ C++ env server with "
+                         "--native_server)")
+    ap.add_argument("--native_server", action="store_true",
+                    help="With --native: serve envs from the C++ "
+                         "EnvServer too (default: Python servers — the "
+                         "comparison isolates the runtime choice on the "
+                         "learner side).")
+    ap.add_argument("--shm", action="store_true",
+                    help="shm: pipes (shared-memory rings) instead of "
+                         "unix sockets.")
+    ap.add_argument("--compare_native", action="store_true",
+                    help="Run python+socket vs native+shm at the same "
+                         "workload and emit the >=1.5x acceptance "
+                         "verdict (ISSUE 9).")
+    ap.add_argument("--no_device_agent_state", action="store_true",
+                    help="Legacy acting path (agent state rides every "
+                         "inference request/reply) — for before/after "
+                         "comparison against the device-resident table.")
+    ap.add_argument("--out", default="/tmp/tbt_e2e.log")
+    ap.add_argument("--artifact", default=_ARTIFACT,
+                    help="Comparison-verdict artifact path ('' skips "
+                         "the write; --compare_native only).")
+    ap.add_argument("--timeout_s", type=int, default=1500)
+    args = ap.parse_args()
+
+    if not args.compare_native:
+        summary = run_config(
+            args, native=args.native, shm=args.shm, log_path=args.out,
+            tag="native" if args.native else "python",
+        )
+        print(json.dumps(summary))
+        if "error" in summary:
+            sys.exit(1)
+        return
+
+    # ISSUE 9 acceptance: native+shm+slots+K vs python+socket, same
+    # workload, >= 8 actor processes. (The python leg runs over unix
+    # sockets — faster than TCP loopback, so the gate is conservative.)
+    baseline = run_config(
+        args, native=False, shm=False, log_path=args.out + ".python",
+        tag="cmp-python",
+    )
+    native = run_config(
+        args, native=True, shm=True, log_path=args.out + ".native",
+        tag="cmp-native",
+    )
+    ratio = None
+    if "error" not in baseline and "error" not in native:
+        base_sps = (
+            baseline["steady_sps_telemetry"] or baseline["steady_sps_mean"]
+        )
+        native_sps = (
+            native["steady_sps_telemetry"] or native["steady_sps_mean"]
+        )
+        ratio = native_sps / base_sps if base_sps else None
+    out = {
+        "bench": "native_parity_e2e",
+        "baseline_python_socket": baseline,
+        "native_shm": native,
+        "native_speedup": round(ratio, 3) if ratio else None,
+        "acceptance": {
+            "min_actors": args.num_actors,
+            "superstep_k": args.superstep_k,
+            "required_speedup": 1.5,
+            "ok": bool(ratio and ratio >= 1.5 and args.num_actors >= 8),
+        },
+    }
+    if args.artifact:
+        os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+        with open(args.artifact, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(out))
+    # Same machine-checkable contract as the single-run branch: a CI
+    # lane gating on exit status must see the failed leg / missed gate.
+    if not out["acceptance"]["ok"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

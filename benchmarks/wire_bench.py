@@ -40,6 +40,7 @@ import json
 import os
 import socket
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -359,6 +360,92 @@ def bench_rtt_leg(msg, kind, seconds):
     }
 
 
+def bench_native_rtt_leg(msg, kind, seconds, tmpdir):
+    """Native (C++) client RTT through _tbt_core's transport stack —
+    connect (tcp / shm incl. the ring handshake), then action-down/
+    step-up round trips measured entirely in C++ (no per-message Python
+    call overhead, exactly how the native actor pool drives the wire).
+    The server side is the PYTHON transport stack, so the shm leg
+    crosses the language boundary through the shared ring layout."""
+    from torchbeast_tpu.runtime.native import import_native
+
+    core = import_native()
+    if core is None:
+        return None
+
+    if kind == "native_tcp":
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        address = "127.0.0.1:%d" % listener.getsockname()[1]
+
+        def child():
+            conn, _ = listener.accept()
+            listener.close()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = transport.SocketTransport(conn)
+            t.send(msg)
+            while True:
+                try:
+                    value, _ = t.recv_sized()
+                except (wire.WireError, OSError):
+                    return
+                if value is None:
+                    return
+                t.send(msg)
+
+        pid = _fork(child)
+        listener.close()
+    elif kind == "native_shm":
+        path = os.path.join(tmpdir, "native_shm_rtt")
+        listener = socket.socket(socket.AF_UNIX)
+        listener.bind(path)
+        listener.listen(1)
+        address = f"shm:{path}"
+
+        def child():
+            conn, _ = listener.accept()
+            listener.close()
+            t = transport.server_transport(conn, shm=True)
+            try:
+                t.send(msg)
+                while True:
+                    try:
+                        value, _ = t.recv_sized()
+                    except (wire.WireError, OSError):
+                        break
+                    if value is None:
+                        break
+                    value = None  # drop the ring view (lifetime rule)
+                    t.send(msg)
+            finally:
+                # Owner-side close unlinks the rings and rebalances the
+                # resource tracker (the client's sweep may have gotten
+                # there first) — without this, the fork-shared tracker
+                # warns about already-unlinked segments at exit.
+                t.close()
+
+        pid = _fork(child)
+        listener.close()
+    else:
+        raise ValueError(kind)
+
+    previous = _set_affinity({0})
+    try:
+        iters, elapsed = core.bench_client_rtt(
+            address, seconds=seconds, warmup=50
+        )
+    finally:
+        _restore_affinity(previous)
+    os.waitpid(pid, 0)
+    return {
+        "transport": kind,
+        "msgs_s": iters / elapsed if elapsed > 0 else 0.0,
+        "iters": iters,
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seconds", type=float, default=2.0,
@@ -390,6 +477,16 @@ def main(argv=None):
             row = bench_rtt_leg(msg, kind, flags.seconds)
             row["payload"] = name
             results["rtt"].append(row)
+        # Native rows (ISSUE 9): the C++ client stack vs the Python eras
+        # above — omitted (with a note) when _tbt_core isn't built.
+        for kind in ("native_tcp", "native_shm"):
+            with tempfile.TemporaryDirectory() as sock_dir:
+                row = bench_native_rtt_leg(msg, kind, flags.seconds, sock_dir)
+            if row is None:
+                results["native_skipped"] = True
+                break
+            row["payload"] = name
+            results.setdefault("rtt_native", []).append(row)
 
     def send_row(payload, leg):
         return next(
@@ -416,6 +513,25 @@ def main(argv=None):
         "atari_shm_over_tcp_send": shm_vs_tcp_send,
         "atari_shm_over_tcp_rtt": shm_vs_tcp_rtt,
     }
+    if "rtt_native" in results:
+        def native_row(payload, kind):
+            return next(
+                r for r in results["rtt_native"]
+                if r["payload"] == payload and r["transport"] == kind
+            )
+
+        # Native-vs-Python eras at the Atari payload: the C++ client
+        # stack against the same Python server (informational — RTT on
+        # loopback is syscall-dominated; the pool-level win shows in the
+        # e2e bench artifact).
+        acceptance["atari_native_shm_over_python_tcp_rtt"] = (
+            native_row("atari", "native_shm")["msgs_s"]
+            / rtt_row("atari", "tcp")["msgs_s"]
+        )
+        acceptance["atari_native_shm_over_python_shm_rtt"] = (
+            native_row("atari", "native_shm")["msgs_s"]
+            / rtt_row("atari", "shm")["msgs_s"]
+        )
     failures = []
     if not flags.selftest:
         if atari_speedup < 2.0:

@@ -1,6 +1,7 @@
 // ActorPool: pure-C++ actor loops — the reference's hottest native
 // component (N5, /root/reference/src/cc/actorpool.cc:342-564), re-designed
-// for the framed-socket transport.
+// for the framed transports (tcp/unix sockets and shm rings, client.h /
+// shm.h).
 //
 // Each loop: connect to an env server, read the initial Step, then repeat
 // {inference via DynamicBatcher::compute -> send Action -> recv Step},
@@ -8,12 +9,26 @@
 // (overlap-by-one, agent-output pairing, agent-state carry; see
 // torchbeast_tpu/rollout.py for the invariant spec shared with the Python
 // implementation). No Python in the loop: the GIL is only touched by the
-// inference/learner threads that drain the queues from the Python side.
+// inference/learner threads that drain the queues from the Python side —
+// plus, in slot mode, the once-per-unroll slot hooks (pymodule.cc), which
+// drive the SAME device-resident state table the Python pool uses.
+//
+// Two framings (runtime/actor_pool.py wire contract):
+// - legacy: requests carry {"env", "agent_state"}; replies carry
+//   {"outputs", "agent_state"} and the boundary state rides every reply.
+// - slot (use_slots): requests carry {"env", "slot", "advance"} ([1,1]
+//   leaves, batchable like any other); replies carry {"outputs"} only.
+//   Recurrent state lives in the Python DeviceStateTable; the hooks
+//   reset a slot at (re)connect and read it once per unroll boundary.
 
 #pragma once
 
 #include <atomic>
+#include <chrono>
+#include <cstring>
 #include <exception>
+#include <functional>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -21,6 +36,7 @@
 
 #include "client.h"
 #include "queues.h"
+#include "shm.h"
 #include "wire.h"
 
 namespace tbt {
@@ -35,28 +51,64 @@ inline const std::vector<std::string>& env_keys() {
 class ActorPool {
  public:
   using LearnerQueue = BatchingQueue<int>;  // payload unused
+  // Slot hooks (slot mode only; pymodule.cc binds them to the Python
+  // DeviceStateTable under the GIL): reset(slot) -> initial state host
+  // copy, read(slot) -> the slot's current state host copy.
+  using SlotHook = std::function<ArrayNest(int64_t)>;
+
+  struct Telemetry {
+    int64_t env_steps = 0;
+    int64_t connects = 0;
+    int64_t reconnects = 0;
+    int64_t bytes_up = 0;    // env server -> this process
+    int64_t bytes_down = 0;  // actions back out
+  };
 
   ActorPool(int64_t unroll_length, std::shared_ptr<LearnerQueue> learner_queue,
             std::shared_ptr<DynamicBatcher> inference_batcher,
             std::vector<std::string> addresses, ArrayNest initial_agent_state,
-            double connect_timeout_s = 600, int64_t max_reconnects = 0)
+            double connect_timeout_s = 600, int64_t max_reconnects = 0,
+            bool use_slots = false, SlotHook slot_reset = nullptr,
+            SlotHook slot_read = nullptr,
+            size_t max_frame_bytes = wire::kMaxFrameBytes)
       : unroll_length_(unroll_length),
         learner_queue_(std::move(learner_queue)),
         inference_batcher_(std::move(inference_batcher)),
         addresses_(std::move(addresses)),
         initial_agent_state_(std::move(initial_agent_state)),
         connect_timeout_s_(connect_timeout_s),
-        max_reconnects_(max_reconnects) {}
+        max_reconnects_(max_reconnects),
+        use_slots_(use_slots),
+        slot_reset_(std::move(slot_reset)),
+        slot_read_(std::move(slot_read)),
+        max_frame_bytes_(max_frame_bytes) {
+    if (use_slots_ && (!slot_reset_ || !slot_read_))
+      throw std::invalid_argument(
+          "slot framing needs slot_reset and slot_read hooks");
+  }
 
   int64_t count() const { return count_.load(); }
   int64_t reconnect_count() const { return reconnect_count_.load(); }
+
+  Telemetry telemetry() const {
+    Telemetry t;
+    t.env_steps = count_.load();
+    t.connects = connects_.load();
+    t.reconnects = reconnect_count_.load();
+    t.bytes_up = bytes_up_.load();
+    t.bytes_down = bytes_down_.load();
+    return t;
+  }
 
   // Blocks until every loop exits; rethrows the first error.
   void run() {
     std::vector<std::thread> threads;
     threads.reserve(addresses_.size());
-    for (const std::string& address : addresses_) {
-      threads.emplace_back([this, address] { guarded_loop(address); });
+    for (size_t i = 0; i < addresses_.size(); ++i) {
+      const std::string& address = addresses_[i];
+      int64_t index = static_cast<int64_t>(i);
+      threads.emplace_back(
+          [this, index, address] { guarded_loop(index, address); });
     }
     for (auto& t : threads) t.join();
     std::lock_guard<std::mutex> lock(error_mu_);
@@ -76,13 +128,35 @@ class ActorPool {
   }
 
  private:
-  void guarded_loop(const std::string& address) {
+  void record_first_error() {
+    std::lock_guard<std::mutex> lock(error_mu_);
+    if (!first_error_) first_error_ = std::current_exception();
+  }
+
+  void guarded_loop(int64_t index, const std::string& address) {
     int64_t reconnects = 0;
     int64_t progress = 0;  // this actor's env steps across reconnects
     while (true) {
       int64_t steps_at_connect = progress;
+      // Transport failure (env-server death / stream cut / corrupt shm
+      // frame): optionally reconnect with a fresh env + reset agent
+      // state. During pipeline shutdown exit cleanly; a full recovery
+      // (>= one unroll streamed since the last connect) earns the
+      // budget back. Returns true to retry the stream.
+      auto transport_failure = [&]() -> bool {
+        if (inference_batcher_->is_closed() || learner_queue_->is_closed())
+          return false;
+        if (progress - steps_at_connect >= unroll_length_) reconnects = 0;
+        if (reconnects < max_reconnects_) {
+          ++reconnects;
+          reconnect_count_.fetch_add(1);
+          return true;
+        }
+        record_first_error();
+        return false;
+      };
       try {
-        loop(address, &progress);
+        loop(index, address, &progress);
         return;
       } catch (const ClosedBatchingQueue&) {
         return;  // clean shutdown
@@ -93,29 +167,20 @@ class ActorPool {
         // mid-training (inference failure) is a real error.
         if (!inference_batcher_->is_closed() &&
             !learner_queue_->is_closed()) {
-          std::lock_guard<std::mutex> lock(error_mu_);
-          if (!first_error_) first_error_ = std::current_exception();
+          record_first_error();
         }
         return;
       } catch (const SocketError&) {
-        // Transport failure (env-server death / stream cut): optionally
-        // reconnect with a fresh env + reset agent state. During pipeline
-        // shutdown exit cleanly; a full recovery (>= one unroll streamed
-        // since the last connect) earns the budget back.
-        if (inference_batcher_->is_closed() || learner_queue_->is_closed())
-          return;
-        if (progress - steps_at_connect >= unroll_length_) reconnects = 0;
-        if (reconnects < max_reconnects_) {
-          ++reconnects;
-          reconnect_count_.fetch_add(1);
-          continue;
-        }
-        std::lock_guard<std::mutex> lock(error_mu_);
-        if (!first_error_) first_error_ = std::current_exception();
+        if (transport_failure()) continue;
+        return;
+      } catch (const wire::WireError&) {
+        // A corrupt frame (bit-flipped tcp stream, stomped shm ring) is
+        // a per-connection failure, not a pool failure — same
+        // reconnect contract as the Python pool.
+        if (transport_failure()) continue;
         return;
       } catch (...) {
-        std::lock_guard<std::mutex> lock(error_mu_);
-        if (!first_error_) first_error_ = std::current_exception();
+        record_first_error();
         return;
       }
     }
@@ -143,8 +208,8 @@ class ActorPool {
       const Array& a = it->second.leaf().array;
       std::vector<int64_t> shape = {1, 1};
       shape.insert(shape.end(), a.shape().begin(), a.shape().end());
-      // Clone: the wire buffer is reused per message; rollout storage
-      // must own its bytes.
+      // Clone: the wire buffer is reused per message (RecvBuffer / shm
+      // ring slot); rollout storage must own its bytes.
       Array expanded(a.dtype(), shape);
       std::memcpy(expanded.mutable_data(), a.data(), a.nbytes());
       out.emplace(key, ArrayNest(std::move(expanded)));
@@ -157,35 +222,72 @@ class ActorPool {
     ArrayNest agent;
   };
 
-  void loop(const std::string& address, int64_t* progress) {
-    FramedSocket sock;
-    sock.connect(address, connect_timeout_s_);
+  template <typename T>
+  static Array scalar_array(DType dtype, T value) {
+    Array a(dtype, {1, 1});
+    std::memcpy(a.mutable_data(), &value, sizeof(T));
+    return a;
+  }
 
-    ArrayNest env_outputs = env_outputs_from(sock.recv());
-    ArrayNest agent_state = initial_agent_state_;
+  ArrayNest recv_step(Transport* t) {
+    auto [msg, nbytes] = t->recv_sized();
+    bytes_up_.fetch_add(static_cast<int64_t>(nbytes));
+    return env_outputs_from(msg);
+  }
 
-    auto compute = [this](const ArrayNest& env, const ArrayNest& state) {
+  void loop(int64_t index, const std::string& address, int64_t* progress) {
+    std::unique_ptr<Transport> sock =
+        shm::connect_transport(address, connect_timeout_s_, max_frame_bytes_);
+    connects_.fetch_add(1);
+    // shm connections: sweep the ring segments on EVERY teardown — a
+    // SIGKILL'd env server can't clean up its own, and for a live
+    // server this only pre-empts its own unlink (segments are
+    // per-connection, never re-attached).
+    struct Sweep {
+      Transport* t;
+      ~Sweep() { t->unlink_segments(); }
+    } sweep{sock.get()};
+
+    // Fresh stream => fresh recurrent state. In slot mode this resets
+    // the actor's table slot (covers reconnects: the partial rollout
+    // was discarded, so the slot must restart from the initial state)
+    // and fetches the host copy for the rollout boundary.
+    ArrayNest initial_agent_state =
+        use_slots_ ? slot_reset_(index) : initial_agent_state_;
+
+    ArrayNest env_outputs = recv_step(sock.get());
+    ArrayNest agent_state = initial_agent_state;
+
+    auto compute = [this, index](const ArrayNest& env, ArrayNest* state,
+                                 bool advance) {
       ArrayNest::Dict inputs;
-      inputs.emplace("agent_state", state);
       inputs.emplace("env", env);
+      if (use_slots_) {
+        inputs.emplace("slot", ArrayNest(scalar_array<int32_t>(
+                                   DType::kI32, static_cast<int32_t>(index))));
+        inputs.emplace("advance", ArrayNest(scalar_array<uint8_t>(
+                                      DType::kBool, advance ? 1 : 0)));
+        ArrayNest result = inference_batcher_->compute(ArrayNest(inputs));
+        return result.dict().at("outputs");
+      }
+      inputs.emplace("agent_state", *state);
       ArrayNest result = inference_batcher_->compute(ArrayNest(inputs));
       const auto& d = result.dict();
-      return std::make_pair(d.at("outputs"), d.at("agent_state"));
+      if (advance) *state = d.at("agent_state");
+      return d.at("outputs");
     };
 
-    // Prime the boundary agent output (state advance discarded — the first
-    // in-rollout compute re-consumes this env output for real).
-    auto [agent_outputs, discard] = compute(env_outputs, agent_state);
-    (void)discard;
+    // Prime the boundary agent output (state advance discarded — the
+    // first in-rollout compute re-consumes this env output for real).
+    ArrayNest agent_outputs = compute(env_outputs, &agent_state,
+                                      /*advance=*/false);
 
     std::vector<StepPair> rollout;
     rollout.push_back({env_outputs, agent_outputs});
-    ArrayNest rollout_initial_state = agent_state;
+    ArrayNest rollout_initial_state = initial_agent_state;
 
     while (true) {
-      auto [outputs, new_state] = compute(env_outputs, agent_state);
-      agent_outputs = outputs;
-      agent_state = new_state;
+      agent_outputs = compute(env_outputs, &agent_state, /*advance=*/true);
 
       // Extract the scalar action from outputs["action"] ([1,1]).
       const Array& action_arr =
@@ -197,9 +299,10 @@ class ActorPool {
                          wire::ValueNest(wire::Value::of_string("action")));
       action_msg.emplace("action",
                          wire::ValueNest(wire::Value::of_int(action)));
-      sock.send(wire::ValueNest(std::move(action_msg)));
+      bytes_down_.fetch_add(
+          static_cast<int64_t>(sock->send(wire::ValueNest(std::move(action_msg)))));
 
-      env_outputs = env_outputs_from(sock.recv());
+      env_outputs = recv_step(sock.get());
       ++(*progress);
       count_.fetch_add(1);
       rollout.push_back({env_outputs, agent_outputs});
@@ -207,7 +310,11 @@ class ActorPool {
       if (static_cast<int64_t>(rollout.size()) == unroll_length_ + 1) {
         enqueue_rollout(rollout, rollout_initial_state);
         rollout.erase(rollout.begin(), rollout.end() - 1);  // overlap-by-one
-        rollout_initial_state = agent_state;
+        // Boundary state for the NEXT rollout: slot mode fetches it
+        // from the device table once per unroll (the only time agent
+        // state crosses the host boundary); legacy mode carries it
+        // from the last reply.
+        rollout_initial_state = use_slots_ ? slot_read_(index) : agent_state;
       }
     }
   }
@@ -254,9 +361,16 @@ class ActorPool {
   const ArrayNest initial_agent_state_;
   const double connect_timeout_s_;
   const int64_t max_reconnects_;
+  const bool use_slots_;
+  const SlotHook slot_reset_;
+  const SlotHook slot_read_;
+  const size_t max_frame_bytes_;
 
   std::atomic<int64_t> count_{0};
   std::atomic<int64_t> reconnect_count_{0};
+  std::atomic<int64_t> connects_{0};
+  std::atomic<int64_t> bytes_up_{0};
+  std::atomic<int64_t> bytes_down_{0};
   mutable std::mutex error_mu_;
   std::exception_ptr first_error_;
 };

@@ -30,14 +30,33 @@ class SocketError : public std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
-class FramedSocket {
+// One framed-message stream, whatever the data plane: the plain socket
+// (FramedSocket) or the shared-memory rings (shm.h ShmTransport). The
+// actor pool and env server speak only this interface, so both sides
+// accept every address scheme the Python runtime does.
+class Transport {
+ public:
+  virtual ~Transport() = default;
+  // Returns the framed byte count (header included) for wire telemetry.
+  virtual size_t send(const wire::ValueNest& value) = 0;
+  // (value, framed byte count); throws SocketError on EOF — the env
+  // stream should outlive the actor loop.
+  virtual std::pair<wire::ValueNest, size_t> recv_sized() = 0;
+  wire::ValueNest recv() { return recv_sized().first; }
+  // shm crash sweep; no-op for socket transports.
+  virtual void unlink_segments() {}
+  virtual void close() = 0;
+};
+
+class FramedSocket : public Transport {
  public:
   FramedSocket() = default;
-  ~FramedSocket() { close(); }
+  ~FramedSocket() override { close(); }
 
   FramedSocket(const FramedSocket&) = delete;
   FramedSocket& operator=(const FramedSocket&) = delete;
-  FramedSocket(FramedSocket&& other) noexcept : fd_(other.fd_) {
+  FramedSocket(FramedSocket&& other) noexcept
+      : fd_(other.fd_), max_frame_bytes_(other.max_frame_bytes_) {
     other.fd_ = -1;
   }
 
@@ -48,6 +67,21 @@ class FramedSocket {
     s.fd_ = fd;
     return s;
   }
+
+  // Borrow the fd (e.g. for setsockopt) without giving up ownership.
+  int fd() const { return fd_; }
+
+  // Hand the fd off (e.g. to a ShmTransport after the handshake); the
+  // destructor then leaves it alone.
+  int release() {
+    int fd = fd_;
+    fd_ = -1;
+    return fd;
+  }
+
+  // Per-connection frame bound (--max_frame_bytes); defaults to the
+  // codec-wide kMaxFrameBytes.
+  void set_max_frame_bytes(size_t n) { max_frame_bytes_ = n; }
 
   // "unix:/path" or "host:port", retrying until deadline_s.
   void connect(const std::string& address, double deadline_s) {
@@ -64,14 +98,14 @@ class FramedSocket {
                       last_error);
   }
 
-  void close() {
+  void close() override {
     if (fd_ >= 0) {
       ::close(fd_);
       fd_ = -1;
     }
   }
 
-  void send(const wire::ValueNest& value) {
+  size_t send(const wire::ValueNest& value) override {
     std::vector<uint8_t> framed = wire::encode(value);
     size_t sent = 0;
     while (sent < framed.size()) {
@@ -79,21 +113,24 @@ class FramedSocket {
       if (n <= 0) throw SocketError("send failed");
       sent += static_cast<size_t>(n);
     }
+    return framed.size();
   }
 
   // Throws SocketError on EOF (the stream should outlive the actor loop).
-  wire::ValueNest recv() {
+  std::pair<wire::ValueNest, size_t> recv_sized() override {
     uint8_t header[4];
     recv_exact(header, 4);
     uint32_t length = 0;
     for (int i = 0; i < 4; ++i)
       length |= static_cast<uint32_t>(header[i]) << (8 * i);
-    if (length > wire::kMaxFrameBytes)
+    if (length > max_frame_bytes_)
       throw wire::WireError("wire: frame length " + std::to_string(length) +
-                            " exceeds kMaxFrameBytes");
+                            " exceeds max_frame_bytes " +
+                            std::to_string(max_frame_bytes_));
     auto payload = std::make_shared<std::vector<uint8_t>>(length);
     recv_exact(payload->data(), length);
-    return wire::decode(payload->data(), length, payload);
+    return {wire::decode(payload->data(), length, payload),
+            4 + static_cast<size_t>(length)};
   }
 
  private:
@@ -166,6 +203,7 @@ class FramedSocket {
   }
 
   int fd_ = -1;
+  size_t max_frame_bytes_ = wire::kMaxFrameBytes;
 };
 
 }  // namespace tbt

@@ -27,6 +27,7 @@
 #include <vector>
 
 #include "client.h"
+#include "shm.h"
 #include "wire.h"
 
 namespace tbt {
@@ -109,8 +110,14 @@ class EnvServer {
  private:
   void bind_and_listen() {
     int fd = -1;
-    if (address_.rfind("unix:", 0) == 0) {
-      unix_path_ = address_.substr(5);
+    if (address_.rfind("unix:", 0) == 0 || shm::is_shm_address(address_)) {
+      // shm addresses resolve to their unix doorbell socket; the
+      // per-connection rings are created at accept time
+      // (shm_server_transport), names exchanged in the handshake —
+      // same protocol as runtime/transport.py server_transport.
+      shm_ = shm::is_shm_address(address_);
+      unix_path_ = shm_ ? shm::shm_socket_path(address_)
+                        : address_.substr(5);
       ::unlink(unix_path_.c_str());
       fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
       if (fd < 0) throw SocketError("socket() failed");
@@ -149,16 +156,25 @@ class EnvServer {
   void serve_stream(int fd) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    FramedSocket sock = FramedSocket::adopt(fd);
+    std::unique_ptr<Transport> sock;
     StreamHooks hooks;
     bool have_hooks = false;
     try {
+      if (shm_) {
+        // Rings + handshake BEFORE the env hooks run, so a client that
+        // never acks can't leak an env instance (matches the Python
+        // server's ordering). Ring teardown: the transport owns its
+        // created segments and unlinks them at close.
+        sock = shm::shm_server_transport(FramedSocket::adopt(fd));
+      } else {
+        sock = std::make_unique<FramedSocket>(FramedSocket::adopt(fd));
+      }
       hooks = hook_factory_();
       have_hooks = true;
-      sock.send(hooks.initial());
+      sock->send(hooks.initial());
       while (true) {
-        wire::ValueNest action = sock.recv();
-        sock.send(hooks.step(action));
+        wire::ValueNest action = sock->recv();
+        sock->send(hooks.step(action));
       }
     } catch (const SocketError&) {
       // client hung up / stop(): normal end of stream
@@ -171,8 +187,9 @@ class EnvServer {
                     wire::ValueNest(wire::Value::of_string("error")));
         err.emplace("message",
                     wire::ValueNest(wire::Value::of_string(e.what())));
-        sock.send(wire::ValueNest(std::move(err)));
+        if (sock) sock->send(wire::ValueNest(std::move(err)));
       } catch (const SocketError&) {
+      } catch (const wire::WireError&) {
       }
     }
     if (have_hooks && hooks.close) hooks.close();
@@ -206,6 +223,7 @@ class EnvServer {
   std::string address_;
   std::function<StreamHooks()> hook_factory_;
   std::string unix_path_;
+  bool shm_ = false;
   std::atomic<int> listen_fd_{-1};
   std::atomic<bool> running_{false};
   std::mutex mu_;

@@ -16,6 +16,7 @@
 
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #include <numpy/arrayobject.h>
+#include <numpy/arrayscalars.h>
 
 #include <memory>
 #include <string>
@@ -24,6 +25,7 @@
 #include "actor_pool.h"
 #include "env_server.h"
 #include "queues.h"
+#include "shm.h"
 
 namespace {
 
@@ -35,6 +37,38 @@ PyObject* ClosedBatchingQueueError;
 PyObject* AsyncErrorError;
 
 // ---------------------------------------------------------------- dtypes
+// bfloat16 (wire code 12, csrc/array.h kBF16) is a numpy USER dtype
+// registered by ml_dtypes (a jax dependency), so its type number is
+// dynamic — resolved once, under the GIL. -1 = ml_dtypes unavailable:
+// converting a bf16 array then fails loudly instead of mislabeling it.
+int bf16_typenum = -1;
+bool bf16_resolved = false;
+
+int resolve_bf16_typenum() {
+  if (bf16_resolved) return bf16_typenum;
+  bf16_resolved = true;
+  PyObject* mod = PyImport_ImportModule("ml_dtypes");
+  if (!mod) {
+    PyErr_Clear();
+    return bf16_typenum;
+  }
+  PyObject* bf = PyObject_GetAttrString(mod, "bfloat16");
+  Py_DECREF(mod);
+  if (!bf) {
+    PyErr_Clear();
+    return bf16_typenum;
+  }
+  PyArray_Descr* descr = nullptr;
+  if (PyArray_DescrConverter(bf, &descr) && descr) {
+    bf16_typenum = descr->type_num;
+    Py_DECREF(descr);
+  } else {
+    PyErr_Clear();
+  }
+  Py_DECREF(bf);
+  return bf16_typenum;
+}
+
 int dtype_to_npy(DType d) {
   switch (d) {
     case DType::kU8: return NPY_UINT8;
@@ -49,6 +83,7 @@ int dtype_to_npy(DType d) {
     case DType::kU32: return NPY_UINT32;
     case DType::kU64: return NPY_UINT64;
     case DType::kF16: return NPY_FLOAT16;
+    case DType::kBF16: return resolve_bf16_typenum();
   }
   return -1;
 }
@@ -67,7 +102,12 @@ bool npy_to_dtype(int npy, DType* out) {
     case NPY_UINT32: *out = DType::kU32; return true;
     case NPY_UINT64: *out = DType::kU64; return true;
     case NPY_FLOAT16: *out = DType::kF16; return true;
-    default: return false;
+    default:
+      if (npy >= 0 && npy == resolve_bf16_typenum()) {
+        *out = DType::kBF16;
+        return true;
+      }
+      return false;
   }
 }
 
@@ -196,6 +236,204 @@ PyObject* nest_to_py(const ArrayNest& nest) {
 
 void set_py_error();
 
+// ------------------------------------------------- telemetry snapshots
+// HistSnapshot -> {"count", "total", "total_sq", "min", "max",
+// "buckets": {index: count}} — the shape runtime/native.py's fold feeds
+// into telemetry.metrics.Histogram.observe_aggregate (same log-bucket
+// geometry; csrc/queues.h telemetry_bucket_index).
+PyObject* hist_to_py(const tbt::HistSnapshot& h) {
+  PyObject* buckets = PyDict_New();
+  if (!buckets) return nullptr;
+  for (const auto& [index, count] : h.buckets) {
+    PyObject* key = PyLong_FromLong(index);
+    PyObject* value = PyLong_FromLongLong(count);
+    if (!key || !value || PyDict_SetItem(buckets, key, value) < 0) {
+      Py_XDECREF(key);
+      Py_XDECREF(value);
+      Py_DECREF(buckets);
+      return nullptr;
+    }
+    Py_DECREF(key);
+    Py_DECREF(value);
+  }
+  return Py_BuildValue("{s:L,s:d,s:d,s:d,s:d,s:N}", "count", h.count,
+                       "total", h.total, "total_sq", h.total_sq, "min",
+                       h.min, "max", h.max, "buckets", buckets);
+}
+
+// ------------------------------------------------- wire value <-> python
+// Full-fidelity converters between Python values and wire::ValueNest —
+// scalars stay scalars (unlike the ArrayNest converters, which coerce
+// everything to arrays). Powers the _tbt_core.wire_encode/wire_decode
+// cross-language codec pins (tests/test_native.py) and the handshake-free
+// bench helpers.
+bool py_to_value(PyObject* obj, tbt::wire::ValueNest* out) {
+  namespace wire = tbt::wire;
+  // Ordering matches wire.py _encode_value: None, bool BEFORE int,
+  // int, float, str, ndarray, list/tuple, dict.
+  if (obj == Py_None) {
+    *out = wire::ValueNest(wire::Value{});
+    return true;
+  }
+  if (PyBool_Check(obj) || PyArray_IsScalar(obj, Bool)) {
+    wire::Value v;
+    v.kind = wire::Value::Kind::kBool;
+    v.b = PyObject_IsTrue(obj) == 1;
+    *out = wire::ValueNest(std::move(v));
+    return true;
+  }
+  if ((PyLong_Check(obj) || PyArray_IsScalar(obj, Integer)) &&
+      !PyArray_Check(obj)) {
+    long long x = PyLong_Check(obj) ? PyLong_AsLongLong(obj) : 0;
+    if (!PyLong_Check(obj)) {
+      PyObject* as_int = PyNumber_Long(obj);
+      if (!as_int) return false;
+      x = PyLong_AsLongLong(as_int);
+      Py_DECREF(as_int);
+    }
+    if (PyErr_Occurred()) return false;
+    *out = wire::ValueNest(wire::Value::of_int(x));
+    return true;
+  }
+  if (PyFloat_Check(obj) || PyArray_IsScalar(obj, Floating)) {
+    double x = PyFloat_Check(obj) ? PyFloat_AsDouble(obj) : 0.0;
+    if (!PyFloat_Check(obj)) {
+      PyObject* as_float = PyNumber_Float(obj);
+      if (!as_float) return false;
+      x = PyFloat_AsDouble(as_float);
+      Py_DECREF(as_float);
+    }
+    if (PyErr_Occurred()) return false;
+    wire::Value v;
+    v.kind = wire::Value::Kind::kFloat;
+    v.f = x;
+    *out = wire::ValueNest(std::move(v));
+    return true;
+  }
+  if (PyUnicode_Check(obj)) {
+    const char* s = PyUnicode_AsUTF8(obj);
+    if (!s) return false;
+    *out = wire::ValueNest(wire::Value::of_string(s));
+    return true;
+  }
+  if (PyArray_Check(obj)) {
+    PyArrayObject* arr = reinterpret_cast<PyArrayObject*>(
+        PyArray_FROM_OF(obj, NPY_ARRAY_C_CONTIGUOUS | NPY_ARRAY_ALIGNED));
+    if (!arr) return false;
+    DType dtype;
+    if (!npy_to_dtype(PyArray_TYPE(arr), &dtype)) {
+      PyErr_Format(PyExc_TypeError, "unsupported array dtype %d",
+                   PyArray_TYPE(arr));
+      Py_DECREF(arr);
+      return false;
+    }
+    std::vector<int64_t> shape(PyArray_NDIM(arr));
+    for (int i = 0; i < PyArray_NDIM(arr); ++i)
+      shape[i] = PyArray_DIM(arr, i);
+    // Deep copy: wire values may outlive the GIL scope.
+    Array a(dtype, std::move(shape));
+    std::memcpy(a.mutable_data(), PyArray_DATA(arr), a.nbytes());
+    Py_DECREF(arr);
+    *out = wire::ValueNest(wire::Value::of(std::move(a)));
+    return true;
+  }
+  if (PyList_Check(obj) || PyTuple_Check(obj)) {
+    PyObject* seq = PySequence_Fast(obj, "expected sequence");
+    if (!seq) return false;
+    wire::ValueNest::List list;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    list.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      wire::ValueNest sub;
+      if (!py_to_value(PySequence_Fast_GET_ITEM(seq, i), &sub)) {
+        Py_DECREF(seq);
+        return false;
+      }
+      list.push_back(std::move(sub));
+    }
+    Py_DECREF(seq);
+    *out = wire::ValueNest(std::move(list));
+    return true;
+  }
+  if (PyDict_Check(obj)) {
+    wire::ValueNest::Dict dict;
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(obj, &pos, &key, &value)) {
+      PyObject* key_str = PyObject_Str(key);
+      if (!key_str) return false;
+      wire::ValueNest sub;
+      if (!py_to_value(value, &sub)) {
+        Py_DECREF(key_str);
+        return false;
+      }
+      const char* key_utf8 = PyUnicode_AsUTF8(key_str);
+      if (!key_utf8) {  // e.g. lone surrogates: raises, returns NULL
+        Py_DECREF(key_str);
+        return false;
+      }
+      dict.emplace(key_utf8, std::move(sub));
+      Py_DECREF(key_str);
+    }
+    *out = wire::ValueNest(std::move(dict));
+    return true;
+  }
+  PyErr_Format(PyExc_TypeError, "cannot serialize %s to the wire",
+               Py_TYPE(obj)->tp_name);
+  return false;
+}
+
+PyObject* array_to_py(const Array& a);
+
+PyObject* value_to_py(const tbt::wire::ValueNest& nest) {
+  namespace wire = tbt::wire;
+  if (nest.is_leaf()) {
+    const wire::Value& v = nest.leaf();
+    switch (v.kind) {
+      case wire::Value::Kind::kNone:
+        Py_RETURN_NONE;
+      case wire::Value::Kind::kBool:
+        return PyBool_FromLong(v.b);
+      case wire::Value::Kind::kInt:
+        return PyLong_FromLongLong(v.i);
+      case wire::Value::Kind::kFloat:
+        return PyFloat_FromDouble(v.f);
+      case wire::Value::Kind::kString:
+        return PyUnicode_FromStringAndSize(v.s.data(), v.s.size());
+      case wire::Value::Kind::kArray:
+        return array_to_py(v.array);
+    }
+    PyErr_SetString(PyExc_RuntimeError, "bad wire value kind");
+    return nullptr;
+  }
+  if (nest.is_list()) {
+    // Lists, matching wire.py decode (nest_to_py uses tuples).
+    PyObject* list = PyList_New(nest.list().size());
+    if (!list) return nullptr;
+    for (size_t i = 0; i < nest.list().size(); ++i) {
+      PyObject* item = value_to_py(nest.list()[i]);
+      if (!item) {
+        Py_DECREF(list);
+        return nullptr;
+      }
+      PyList_SET_ITEM(list, i, item);
+    }
+    return list;
+  }
+  PyObject* dict = PyDict_New();
+  if (!dict) return nullptr;
+  for (const auto& [key, sub] : nest.dict()) {
+    PyObject* item = value_to_py(sub);
+    if (!item || PyDict_SetItemString(dict, key.c_str(), item) < 0) {
+      Py_XDECREF(item);
+      Py_DECREF(dict);
+      return nullptr;
+    }
+    Py_DECREF(item);
+  }
+  return dict;
+}
+
 // Run fn with the GIL released, catching C++ exceptions INSIDE the no-GIL
 // region (an exception unwinding past Py_END_ALLOW_THREADS would skip the
 // GIL re-acquire and corrupt the interpreter). Returns false with the
@@ -319,6 +557,37 @@ PyObject* queue_dequeue_many(PyBatchingQueue* self, PyObject*) {
                        static_cast<Py_ssize_t>(result.second.size()));
 }
 
+// Raw-item intake for the host BatchArena (runtime/queues.py contract):
+// one FIFO (inputs, rows) pair, blocking; StopIteration once closed —
+// what lets --superstep_k > 1 drain native rollouts straight into the
+// preallocated [K, T+1, B, ...] arena columns.
+PyObject* queue_dequeue_item(PyBatchingQueue* self, PyObject*) {
+  std::pair<ArrayNest, int64_t> result;
+  auto queue = self->queue;
+  if (!call_nogil([&] { result = queue->dequeue_item(); })) return nullptr;
+  PyObject* nest = nest_to_py(result.first);
+  if (!nest) return nullptr;
+  return Py_BuildValue("(NL)", nest,
+                       static_cast<long long>(result.second));
+}
+
+PyObject* queue_telemetry(PyBatchingQueue* self, PyObject*) {
+  auto queue = self->queue;
+  tbt::HistSnapshot wait = queue->dequeue_wait_snapshot(/*reset=*/true);
+  tbt::HistSnapshot sizes = queue->batch_size_snapshot(/*reset=*/true);
+  PyObject* wait_py = hist_to_py(wait);
+  if (!wait_py) return nullptr;
+  PyObject* sizes_py = hist_to_py(sizes);
+  if (!sizes_py) {
+    Py_DECREF(wait_py);
+    return nullptr;
+  }
+  return Py_BuildValue("{s:L,s:L,s:N,s:N}", "items_in",
+                       static_cast<long long>(queue->num_enqueued()),
+                       "depth", static_cast<long long>(queue->size()),
+                       "dequeue_wait_s", wait_py, "batch_size", sizes_py);
+}
+
 PyObject* queue_close(PyBatchingQueue* self, PyObject*) {
   try {
     self->queue->close();
@@ -364,6 +633,10 @@ PyObject* queue_new(PyTypeObject* type, PyObject*, PyObject*) {
 PyMethodDef queue_methods[] = {
     {"enqueue", reinterpret_cast<PyCFunction>(queue_enqueue), METH_O, nullptr},
     {"dequeue_many", reinterpret_cast<PyCFunction>(queue_dequeue_many),
+     METH_NOARGS, nullptr},
+    {"dequeue_item", reinterpret_cast<PyCFunction>(queue_dequeue_item),
+     METH_NOARGS, nullptr},
+    {"telemetry", reinterpret_cast<PyCFunction>(queue_telemetry),
      METH_NOARGS, nullptr},
     {"close", reinterpret_cast<PyCFunction>(queue_close), METH_NOARGS,
      nullptr},
@@ -503,6 +776,29 @@ PyObject* batcher_is_closed(PyDynamicBatcher* self, PyObject*) {
   return PyBool_FromLong(self->batcher->is_closed());
 }
 
+// Interval snapshot of the per-request stage stamps (enqueue -> batch ->
+// reply) — resets the accumulators, so each call returns THIS interval's
+// aggregates for the driver's monitor-tick fold (runtime/native.py).
+PyObject* batcher_telemetry(PyDynamicBatcher* self, PyObject*) {
+  auto telemetry = self->batcher->telemetry();
+  tbt::HistSnapshot wait = telemetry->request_wait_s.snapshot(true);
+  tbt::HistSnapshot rtt = telemetry->request_rtt_s.snapshot(true);
+  tbt::HistSnapshot sizes = telemetry->batch_size.snapshot(true);
+  PyObject* wait_py = hist_to_py(wait);
+  PyObject* rtt_py = wait_py ? hist_to_py(rtt) : nullptr;
+  PyObject* sizes_py = rtt_py ? hist_to_py(sizes) : nullptr;
+  if (!sizes_py) {
+    Py_XDECREF(wait_py);
+    Py_XDECREF(rtt_py);
+    return nullptr;
+  }
+  return Py_BuildValue(
+      "{s:L,s:L,s:N,s:N,s:N}", "batches",
+      static_cast<long long>(telemetry->batches.load()), "rows",
+      static_cast<long long>(telemetry->rows.load()), "request_wait_s",
+      wait_py, "request_rtt_s", rtt_py, "batch_size", sizes_py);
+}
+
 void batcher_dealloc(PyDynamicBatcher* self) {
   self->batcher.~shared_ptr();
   Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
@@ -518,6 +814,8 @@ PyObject* batcher_new(PyTypeObject* type, PyObject*, PyObject*) {
 PyMethodDef batcher_methods[] = {
     {"compute", reinterpret_cast<PyCFunction>(batcher_compute), METH_O,
      nullptr},
+    {"telemetry", reinterpret_cast<PyCFunction>(batcher_telemetry),
+     METH_NOARGS, nullptr},
     {"close", reinterpret_cast<PyCFunction>(batcher_close), METH_NOARGS,
      nullptr},
     {"size", reinterpret_cast<PyCFunction>(batcher_size), METH_NOARGS,
@@ -531,19 +829,81 @@ PyTypeObject PyDynamicBatcherType = {
 };
 
 // --- ActorPool
+
+// Slot hooks (slot framing, ISSUE 9): the C++ loops drive the SAME
+// Python DeviceStateTable the Python pool uses, taking the GIL only at
+// stream (re)connect (reset) and once per unroll boundary (read_slot) —
+// never per step. Conversion borrows the returned numpy buffers
+// refcounted (py_owner), so no copy is paid either.
+[[noreturn]] void throw_py_error();
+
+tbt::ActorPool::SlotHook make_slot_reset(std::shared_ptr<void> table_ref) {
+  return [table_ref](int64_t slot) -> ArrayNest {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    ArrayNest out;
+    try {
+      PyObject* table = static_cast<PyObject*>(table_ref.get());
+      PyObject* ids = Py_BuildValue("[L]", static_cast<long long>(slot));
+      if (!ids) throw_py_error();
+      PyObject* r = PyObject_CallMethod(table, "reset", "O", ids);
+      Py_DECREF(ids);
+      if (!r) throw_py_error();
+      Py_DECREF(r);
+      PyObject* initial =
+          PyObject_GetAttrString(table, "initial_state_host");
+      if (!initial) throw_py_error();
+      ArrayNest nest;
+      bool ok = nest_from_py(initial, &nest);
+      Py_DECREF(initial);
+      if (!ok) throw_py_error();
+      out = std::move(nest);
+    } catch (...) {
+      PyGILState_Release(gil);
+      throw;
+    }
+    PyGILState_Release(gil);
+    return out;
+  };
+}
+
+tbt::ActorPool::SlotHook make_slot_read(std::shared_ptr<void> table_ref) {
+  return [table_ref](int64_t slot) -> ArrayNest {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    ArrayNest out;
+    try {
+      PyObject* table = static_cast<PyObject*>(table_ref.get());
+      PyObject* piece = PyObject_CallMethod(
+          table, "read_slot", "L", static_cast<long long>(slot));
+      if (!piece) throw_py_error();
+      ArrayNest nest;
+      bool ok = nest_from_py(piece, &nest);
+      Py_DECREF(piece);
+      if (!ok) throw_py_error();
+      out = std::move(nest);
+    } catch (...) {
+      PyGILState_Release(gil);
+      throw;
+    }
+    PyGILState_Release(gil);
+    return out;
+  };
+}
+
 int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
   static const char* kwlist[] = {
       "unroll_length",     "learner_queue", "inference_batcher",
       "env_server_addresses", "initial_agent_state", "connect_timeout_s",
-      "max_reconnects", nullptr};
+      "max_reconnects", "state_table", "max_frame_bytes", nullptr};
   long long unroll_length = 0, max_reconnects = 0;
   PyObject *queue_obj, *batcher_obj, *addresses_obj, *state_obj;
+  PyObject* table_obj = Py_None;
+  PyObject* max_frame_obj = Py_None;
   double connect_timeout_s = 600;
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "LO!O!OO|dL", const_cast<char**>(kwlist),
+          args, kwargs, "LO!O!OO|dLOO", const_cast<char**>(kwlist),
           &unroll_length, &PyBatchingQueueType, &queue_obj,
           &PyDynamicBatcherType, &batcher_obj, &addresses_obj, &state_obj,
-          &connect_timeout_s, &max_reconnects))
+          &connect_timeout_s, &max_reconnects, &table_obj, &max_frame_obj))
     return -1;
   std::vector<std::string> addresses;
   PyObject* seq = PySequence_Fast(addresses_obj, "addresses must be a sequence");
@@ -558,17 +918,50 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
     addresses.push_back(PyUnicode_AsUTF8(item));
   }
   Py_DECREF(seq);
+  size_t max_frame_bytes = tbt::wire::kMaxFrameBytes;
+  if (max_frame_obj != Py_None) {
+    long long n = PyLong_AsLongLong(max_frame_obj);
+    if (PyErr_Occurred()) return -1;
+    // Honor any explicit value, like wire._frame_limit: 0 (or negative,
+    // clamped to 0 here) rejects every frame, surfacing the
+    // misconfiguration instead of silently running with the default.
+    max_frame_bytes = n > 0 ? static_cast<size_t>(n) : 0;
+  }
   ArrayNest state;
   if (!nest_from_py(state_obj, &state)) return -1;
   try {
     // Deep-copy the state: actor threads use it GIL-free.
     ArrayNest owned = state.map([](const Array& a) { return a.clone(); });
+    bool use_slots = table_obj != Py_None;
+    tbt::ActorPool::SlotHook slot_reset, slot_read;
+    if (use_slots) {
+      // Same guard as the Python pool (actor_pool.py): actor i owns
+      // slot i, so an undersized table would silently alias slots
+      // (jax gather clamps / scatter drops out-of-bounds indices).
+      PyObject* num_slots_obj = PyObject_GetAttrString(table_obj, "num_slots");
+      if (!num_slots_obj) return -1;
+      long long num_slots = PyLong_AsLongLong(num_slots_obj);
+      Py_DECREF(num_slots_obj);
+      if (PyErr_Occurred()) return -1;
+      if (num_slots < static_cast<long long>(addresses.size())) {
+        PyErr_Format(PyExc_ValueError,
+                     "state table has %lld slots for %zd actors", num_slots,
+                     addresses.size());
+        return -1;
+      }
+      // The hooks share one owning reference to the table, dropped
+      // (under the GIL) when the pool itself is destroyed.
+      std::shared_ptr<void> table_ref = py_owner(table_obj);
+      slot_reset = make_slot_reset(table_ref);
+      slot_read = make_slot_read(table_ref);
+    }
     self->pool = std::make_shared<tbt::ActorPool>(
         unroll_length,
         reinterpret_cast<PyBatchingQueue*>(queue_obj)->queue,
         reinterpret_cast<PyDynamicBatcher*>(batcher_obj)->batcher,
         std::move(addresses), std::move(owned), connect_timeout_s,
-        max_reconnects);
+        max_reconnects, use_slots, std::move(slot_reset),
+        std::move(slot_read), max_frame_bytes);
     return 0;
   } catch (...) {
     set_py_error();
@@ -588,6 +981,18 @@ PyObject* pool_count(PyActorPool* self, PyObject*) {
 
 PyObject* pool_reconnect_count(PyActorPool* self, PyObject*) {
   return PyLong_FromLongLong(self->pool->reconnect_count());
+}
+
+// Cumulative wire/step counters — the driver folds tick deltas into the
+// telemetry registry (runtime/native.py NativeTelemetryFolder).
+PyObject* pool_telemetry(PyActorPool* self, PyObject*) {
+  tbt::ActorPool::Telemetry t = self->pool->telemetry();
+  return Py_BuildValue("{s:L,s:L,s:L,s:L,s:L}", "env_steps",
+                       static_cast<long long>(t.env_steps), "connects",
+                       static_cast<long long>(t.connects), "reconnects",
+                       static_cast<long long>(t.reconnects), "bytes_up",
+                       static_cast<long long>(t.bytes_up), "bytes_down",
+                       static_cast<long long>(t.bytes_down));
 }
 
 PyObject* pool_first_error_message(PyActorPool* self, PyObject*) {
@@ -615,6 +1020,8 @@ PyMethodDef pool_methods[] = {
      reinterpret_cast<PyCFunction>(pool_first_error_message), METH_NOARGS,
      nullptr},
     {"reconnect_count", reinterpret_cast<PyCFunction>(pool_reconnect_count),
+     METH_NOARGS, nullptr},
+    {"telemetry", reinterpret_cast<PyCFunction>(pool_telemetry),
      METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr}};
 
@@ -893,10 +1300,113 @@ PyMethodDef env_server_methods[] = {
      nullptr},
     {nullptr, nullptr, 0, nullptr}};
 
+// ------------------------------------------------- module functions
+// Cross-language codec pins: encode/decode through the C++ wire codec,
+// full frame bytes (u32 header included). tests/test_native.py asserts
+// wire_encode(x) == wire.encode(x) and wire.decode round-trips both
+// ways, which pins tags/dtypes/layout in ANGER (beastlint WIRE-PARITY
+// pins them textually).
+PyObject* py_wire_encode(PyObject*, PyObject* arg) {
+  tbt::wire::ValueNest value;
+  if (!py_to_value(arg, &value)) return nullptr;
+  try {
+    std::vector<uint8_t> framed = tbt::wire::encode(value);
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(framed.data()),
+        static_cast<Py_ssize_t>(framed.size()));
+  } catch (...) {
+    set_py_error();
+    return nullptr;
+  }
+}
+
+PyObject* py_wire_decode(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO) != 0) return nullptr;
+  PyObject* out = nullptr;
+  try {
+    const uint8_t* data = static_cast<const uint8_t*>(view.buf);
+    size_t size = static_cast<size_t>(view.len);
+    if (size < 4) throw tbt::wire::WireError("wire: truncated frame");
+    uint32_t length = tbt::shm::load_u32le(data);
+    if (length != size - 4)
+      throw tbt::wire::WireError("wire: frame length mismatch");
+    // Deep-copy into an owned buffer so decoded arrays outlive `arg`.
+    auto payload = std::make_shared<std::vector<uint8_t>>(
+        data + 4, data + size);
+    tbt::wire::ValueNest value =
+        tbt::wire::decode(payload->data(), payload->size(), payload);
+    out = value_to_py(value);
+  } catch (...) {
+    set_py_error();
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+// Native-transport RTT bench (benchmarks/wire_bench.py native rows): the
+// C++ client stack end to end — connect (tcp/unix/shm incl. handshake),
+// read the initial step, then action-down/step-up round trips for
+// `seconds`, entirely GIL-free. Returns (iters, elapsed_s).
+PyObject* py_bench_client_rtt(PyObject*, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {"address", "seconds", "warmup", nullptr};
+  const char* address;
+  double seconds = 1.0;
+  long long warmup = 50;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "s|dL",
+                                   const_cast<char**>(kwlist), &address,
+                                   &seconds, &warmup))
+    return nullptr;
+  long long iters = 0;
+  double elapsed = 0.0;
+  bool ok = call_nogil([&] {
+    auto t = tbt::shm::connect_transport(address, 30.0);
+    t->recv();  // initial step
+    tbt::wire::ValueNest::Dict action;
+    action.emplace("type",
+                   tbt::wire::ValueNest(tbt::wire::Value::of_string("action")));
+    action.emplace("action",
+                   tbt::wire::ValueNest(tbt::wire::Value::of_int(1)));
+    tbt::wire::ValueNest action_msg(std::move(action));
+    for (long long i = 0; i < warmup; ++i) {
+      t->send(action_msg);
+      t->recv();
+    }
+    auto t0 = std::chrono::steady_clock::now();
+    auto deadline = t0 + std::chrono::duration_cast<
+                             std::chrono::steady_clock::duration>(
+                             std::chrono::duration<double>(seconds));
+    while (std::chrono::steady_clock::now() < deadline) {
+      t->send(action_msg);
+      t->recv();
+      ++iters;
+    }
+    elapsed = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+    t->unlink_segments();
+    t->close();
+  });
+  if (!ok) return nullptr;
+  return Py_BuildValue("(Ld)", iters, elapsed);
+}
+
 // ---------------------------------------------------------------- module
+PyMethodDef module_functions[] = {
+    {"wire_encode", reinterpret_cast<PyCFunction>(py_wire_encode), METH_O,
+     nullptr},
+    {"wire_decode", reinterpret_cast<PyCFunction>(py_wire_decode), METH_O,
+     nullptr},
+    {"bench_client_rtt",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)()>(py_bench_client_rtt)),
+     METH_VARARGS | METH_KEYWORDS, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
 PyModuleDef module_def = {
     PyModuleDef_HEAD_INIT, "_tbt_core",
-    "Native runtime core (queues, dynamic batcher, actor pool)", -1, nullptr,
+    "Native runtime core (queues, dynamic batcher, actor pool)", -1,
+    module_functions,
 };
 
 void init_type(PyTypeObject* type, const char* name, size_t basicsize,

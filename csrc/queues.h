@@ -6,10 +6,14 @@
 
 #pragma once
 
+#include <atomic>
+#include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <deque>
 #include <functional>
 #include <future>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <optional>
@@ -22,6 +26,72 @@
 namespace tbt {
 
 using ArrayNest = Nest<Array>;
+
+// ------------------------------------------------------------ telemetry
+// Log-bucket histogram accumulator with the SAME bucket geometry as
+// torchbeast_tpu/telemetry/metrics.py (LO=1e-9, growth 2^0.25), so the
+// Python driver can fold native snapshots straight into registry
+// histograms bucket-for-bucket. Mutex-guarded: observations here happen
+// at batch cadence (or per request on ms-scale operations), so a ~100ns
+// lock is noise — and snapshot(reset=true) hands the driver exact
+// interval aggregates without a torn read.
+inline int telemetry_bucket_index(double value) {
+  constexpr double kLo = 1e-9;
+  static const double kLogGrowth = std::log(std::pow(2.0, 0.25));
+  if (value <= kLo) return 0;
+  return 1 + static_cast<int>(std::log(value / kLo) / kLogGrowth);
+}
+
+struct HistSnapshot {
+  int64_t count = 0;
+  double total = 0.0;
+  double total_sq = 0.0;
+  double min = 0.0;
+  double max = 0.0;
+  std::map<int, int64_t> buckets;
+};
+
+class HistAccum {
+ public:
+  void observe(double value) {
+    std::lock_guard<std::mutex> lock(mu_);
+    ++count_;
+    total_ += value;
+    total_sq_ += value * value;
+    if (count_ == 1 || value < min_) min_ = value;
+    if (count_ == 1 || value > max_) max_ = value;
+    ++buckets_[telemetry_bucket_index(value)];
+  }
+
+  // Interval aggregate; reset=true starts a fresh interval (the
+  // driver's monitor-tick fold — the registry owns the cumulative view).
+  HistSnapshot snapshot(bool reset = false) {
+    std::lock_guard<std::mutex> lock(mu_);
+    HistSnapshot out{count_, total_, total_sq_, min_, max_, buckets_};
+    if (reset) {
+      count_ = 0;
+      total_ = total_sq_ = min_ = max_ = 0.0;
+      buckets_.clear();
+    }
+    return out;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  int64_t count_ = 0;
+  double total_ = 0.0, total_sq_ = 0.0, min_ = 0.0, max_ = 0.0;
+  std::map<int, int64_t> buckets_;
+};
+
+// Per-request pipeline stamps (ISSUE 2 parity): enqueue -> batch ->
+// reply. Shared by the batcher and its in-flight Batches.
+struct BatcherTelemetry {
+  std::atomic<int64_t> batches{0};
+  std::atomic<int64_t> rows{0};
+  HistAccum batch_size;
+  HistAccum request_wait_s;  // enqueue -> picked into a batch
+  HistAccum request_rtt_s;   // enqueue -> outputs distributed
+};
 
 class ClosedBatchingQueue : public std::runtime_error {
  public:
@@ -110,6 +180,7 @@ class BatchingQueue {
   // Blocks for >= min rows (or any after timeout). Throws QueueStopped when
   // closed and drained.
   std::pair<ArrayNest, std::vector<Payload>> dequeue_many() {
+    auto t0 = std::chrono::steady_clock::now();
     std::vector<Item> items;
     {
       std::unique_lock<std::mutex> lock(mu_);
@@ -160,15 +231,56 @@ class BatchingQueue {
       }
       can_enqueue_.notify_all();
     }
+    dequeue_wait_s_.observe(
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count());
     std::vector<ArrayNest> inputs;
     std::vector<Payload> payloads;
     inputs.reserve(items.size());
     payloads.reserve(items.size());
+    int64_t total_rows = 0;
     for (Item& it : items) {
+      total_rows += it.rows;
       inputs.push_back(std::move(it.inputs));
       payloads.push_back(std::move(it.payload));
     }
+    batch_size_.observe(static_cast<double>(total_rows));
     return {batch_nests(inputs, batch_dim_), std::move(payloads)};
+  }
+
+  // One raw (inputs, rows) item in FIFO order, blocking until an item
+  // arrives; QueueStopped once the queue is closed and drained. The
+  // BatchArena's intake (runtime/queues.py dequeue_item): assembly
+  // happens by write-through column copy straight into the host arena,
+  // so this path skips dequeue_many's min-batch wait and batch forming.
+  std::pair<ArrayNest, int64_t> dequeue_item() {
+    auto t0 = std::chrono::steady_clock::now();
+    std::unique_lock<std::mutex> lock(mu_);
+    while (deque_.empty()) {
+      if (closed_) throw QueueStopped("queue closed");
+      can_dequeue_.wait(lock);
+    }
+    Item item = std::move(deque_.front());
+    deque_.pop_front();
+    can_enqueue_.notify_all();
+    lock.unlock();
+    dequeue_wait_s_.observe(
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count());
+    return {std::move(item.inputs), item.rows};
+  }
+
+  int64_t num_enqueued() const {
+    std::unique_lock<std::mutex> lock(mu_);
+    return num_enqueued_;
+  }
+
+  // Interval telemetry for the Python driver's native fold.
+  HistSnapshot dequeue_wait_snapshot(bool reset) {
+    return dequeue_wait_s_.snapshot(reset);
+  }
+  HistSnapshot batch_size_snapshot(bool reset) {
+    return batch_size_.snapshot(reset);
   }
 
   // Returns leftover items; their payloads, so callers can fail promises.
@@ -197,6 +309,8 @@ class BatchingQueue {
   std::deque<Item> deque_;
   bool closed_ = false;
   int64_t num_enqueued_ = 0;
+  HistAccum dequeue_wait_s_;
+  HistAccum batch_size_;
 };
 
 class DynamicBatcher {
@@ -204,14 +318,19 @@ class DynamicBatcher {
   struct Request {
     std::shared_ptr<std::promise<ArrayNest>> promise;
     int64_t rows;
+    // Stage stamps (enqueue -> batch -> reply): set at compute(), read
+    // when the batch forms and when outputs are distributed.
+    std::chrono::steady_clock::time_point enqueued_at;
   };
 
   class Batch {
    public:
-    Batch(int64_t batch_dim, ArrayNest inputs, std::vector<Request> requests)
+    Batch(int64_t batch_dim, ArrayNest inputs, std::vector<Request> requests,
+          std::shared_ptr<BatcherTelemetry> telemetry = nullptr)
         : batch_dim_(batch_dim),
           inputs_(std::move(inputs)),
-          requests_(std::move(requests)) {}
+          requests_(std::move(requests)),
+          telemetry_(std::move(telemetry)) {}
 
     ~Batch() {
       if (!outputs_set_) {
@@ -243,12 +362,17 @@ class DynamicBatcher {
       });
       if (!any) throw std::invalid_argument("empty output");
       outputs_set_ = true;
+      auto now = std::chrono::steady_clock::now();
       int64_t offset = 0;
       for (Request& r : requests_) {
         int64_t start = offset, count = r.rows;
         ArrayNest mine = outputs.map([&](const Array& a) {
           return slice(a, batch_dim_, start, count);
         });
+        if (telemetry_) {
+          telemetry_->request_rtt_s.observe(
+              std::chrono::duration<double>(now - r.enqueued_at).count());
+        }
         r.promise->set_value(std::move(mine));
         offset += count;
       }
@@ -267,6 +391,7 @@ class DynamicBatcher {
     int64_t batch_dim_;
     ArrayNest inputs_;
     std::vector<Request> requests_;
+    std::shared_ptr<BatcherTelemetry> telemetry_;
     bool outputs_set_ = false;
   };
 
@@ -274,17 +399,22 @@ class DynamicBatcher {
                  int64_t max_batch_size, std::optional<int64_t> timeout_ms)
       : batch_dim_(batch_dim),
         queue_(batch_dim, min_batch_size, max_batch_size, timeout_ms,
-               std::nullopt, /*check_inputs=*/true) {}
+               std::nullopt, /*check_inputs=*/true),
+        telemetry_(std::make_shared<BatcherTelemetry>()) {}
 
   int64_t size() const { return queue_.size(); }
   bool is_closed() const { return queue_.is_closed(); }
+
+  // Interval snapshot for the Python driver's native-telemetry fold.
+  std::shared_ptr<BatcherTelemetry> telemetry() { return telemetry_; }
 
   ArrayNest compute(ArrayNest inputs,
                     int64_t timeout_s = 600 /* reference: 10 min */) {
     int64_t rows = inputs.front().dim(batch_dim_);
     if (rows > queue_.max_batch_size())
       throw std::invalid_argument("compute() exceeds maximum_batch_size");
-    Request req{std::make_shared<std::promise<ArrayNest>>(), rows};
+    Request req{std::make_shared<std::promise<ArrayNest>>(), rows,
+                std::chrono::steady_clock::now()};
     auto future = req.promise->get_future();
     queue_.enqueue(std::move(inputs), std::move(req));
     if (future.wait_for(std::chrono::seconds(timeout_s)) ==
@@ -297,8 +427,18 @@ class DynamicBatcher {
   // Blocks; throws QueueStopped when closed.
   std::unique_ptr<Batch> get_batch() {
     auto [inputs, requests] = queue_.dequeue_many();
+    auto now = std::chrono::steady_clock::now();
+    int64_t rows = 0;
+    for (const Request& r : requests) {
+      rows += r.rows;
+      telemetry_->request_wait_s.observe(
+          std::chrono::duration<double>(now - r.enqueued_at).count());
+    }
+    telemetry_->batches.fetch_add(1);
+    telemetry_->rows.fetch_add(rows);
+    telemetry_->batch_size.observe(static_cast<double>(rows));
     return std::make_unique<Batch>(batch_dim_, std::move(inputs),
-                                   std::move(requests));
+                                   std::move(requests), telemetry_);
   }
 
   void close() {
@@ -312,6 +452,7 @@ class DynamicBatcher {
  private:
   int64_t batch_dim_;
   BatchingQueue<Request> queue_;
+  std::shared_ptr<BatcherTelemetry> telemetry_;
 };
 
 }  // namespace tbt

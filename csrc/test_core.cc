@@ -11,11 +11,14 @@
 #include <thread>
 #include <vector>
 
+#include <sys/socket.h>
+
 #include "array.h"
 #include "client.h"
 #include "env_server.h"
 #include "nest.h"
 #include "queues.h"
+#include "shm.h"
 #include "wire.h"
 
 using namespace tbt;
@@ -363,6 +366,187 @@ static void test_dynamic_batcher() {
 }
 
 
+// Raw-item FIFO intake (the BatchArena path: --superstep_k native).
+static void test_batching_queue_dequeue_item() {
+  BatchingQueue<int> queue(1, 1, 8, {}, {}, true);
+  for (int i = 0; i < 3; ++i) {
+    queue.enqueue(ArrayNest(make_array(DType::kI64, {2, 1}, i)), i);
+  }
+  for (int i = 0; i < 3; ++i) {
+    auto [inputs, rows] = queue.dequeue_item();
+    CHECK(rows == 1);  // rows along batch_dim=1
+    CHECK(reinterpret_cast<const int64_t*>(inputs.front().data())[0] == i);
+  }
+  queue.close();
+  CHECK_THROWS(queue.dequeue_item(), QueueStopped);
+  std::printf("batching queue dequeue_item ok\n");
+}
+
+// Batcher stage stamps: request_wait/rtt/batch_size accumulate and
+// snapshot(reset) starts a fresh interval.
+static void test_batcher_telemetry() {
+  DynamicBatcher batcher(0, 1, 64, 20);
+  std::thread producer([&batcher] {
+    batcher.compute(ArrayNest(make_array(DType::kI64, {1, 2}, 3)));
+  });
+  auto batch = batcher.get_batch();
+  batch->set_outputs(batch->inputs());
+  producer.join();
+  auto telemetry = batcher.telemetry();
+  CHECK(telemetry->batches.load() == 1);
+  CHECK(telemetry->rows.load() == 1);
+  HistSnapshot wait = telemetry->request_wait_s.snapshot(true);
+  CHECK(wait.count == 1);
+  CHECK(wait.total >= 0.0);
+  CHECK(telemetry->request_wait_s.snapshot(false).count == 0);  // reset
+  HistSnapshot rtt = telemetry->request_rtt_s.snapshot(false);
+  CHECK(rtt.count == 1);
+  CHECK(rtt.total >= wait.total);
+  // Bucket geometry matches telemetry/metrics.py: 1e-3 lands in bucket
+  // 1 + floor(log(1e-3/1e-9)/log(2^0.25)) = 80.
+  CHECK(telemetry_bucket_index(1e-3) == 80);
+  CHECK(telemetry_bucket_index(0.0) == 0);
+  batcher.close();
+  std::printf("batcher telemetry ok\n");
+}
+
+// SPSC ring: frame roundtrip, wrap at the segment end, inline marker,
+// ring-eligibility cap.
+static void test_shm_ring_roundtrip() {
+  shm::ShmRing ring = shm::ShmRing::create(256);
+  CHECK(ring.capacity() == 256);
+  CHECK(ring.max_frame_bytes() == 256 / 2 - 4);
+
+  // Attach sees the same bytes.
+  shm::ShmRing peer = shm::ShmRing::attach(ring.name());
+  CHECK(peer.capacity() == 256);
+
+  auto write = [&](const std::vector<uint8_t>& payload) {
+    ring.write_frame(payload.data(), payload.size(), nullptr);
+  };
+  auto read_check = [&](const std::vector<uint8_t>& expected) {
+    CHECK(peer.has_frame());
+    shm::ShmRing::Frame f = peer.read_frame();
+    CHECK(!f.is_inline);
+    CHECK(f.size == expected.size());
+    CHECK(std::memcmp(f.data, expected.data(), f.size) == 0);
+    peer.release(f.advance);
+  };
+
+  // Enough frames to wrap several times.
+  for (int round = 0; round < 40; ++round) {
+    std::vector<uint8_t> payload(37 + (round % 50));
+    for (size_t i = 0; i < payload.size(); ++i)
+      payload[i] = static_cast<uint8_t>(round + i);
+    write(payload);
+    read_check(payload);
+  }
+
+  // Inline marker holds the order slot.
+  std::vector<uint8_t> small{1, 2, 3};
+  write(small);
+  ring.write_inline_marker(nullptr);
+  write(small);
+  read_check(small);
+  shm::ShmRing::Frame f = peer.read_frame();
+  CHECK(f.is_inline);
+  peer.release(f.advance);
+  read_check(small);
+  CHECK(!peer.has_frame());
+
+  // Over-capacity frames are rejected outright.
+  std::vector<uint8_t> huge(300);
+  CHECK_THROWS(ring.write_frame(huge.data(), huge.size(), nullptr),
+               wire::WireError);
+  peer.close();
+  ring.close();
+  std::printf("shm ring roundtrip ok\n");
+}
+
+static wire::ValueNest step_like_message(int64_t tag, int64_t frame_cells) {
+  wire::ValueNest::Dict d;
+  d.emplace("type", wire::ValueNest(wire::Value::of_string("step")));
+  d.emplace("frame", wire::ValueNest(wire::Value::of(
+                         make_array(DType::kU8, {frame_cells}, tag & 0xff))));
+  d.emplace("reward", wire::ValueNest(wire::Value::of(
+                          make_array(DType::kF32, {}, tag))));
+  d.emplace("count", wire::ValueNest(wire::Value::of_int(tag)));
+  return wire::ValueNest(std::move(d));
+}
+
+// Full transport pair over a socketpair doorbell: ordering and contents
+// across ring frames AND oversized inline frames, both directions.
+static void test_shm_ring_transport() {
+  int fds[2];
+  CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, fds) == 0);
+  // Small rings force wraps and route big frames inline.
+  shm::ShmRing s2c = shm::ShmRing::create(4096);
+  shm::ShmRing c2s = shm::ShmRing::create(1024);
+  shm::ShmRing s2c_peer = shm::ShmRing::attach(s2c.name());
+  shm::ShmRing c2s_peer = shm::ShmRing::attach(c2s.name());
+  shm::ShmTransport server(fds[0], std::move(s2c), std::move(c2s));
+  shm::ShmTransport client(fds[1], std::move(c2s_peer), std::move(s2c_peer));
+
+  constexpr int kMessages = 200;
+  std::thread server_thread([&server] {
+    for (int i = 0; i < kMessages; ++i) {
+      // Every 7th frame is bigger than the obs ring allows -> inline.
+      int64_t cells = (i % 7 == 6) ? 8192 : 64 + i;
+      server.send(step_like_message(i, cells));
+      wire::ValueNest action = server.recv();
+      CHECK(action.dict().at("action").leaf().i == i);
+    }
+  });
+  for (int i = 0; i < kMessages; ++i) {
+    wire::ValueNest step = client.recv();
+    const auto& dict = step.dict();
+    CHECK(dict.at("count").leaf().i == i);
+    int64_t cells = (i % 7 == 6) ? 8192 : 64 + i;
+    const Array& frame = dict.at("frame").leaf().array;
+    CHECK(frame.numel() == cells);
+    CHECK(frame.data()[0] == (i & 0xff));
+    wire::ValueNest::Dict a;
+    a.emplace("type", wire::ValueNest(wire::Value::of_string("action")));
+    a.emplace("action", wire::ValueNest(wire::Value::of_int(i)));
+    client.send(wire::ValueNest(std::move(a)));
+  }
+  server_thread.join();
+  // EOF surfaces as SocketError once the peer closes.
+  server.close();
+  CHECK_THROWS(client.recv(), SocketError);
+  client.close();
+  std::printf("shm ring transport ok (%d messages)\n", kMessages);
+}
+
+// Threaded stress at a rate-matched cadence: the coalesced-doorbell
+// waiting-flag handshake must neither deadlock nor reorder. (TSan lane:
+// build_native.sh --sanitize=thread --filter=ring.)
+static void test_shm_ring_stress() {
+  int fds[2];
+  CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, fds) == 0);
+  shm::ShmRing a = shm::ShmRing::create(2048);
+  shm::ShmRing b = shm::ShmRing::create(2048);
+  shm::ShmRing a_peer = shm::ShmRing::attach(a.name());
+  shm::ShmRing b_peer = shm::ShmRing::attach(b.name());
+  shm::ShmTransport left(fds[0], std::move(a), std::move(b));
+  shm::ShmTransport right(fds[1], std::move(b_peer), std::move(a_peer));
+
+  constexpr int kMessages = 2000;
+  std::thread producer([&left] {
+    for (int i = 0; i < kMessages; ++i) {
+      left.send(step_like_message(i, 16 + (i % 113)));
+    }
+  });
+  for (int i = 0; i < kMessages; ++i) {
+    wire::ValueNest step = right.recv();
+    CHECK(step.dict().at("count").leaf().i == i);
+  }
+  producer.join();
+  left.close();
+  right.close();
+  std::printf("shm ring stress ok (%d messages)\n", kMessages);
+}
+
 void test_env_server() {
   // Counting "env" implemented as hooks: initial -> step 0; each action
   // increments by the action value. A throwing step produces an error
@@ -455,8 +639,13 @@ int main(int argc, char** argv) {
   if (want("wire_malformed")) { test_wire_malformed(); ++ran; }
   if (want("batching_queue")) { test_batching_queue(); ++ran; }
   if (want("batching_queue_timeout")) { test_batching_queue_timeout_zero(); ++ran; }
+  if (want("batching_queue_dequeue_item")) { test_batching_queue_dequeue_item(); ++ran; }
   if (want("queue_stress")) { test_queue_stress(); ++ran; }
   if (want("dynamic_batcher")) { test_dynamic_batcher(); ++ran; }
+  if (want("batcher_telemetry")) { test_batcher_telemetry(); ++ran; }
+  if (want("shm_ring_roundtrip")) { test_shm_ring_roundtrip(); ++ran; }
+  if (want("shm_ring_transport")) { test_shm_ring_transport(); ++ran; }
+  if (want("shm_ring_stress")) { test_shm_ring_stress(); ++ran; }
   if (want("env_server")) { test_env_server(); ++ran; }
   if (ran == 0) {
     std::fprintf(stderr, "no tests match filter '%s'\n", filter);

@@ -11,8 +11,17 @@
 #   --filter=SUBSTR           pass a test-name substring filter through to
 #                             every test_core run (e.g. --filter=wire
 #                             skips the socket tests in sandboxes that
-#                             cannot run them). Applies to the plain,
-#                             --tsan, and --sanitize runs alike.
+#                             cannot run them). REPEATABLE: each filter
+#                             gets its own run of the binary, so the
+#                             documented TSan lane
+#                             --sanitize=thread --filter={queue,atch,ring}
+#                             (brace expansion = three --filter args)
+#                             covers all three suites. Applies to the
+#                             plain, --tsan, and --sanitize runs alike.
+#   --smoke                   the native-parity CI lane in one command:
+#                             build + run the filtered suites (queue,
+#                             atch, ring, wire, array, nest) plain AND
+#                             under TSan, then build the extension.
 #
 # The sanitized binaries land in build/test_core_<sanitizer>; the slow
 # smoke test in tests/test_native.py drives --sanitize=address/undefined
@@ -21,20 +30,60 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p build
 
+# shm_open/shm_unlink live in librt on this image's glibc (<2.34); newer
+# glibcs keep an empty librt, so linking it is portable both ways.
+LIBS=(-lrt)
+
 SANITIZE=""
-FILTER=""
+FILTERS=()
 TSAN=0
+SMOKE=0
 for arg in "$@"; do
     case "$arg" in
         --tsan) TSAN=1 ;;
+        --smoke) SMOKE=1 ;;
         --sanitize=*) SANITIZE="${arg#--sanitize=}" ;;
-        --filter=*) FILTER="${arg#--filter=}" ;;
+        --filter=*) FILTERS+=("${arg#--filter=}") ;;
         *)
             echo "unknown argument: $arg" >&2
             exit 2
             ;;
     esac
 done
+
+run_filtered() {
+    # Run $1 once per filter (or once unfiltered when none given).
+    local binary="$1"
+    if [[ ${#FILTERS[@]} -eq 0 ]]; then
+        "$binary"
+    else
+        for f in "${FILTERS[@]}"; do
+            "$binary" "$f"
+        done
+    fi
+}
+
+if [[ "$SMOKE" == 1 ]]; then
+    # One-command native-parity lane: every suite that runs in a plain
+    # sandbox (the env_server socket suite needs a working accept(),
+    # which some sandboxes lack), plain + TSan, then the extension.
+    # "batcher" (not "atch"): strstr filtering makes "atch" also match
+    # the batching_queue tests, which "queue" already runs.
+    FILTERS=(queue batcher ring wire array nest)
+    echo "== C++ core tests (smoke)"
+    g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core \
+        "${LIBS[@]}"
+    run_filtered ./build/test_core
+    echo "== C++ core tests (smoke, ThreadSanitizer)"
+    g++ -std=c++17 -O1 -g -Wall -pthread -fsanitize=thread \
+        csrc/test_core.cc -o build/test_core_tsan "${LIBS[@]}"
+    run_filtered ./build/test_core_tsan
+    echo "== Python extension"
+    touch csrc/pymodule.cc  # setuptools doesn't track header deps
+    python setup.py build_ext --inplace --build-temp build/ext
+    python -c "import _tbt_core; print('extension OK:', _tbt_core.__file__)"
+    exit 0
+fi
 
 if [[ -n "$SANITIZE" ]]; then
     case "$SANITIZE" in
@@ -52,20 +101,21 @@ if [[ -n "$SANITIZE" ]]; then
     fi
     g++ -std=c++17 -O1 -g -Wall -pthread "-fsanitize=${SANITIZE}" \
         "${EXTRA[@]+"${EXTRA[@]}"}" \
-        csrc/test_core.cc -o "build/test_core_${SANITIZE}"
-    "./build/test_core_${SANITIZE}" ${FILTER:+"$FILTER"}
+        csrc/test_core.cc -o "build/test_core_${SANITIZE}" "${LIBS[@]}"
+    run_filtered "./build/test_core_${SANITIZE}"
     exit 0
 fi
 
 echo "== C++ core tests"
-g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core
-./build/test_core ${FILTER:+"$FILTER"}
+g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core \
+    "${LIBS[@]}"
+run_filtered ./build/test_core
 
 if [[ "$TSAN" == 1 ]]; then
     echo "== C++ core tests (ThreadSanitizer)"
     g++ -std=c++17 -O1 -g -Wall -pthread -fsanitize=thread \
-        csrc/test_core.cc -o build/test_core_tsan
-    ./build/test_core_tsan ${FILTER:+"$FILTER"}
+        csrc/test_core.cc -o build/test_core_tsan "${LIBS[@]}"
+    run_filtered ./build/test_core_tsan
 fi
 
 echo "== Python extension"

@@ -19,6 +19,10 @@ setup(
             sources=["csrc/pymodule.cc"],
             include_dirs=["csrc", numpy.get_include()],
             extra_compile_args=["-std=c++17", "-O2", "-Wall", "-pthread"],
+            # shm_open/shm_unlink live in librt on this image's glibc
+            # (< 2.34; newer glibcs keep an empty librt, so this is
+            # portable both ways).
+            libraries=["rt"],
             language="c++",
         )
     ],

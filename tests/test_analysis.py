@@ -24,6 +24,7 @@ from torchbeast_tpu.analysis.parity import (
     FlagParityRule,
     WireParityRule,
     check_flag_parity,
+    check_ring_parity,
     check_wire_parity,
 )
 from torchbeast_tpu.analysis.selftest import run_selftest
@@ -607,6 +608,128 @@ class TestWireParity:
         assert codes.get("bfloat16") == 12 and len(codes) == 13
         assert max_frame == 256 * 1024 * 1024
         assert tags["ARRAY"] == 1 and len(tags) == 8
+
+
+class TestRingParity:
+    """WIRE-PARITY's shm ring-layout arm (ISSUE 9 satellite): the drift
+    check PR 5 flagged as missing — header word layout, wrap/inline
+    markers, doorbell bytes, and the capacity//2-4 eligibility cap
+    pinned py<->C++, with unparseable sides surfacing as findings."""
+
+    TRANSPORT_PY = (
+        '_DOORBELL_WAKE = b"\\x01"\n'
+        '_DOORBELL_INLINE = b"\\x02"\n'
+        "class ShmRing:\n"
+        "    HEADER_BYTES = 64\n"
+        "    _WRAP = 0xFFFFFFFF\n"
+        "    _INLINE = 0xFFFFFFFE\n"
+        "    _HEAD, _TAIL, _CAP, _WAITING = 0, 1, 2, 3\n"
+        "    def max_frame_bytes(self):\n"
+        "        return self._capacity // 2 - 4\n"
+    )
+    SHM_H = (
+        "constexpr size_t kRingHeaderBytes = 64;\n"
+        "constexpr size_t kRingHeadWord = 0;\n"
+        "constexpr size_t kRingTailWord = 1;\n"
+        "constexpr size_t kRingCapacityWord = 2;\n"
+        "constexpr size_t kRingWaitingWord = 3;\n"
+        "constexpr uint32_t kRingWrapMarker = 0xFFFFFFFF;\n"
+        "constexpr uint32_t kRingInlineMarker = 0xFFFFFFFE;\n"
+        "constexpr uint8_t kDoorbellWake = 0x01;\n"
+        "constexpr uint8_t kDoorbellInline = 0x02;\n"
+        "size_t max_frame_bytes() const { return capacity_ / 2 - 4; }\n"
+    )
+
+    def _ctx(self, src):
+        return FileContext("torchbeast_tpu/runtime/transport.py", src)
+
+    def test_matched_layout_clean(self):
+        assert not check_ring_parity(self._ctx(self.TRANSPORT_PY),
+                                     self.SHM_H)
+
+    def test_cpp_marker_drift_flagged(self):
+        drifted = self.SHM_H.replace(
+            "kRingInlineMarker = 0xFFFFFFFE", "kRingInlineMarker = 0xFFFFFFFD"
+        )
+        found = check_ring_parity(self._ctx(self.TRANSPORT_PY), drifted)
+        assert any("inline marker" in f.message for f in found)
+        assert all(f.rule == "WIRE-PARITY" for f in found)
+
+    def test_py_header_drift_flagged(self):
+        drifted = self.TRANSPORT_PY.replace(
+            "HEADER_BYTES = 64", "HEADER_BYTES = 32"
+        )
+        found = check_ring_parity(self._ctx(drifted), self.SHM_H)
+        assert any("header size" in f.message for f in found)
+
+    def test_word_index_drift_flagged(self):
+        drifted = self.TRANSPORT_PY.replace(
+            "_HEAD, _TAIL, _CAP, _WAITING = 0, 1, 2, 3",
+            "_HEAD, _TAIL, _CAP, _WAITING = 0, 2, 1, 3",
+        )
+        found = check_ring_parity(self._ctx(drifted), self.SHM_H)
+        assert any("tail counter" in f.message for f in found)
+        assert any("capacity word" in f.message for f in found)
+
+    def test_eligibility_cap_drift_flagged(self):
+        drifted = self.SHM_H.replace(
+            "capacity_ / 2 - 4", "capacity_ / 4 - 8"
+        )
+        found = check_ring_parity(self._ctx(self.TRANSPORT_PY), drifted)
+        assert any("eligibility" in f.message for f in found)
+
+    def test_doorbell_byte_drift_flagged(self):
+        drifted = self.TRANSPORT_PY.replace(
+            '_DOORBELL_WAKE = b"\\x01"', '_DOORBELL_WAKE = b"\\x03"'
+        )
+        found = check_ring_parity(self._ctx(drifted), self.SHM_H)
+        assert any("WAKE byte" in f.message for f in found)
+
+    def test_unparseable_side_is_a_finding_not_silence(self):
+        found = check_ring_parity(
+            self._ctx("x = 1\n"), self.SHM_H
+        )
+        assert found and any("cannot verify" in f.message for f in found)
+        found = check_ring_parity(
+            self._ctx(self.TRANSPORT_PY), "// nothing here\n"
+        )
+        assert found and any("cannot verify" in f.message for f in found)
+
+    def test_partially_unparseable_field_is_flagged(self):
+        drifted = self.SHM_H.replace(
+            "constexpr uint8_t kDoorbellWake = 0x01;\n", ""
+        )
+        found = check_ring_parity(self._ctx(self.TRANSPORT_PY), drifted)
+        assert any(
+            "WAKE byte" in f.message and "C++ side" in f.message
+            for f in found
+        )
+
+    def test_real_repo_in_anger(self):
+        """transport.py and csrc/shm.h agree RIGHT NOW, and the parse
+        saw every field (no vacuous None==None matches)."""
+        report = analysis.analyze_paths(
+            [lint_config.TRANSPORT_PY], root=REPO
+        )
+        found = _rules(report, "WIRE-PARITY")
+        assert not found, [f.render() for f in found]
+        from torchbeast_tpu.analysis.parity import (
+            parse_cpp_ring,
+            parse_py_ring,
+        )
+
+        ctx = analysis.load_context(
+            os.path.join(REPO, lint_config.TRANSPORT_PY), REPO
+        )
+        ring_py = parse_py_ring(ctx.tree)
+        with open(os.path.join(REPO, lint_config.SHM_H)) as f:
+            ring_cpp = parse_cpp_ring(f.read())
+        assert None not in ring_py.values(), ring_py
+        assert None not in ring_cpp.values(), ring_cpp
+        assert ring_py == ring_cpp
+        assert ring_py["header_bytes"] == 64
+        assert ring_py["eligibility_divisor"] == 2
+        assert ring_py["eligibility_slack"] == 4
 
 
 class TestFlagParity:

@@ -143,7 +143,7 @@ def test_beastlint_selftest_cli():
     assert set(out["rules"]) == {
         "HOTPATH-SYNC", "JIT-HAZARD", "DONATE-USE", "IMPORT-PURITY",
         "LOCK-DISCIPLINE", "EXCEPT-SWALLOW", "WIRE-PARITY",
-        "FLAG-PARITY",
+        "FLAG-PARITY", "RACE", "LOCK-ORDER", "HOTPATH-SYNC-XPROC",
     }
     for checks in out["rules"].values():
         assert set(checks) == {"positive", "clean", "isolated"}
@@ -187,6 +187,21 @@ def test_wire_bench_selftest(tmp_path):
     for key in ("atari_encode_send_speedup", "atari_shm_over_tcp_send",
                 "atari_shm_over_tcp_rtt"):
         assert out["acceptance"][key] > 0
+    # Native rows (ISSUE 9): present whenever _tbt_core is built (it is
+    # in this repo's CI image; a bare checkout records native_skipped).
+    if not out["results"].get("native_skipped"):
+        native = {
+            (r["payload"], r["transport"])
+            for r in out["results"]["rtt_native"]
+        }
+        assert native == {
+            (p, k) for p in ("small", "atari", "atari_raw")
+            for k in ("native_tcp", "native_shm")
+        }
+        for row in out["results"]["rtt_native"]:
+            assert row["msgs_s"] > 0 and row["iters"] > 0
+        assert out["acceptance"][
+            "atari_native_shm_over_python_tcp_rtt"] > 0
 
     # Telemetry block embedded like inference_bench, with the new wire
     # codec histograms populated (encode from the send legs, decode from

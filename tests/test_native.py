@@ -1,7 +1,12 @@
 """Native (_tbt_core) runtime: same semantic surface as the Python
-queues/actor-pool tests, driven through the C extension. Skipped when the
-extension isn't built (scripts/build_native.sh)."""
+queues/actor-pool tests, driven through the C extension — plus the
+ISSUE 9 parity family: slot framing vs the Python pool (bit-identical
+batches), shm transport e2e + crash/reconnect + /dev/shm sweep, the
+cross-language wire codec pins (incl. bf16), the raw-item arena intake,
+and the telemetry fold. Skipped when the extension isn't built
+(scripts/build_native.sh)."""
 
+import multiprocessing as mp
 import os
 import tempfile
 import threading
@@ -313,3 +318,496 @@ def test_native_actor_pool_end_to_end():
             batch["action"][1:], batch["last_action"][1:]
         )
         prev = batch
+
+
+# ---------------------------------------------------------------------------
+# Cross-language wire codec (ISSUE 9): the C++ encode/decode pinned in
+# anger against wire.py — beastlint WIRE-PARITY pins the same contract
+# textually; this executes both stacks on the same bytes.
+
+
+def _norm(v):
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items())}
+    if isinstance(v, (list, tuple)):
+        return [_norm(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return ("array", str(v.dtype), v.shape, v.tobytes())
+    return v
+
+
+def _sorted_keys(v):
+    if isinstance(v, dict):
+        return {k: _sorted_keys(x) for k, x in sorted(v.items())}
+    return v
+
+
+def _codec_messages():
+    rng = np.random.default_rng(7)
+    yield {"type": "step", "frame": rng.integers(0, 255, (4, 3), np.uint8),
+           "reward": np.asarray(np.float32(0.5)), "done": np.asarray(False),
+           "n": 7, "f": 1.5, "s": "hello", "none": None,
+           "lst": [1, 2.0, "x", None, True]}
+    yield {"scalars": [np.int32(3), np.float64(2.5), np.bool_(True)],
+           "empty": np.zeros((0, 5), np.float32),
+           "zerod": np.asarray(np.int64(-9))}
+    yield {"dtypes": [np.zeros(3, dt) for dt in (
+        np.uint8, np.int8, np.int32, np.int64, np.float32, np.float64,
+        np.bool_, np.uint16, np.int16, np.uint32, np.uint64, np.float16)]}
+
+
+def test_wire_codec_cross_language():
+    from torchbeast_tpu.runtime import wire
+
+    for msg in _codec_messages():
+        # Byte-identical frames for sorted-key dicts (C++ dicts iterate
+        # sorted; Python preserves insertion order — the FORMAT is
+        # order-insensitive, both decode either ordering).
+        smsg = _sorted_keys(msg)
+        assert core.wire_encode(smsg) == wire.encode(smsg)
+        # Cross-decode both directions.
+        assert _norm(core.wire_decode(wire.encode(msg))) == _norm(msg)
+        assert _norm(wire.decode(core.wire_encode(msg)[4:])) == _norm(msg)
+
+
+def test_wire_codec_bf16_roundtrip():
+    """bf16 (wire code 12) decodes natively: C++ frame bytes match
+    wire.py's and the payload survives both directions bit-exactly."""
+    import ml_dtypes
+
+    from torchbeast_tpu.runtime import wire
+
+    bf = np.arange(-6, 6, dtype=ml_dtypes.bfloat16).reshape(3, 4)
+    assert core.wire_encode({"x": bf}) == wire.encode({"x": bf})
+    for decoded in (core.wire_decode(wire.encode({"x": bf}))["x"],
+                    wire.decode(core.wire_encode({"x": bf})[4:])["x"]):
+        assert decoded.dtype == np.dtype(ml_dtypes.bfloat16)
+        assert decoded.tobytes() == bf.tobytes()
+
+
+def test_native_queue_carries_bf16():
+    """The batching queue moves bf16 payloads (pymodule conversions both
+    directions) — what --precision bf16_train rides on natively."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    item = {"x": np.arange(8, dtype=bf16).reshape(2, 1, 4)}
+    queue = core.BatchingQueue(batch_dim=1, minimum_batch_size=2)
+    queue.enqueue(item)
+    queue.enqueue({"x": (item["x"] + 1).astype(bf16)})
+    batch, count = queue.dequeue_many()
+    assert count == 2
+    assert batch["x"].dtype == bf16
+    assert batch["x"].shape == (2, 2, 4)
+    np.testing.assert_array_equal(
+        np.asarray(batch["x"][:, 0], np.float32),
+        np.asarray(item["x"][:, 0], np.float32),
+    )
+    queue.close()
+
+
+# ---------------------------------------------------------------------------
+# Raw-item arena intake (--superstep_k native): dequeue_item drains the
+# native queue through the SAME BatchArena the Python runtime uses,
+# bit-identical to the Python queue path.
+
+
+def _rollout_item(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "batch": {
+            "frame": rng.integers(0, 255, (6, 1, 4, 4), np.uint8),
+            "reward": rng.normal(size=(6, 1)).astype(np.float32),
+        },
+        "initial_agent_state": rng.normal(size=(1, 1, 3)).astype(np.float32),
+    }
+
+
+def test_native_arena_intake_bit_identical():
+    from torchbeast_tpu import nest
+    from torchbeast_tpu.runtime.queues import BatchArena, BatchingQueue
+
+    items = [_rollout_item(s) for s in range(4)]
+    native_q = core.BatchingQueue(batch_dim=1, minimum_batch_size=2,
+                                  maximum_batch_size=2)
+    python_q = BatchingQueue(batch_dim=1, minimum_batch_size=2,
+                             maximum_batch_size=2)
+    for item in items:
+        native_q.enqueue(item)
+        python_q.enqueue(item)
+    stacks = []
+    for queue in (native_q, python_q):
+        arena = BatchArena(k=2, rows=2, batch_dim=1)
+        stacked, release = arena.assemble_from(queue)
+        stacks.append([np.asarray(a) for a in nest.flatten(stacked)])
+        release()
+    assert len(stacks[0]) == len(stacks[1])
+    for native_leaf, python_leaf in zip(*stacks):
+        assert native_leaf.dtype == python_leaf.dtype
+        np.testing.assert_array_equal(native_leaf, python_leaf)
+    # Closing the native queue ends assemble_from with StopIteration,
+    # exactly like the Python queue (QueueStopped -> StopIteration).
+    native_q.close()
+    arena = BatchArena(k=2, rows=2, batch_dim=1)
+    with pytest.raises(StopIteration):
+        arena.assemble_from(native_q)
+
+
+# ---------------------------------------------------------------------------
+# Slot framing: the native pool drives a (host-stand-in) slot table
+# through the same {"env", "slot", "advance"} -> {"outputs"} wire
+# contract as the Python pool — and produces bit-identical batches.
+
+
+class _HostSlotTable:
+    """Host-side stand-in for runtime.state_table.DeviceStateTable: the
+    same reset/read_slot/initial_state_host surface the pools use, with
+    state advanced by the serving thread (deterministic, jax-free)."""
+
+    def __init__(self, num_slots):
+        self.num_slots = num_slots
+        self.initial_state_host = {"s": np.zeros((1, 1), np.int64)}
+        self._values = {}
+
+    @property
+    def trash_slot(self):
+        return self.num_slots
+
+    def get(self, slot):
+        return self._values.get(int(slot), 0)
+
+    def set(self, slot, value):
+        self._values[int(slot)] = int(value)
+
+    def reset(self, slots):
+        for s in slots:
+            self._values[int(s)] = 0
+
+    def read_slot(self, slot):
+        return {"s": np.full((1, 1), self.get(slot), np.int64)}
+
+
+def _serve_slot_batcher(batcher, table):
+    """Inference thread body: CountingEnv dynamics over the slot table
+    (state = where(done, 0, prev) + 1), replies carry outputs ONLY."""
+    it = iter(batcher)
+    while True:
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        inputs = batch.get_inputs()
+        slots = np.asarray(inputs["slot"]).reshape(-1)
+        advance = np.asarray(inputs["advance"]).reshape(-1)
+        done = np.asarray(inputs["env"]["done"])[0].astype(bool)
+        prev = np.array([table.get(s) for s in slots], np.int64)
+        new = np.where(done, 0, prev) + 1
+        for j, slot in enumerate(slots):
+            if advance[j]:
+                table.set(slot, new[j])
+        batch.set_outputs({
+            "outputs": {
+                "action": np.zeros((1, len(slots)), np.int32),
+                "policy_logits": new[None, :, None].astype(np.float32),
+                "baseline": new[None].astype(np.float32),
+            }
+        })
+
+
+def _collect_slot_items(pool_kind, address, n_items):
+    """Run one actor through either pool in slot mode; return the first
+    n_items learner items as flat numpy lists."""
+    from torchbeast_tpu import nest
+
+    table = _HostSlotTable(num_slots=1)
+    if pool_kind == "native":
+        learner_queue = core.BatchingQueue(
+            batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+        )
+        batcher = core.DynamicBatcher(batch_dim=1, timeout_ms=20)
+        pool = core.ActorPool(
+            unroll_length=T,
+            learner_queue=learner_queue,
+            inference_batcher=batcher,
+            env_server_addresses=[address],
+            initial_agent_state=table.initial_state_host,
+            state_table=table,
+        )
+    else:
+        from torchbeast_tpu.runtime.actor_pool import ActorPool
+        from torchbeast_tpu.runtime.queues import (
+            BatchingQueue,
+            DynamicBatcher,
+        )
+
+        learner_queue = BatchingQueue(
+            batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+        )
+        batcher = DynamicBatcher(batch_dim=1, timeout_ms=20)
+        pool = ActorPool(
+            unroll_length=T,
+            learner_queue=learner_queue,
+            inference_batcher=batcher,
+            env_server_addresses=[address],
+            initial_agent_state=table.initial_state_host,
+            state_table=table,
+        )
+    serve = threading.Thread(
+        target=_serve_slot_batcher, args=(batcher, table), daemon=True
+    )
+    serve.start()
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+    items = []
+    it = iter(learner_queue)
+    while len(items) < n_items:
+        item = next(it)
+        if not isinstance(item, tuple):
+            items.append(item)
+        else:  # python queue __next__ yields the batch only
+            items.append(item[0])
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    serve.join(5)
+    return [
+        [np.asarray(leaf) for leaf in nest.flatten(item)] for item in items
+    ]
+
+
+def test_native_slot_framing_matches_python_pool():
+    """Bit-identical learner batches: the same env stream + slot table
+    dynamics through the C++ pool and the Python pool. Pins the slot
+    framing wire contract (requests {env, slot, advance}, replies
+    outputs-only, read_slot at unroll boundaries) end to end."""
+    from torchbeast_tpu.envs import CountingEnv
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    items = {}
+    for kind in ("native", "python"):
+        path = os.path.join(tempfile.mkdtemp(), f"slot_{kind}")
+        server = EnvServer(
+            lambda: CountingEnv(episode_length=EPISODE_LEN), f"unix:{path}"
+        )
+        server.start()
+        deadline = time.monotonic() + 10
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                raise TimeoutError("server did not bind")
+            time.sleep(0.01)
+        try:
+            items[kind] = _collect_slot_items(kind, f"unix:{path}", 5)
+        finally:
+            server.stop()
+    assert len(items["native"]) == len(items["python"])
+    for native_item, python_item in zip(items["native"], items["python"]):
+        assert len(native_item) == len(python_item)
+        for native_leaf, python_leaf in zip(native_item, python_item):
+            assert native_leaf.dtype == python_leaf.dtype
+            np.testing.assert_array_equal(native_leaf, python_leaf)
+
+
+# ---------------------------------------------------------------------------
+# shm transport: the native pool over shared-memory rings served by the
+# PYTHON env server (cross-language ring layout in anger), the crash ->
+# reconnect contract, and the /dev/shm sweep.
+
+
+def _start_counting_server_shm(path):
+    from torchbeast_tpu.envs import CountingEnv
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), f"shm:{path}"
+    )
+    server.start()
+    deadline = time.monotonic() + 10
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError("server did not bind")
+        time.sleep(0.01)
+    return server
+
+
+def _run_native_pool(address, max_reconnects=0):
+    learner_queue = core.BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = core.DynamicBatcher(batch_dim=1, timeout_ms=20)
+
+    def inference():
+        it = iter(batcher)
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            inputs = batch.get_inputs()
+            done = inputs["env"]["done"]
+            state = np.where(done, 0, inputs["agent_state"]) + 1
+            batch.set_outputs({
+                "outputs": {
+                    "action": np.zeros_like(done, np.int32),
+                    "policy_logits": state[..., None].astype(np.float32),
+                    "baseline": state.astype(np.float32),
+                },
+                "agent_state": state.astype(np.int64),
+            })
+
+    inf_thread = threading.Thread(target=inference, daemon=True)
+    inf_thread.start()
+    pool = core.ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+        max_reconnects=max_reconnects,
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+    return learner_queue, batcher, pool, pool_thread
+
+
+def test_native_pool_shm_end_to_end():
+    """C++ actor loops over shm rings created by the Python env server:
+    the cross-language ring layout (header words, wrap/inline markers,
+    doorbell bytes) carries real rollouts with the on-policy invariants
+    held."""
+    path = os.path.join(tempfile.mkdtemp(), "native_shm")
+    server = _start_counting_server_shm(path)
+    learner_queue, batcher, pool, pool_thread = _run_native_pool(
+        f"shm:{path}"
+    )
+    items = []
+    it = iter(learner_queue)
+    while len(items) < 5:
+        items.append(next(it))
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    server.stop()
+    assert pool.count() >= 5 * T
+    prev = None
+    for item in items:
+        batch = item["batch"]
+        assert batch["frame"].shape[:2] == (T + 1, 1)
+        if prev is not None:
+            for key in batch:
+                np.testing.assert_array_equal(
+                    batch[key][0], prev[key][-1], err_msg=key
+                )
+        assert (batch["frame"][batch["done"].astype(bool)] == 0).all()
+        prev = batch
+    telemetry = pool.telemetry()
+    assert telemetry["env_steps"] == pool.count()
+    assert telemetry["bytes_up"] > 0
+    assert telemetry["bytes_down"] > 0
+    assert telemetry["connects"] == 1
+
+
+def _shm_segments():
+    if not os.path.isdir("/dev/shm"):
+        return set()
+    return {n for n in os.listdir("/dev/shm")
+            if n.startswith(("psm_", "tbtring_"))}
+
+
+def _spawn_counting_server_proc(path):
+    ctx = mp.get_context("spawn")
+    proc = ctx.Process(
+        target=_serve_counting_shm_child, args=(path,), daemon=True
+    )
+    proc.start()
+    deadline = time.monotonic() + 30
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError("spawned server did not bind")
+        time.sleep(0.05)
+    return proc
+
+
+def _serve_counting_shm_child(path):
+    from torchbeast_tpu.envs import CountingEnv
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    EnvServer(lambda: CountingEnv(episode_length=5), f"shm:{path}").run()
+
+
+@pytest.mark.slow
+def test_native_shm_crash_reconnect_and_sweep():
+    """Crash contract parity with the Python pool: SIGKILL the env
+    server mid-ring — the native actor tears down that one connection,
+    revives it against the restarted server, and its teardown sweep
+    leaves /dev/shm clean (the dead owner never unlinks)."""
+    before = _shm_segments()
+    path = os.path.join(tempfile.mkdtemp(), "native_shm_crash")
+    proc = _spawn_counting_server_proc(path)
+    learner_queue, batcher, pool, pool_thread = _run_native_pool(
+        f"shm:{path}", max_reconnects=3
+    )
+    try:
+        it = iter(learner_queue)
+        next(it)  # at least one rollout through the first connection
+
+        proc.kill()  # SIGKILL: no cleanup, ring abandoned mid-stream
+        proc.join(10)
+        os.unlink(path)  # dead server's socket file lingers
+        proc = _spawn_counting_server_proc(path)
+
+        for _ in range(3):
+            next(it)
+        assert pool.first_error_message() is None
+        assert pool.reconnect_count() >= 1
+    finally:
+        batcher.close()
+        learner_queue.close()
+        pool_thread.join(10)
+        proc.kill()
+        proc.join(10)
+    leaked = _shm_segments() - before
+    assert leaked == set(), f"leaked /dev/shm segments: {leaked}"
+
+
+# ---------------------------------------------------------------------------
+# Telemetry fold: the C++ counters/stage stamps land in the registry
+# under the same series the Python runtime writes.
+
+
+def test_native_telemetry_fold():
+    from torchbeast_tpu.telemetry.metrics import MetricsRegistry
+    from torchbeast_tpu.runtime.native import NativeTelemetryFolder
+
+    queue = core.BatchingQueue(batch_dim=0, minimum_batch_size=1)
+    batcher = core.DynamicBatcher(batch_dim=0)
+
+    def producer():
+        batcher.compute(np.zeros((1, 2), np.float32))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    batch = next(iter(batcher))
+    batch.set_outputs(batch.get_inputs())
+    t.join(5)
+    queue.enqueue(np.zeros((1, 2), np.float32))
+    queue.dequeue_many()
+
+    registry = MetricsRegistry()
+    folder = NativeTelemetryFolder(
+        registry, pool=None, batcher=batcher, queue=queue
+    )
+    folder.tick()
+    assert registry.counter("learner_queue.items_in").value() == 1
+    rtt = registry.histogram("actor.request_rtt_s")
+    wait = registry.histogram("inference.request_wait_s")
+    assert rtt.count == 1 and wait.count == 1
+    assert rtt.mean >= wait.mean >= 0.0
+    assert registry.histogram("learner_queue.batch_size").count == 1
+    # Second tick: interval semantics — nothing new happened, nothing
+    # double-counted.
+    folder.tick()
+    assert registry.counter("learner_queue.items_in").value() == 1
+    assert rtt.count == 1
+    queue.close()
+    batcher.close()

@@ -48,6 +48,11 @@ WIRE_H = "csrc/wire.h"
 ARRAY_H = "csrc/array.h"
 CLIENT_H = "csrc/client.h"
 POLYBEAST_PY = "torchbeast_tpu/polybeast.py"
+# The shm ring layout contract (ISSUE 9): a Python env server and a C++
+# actor attach the SAME segments, so the header word layout, in-ring
+# markers, doorbell bytes, and the ring-eligibility cap must agree.
+TRANSPORT_PY = "torchbeast_tpu/runtime/transport.py"
+SHM_H = "csrc/shm.h"
 
 # C++ DType enumerator -> numpy dtype name (the dtype table's rosetta
 # stone; WIRE-PARITY fails if either side has a code the other lacks).

@@ -72,6 +72,113 @@ def parse_cpp_dtype_enum(array_h: str) -> Dict[str, int]:
     return out
 
 
+def parse_cpp_ring(shm_h: str) -> Dict[str, Optional[int]]:
+    """csrc/shm.h ring-layout constants -> canonical names. Missing
+    pieces parse to None (the checker turns that into a finding)."""
+    out: Dict[str, Optional[int]] = {}
+
+    def const(cpp_name: str):
+        m = re.search(
+            r"constexpr\s+(?:size_t|uint32_t|uint8_t)\s+" + cpp_name +
+            r"\s*=\s*(0[xX][0-9a-fA-F]+|\d+)",
+            shm_h,
+        )
+        return int(m.group(1), 0) if m else None
+
+    out["header_bytes"] = const("kRingHeaderBytes")
+    out["head_word"] = const("kRingHeadWord")
+    out["tail_word"] = const("kRingTailWord")
+    out["capacity_word"] = const("kRingCapacityWord")
+    out["waiting_word"] = const("kRingWaitingWord")
+    out["wrap_marker"] = const("kRingWrapMarker")
+    out["inline_marker"] = const("kRingInlineMarker")
+    out["doorbell_wake"] = const("kDoorbellWake")
+    out["doorbell_inline"] = const("kDoorbellInline")
+    # Ring-eligibility cap: `max_frame_bytes() ... return capacity_ / D - S`.
+    m = re.search(
+        r"max_frame_bytes\s*\(\s*\)\s*const\s*\{\s*return\s+capacity_\s*/"
+        r"\s*(\d+)\s*-\s*(\d+)\s*;",
+        shm_h,
+    )
+    out["eligibility_divisor"] = int(m.group(1)) if m else None
+    out["eligibility_slack"] = int(m.group(2)) if m else None
+    return out
+
+
+def parse_py_ring(tree: ast.Module) -> Dict[str, Optional[int]]:
+    """runtime/transport.py ring-layout facts -> the same canonical
+    names as parse_cpp_ring (ShmRing class attributes, the module-level
+    doorbell bytes, and max_frame_bytes' capacity//D - S expression)."""
+    out: Dict[str, Optional[int]] = {key: None for key in (
+        "header_bytes", "head_word", "tail_word", "capacity_word",
+        "waiting_word", "wrap_marker", "inline_marker", "doorbell_wake",
+        "doorbell_inline", "eligibility_divisor", "eligibility_slack",
+    )}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name) and isinstance(
+                node.value, ast.Constant
+            ) and isinstance(node.value.value, bytes) and len(
+                node.value.value
+            ) == 1:
+                if target.id == "_DOORBELL_WAKE":
+                    out["doorbell_wake"] = node.value.value[0]
+                elif target.id == "_DOORBELL_INLINE":
+                    out["doorbell_inline"] = node.value.value[0]
+    ring_cls = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.ClassDef) and n.name == "ShmRing"),
+        None,
+    )
+    if ring_cls is None:
+        return out
+    for node in ring_cls.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets[0]
+            if isinstance(targets, ast.Name):
+                value = _fold_py_int(node.value)
+                name = {
+                    "HEADER_BYTES": "header_bytes",
+                    "_WRAP": "wrap_marker",
+                    "_INLINE": "inline_marker",
+                }.get(targets.id)
+                if name is not None and value is not None:
+                    out[name] = value
+            elif isinstance(targets, ast.Tuple) and isinstance(
+                node.value, ast.Tuple
+            ):
+                # `_HEAD, _TAIL, _CAP, _WAITING = 0, 1, 2, 3`
+                names = {
+                    "_HEAD": "head_word", "_TAIL": "tail_word",
+                    "_CAP": "capacity_word", "_WAITING": "waiting_word",
+                }
+                for elt, val in zip(targets.elts, node.value.elts):
+                    if isinstance(elt, ast.Name) and elt.id in names:
+                        folded = _fold_py_int(val)
+                        if folded is not None:
+                            out[names[elt.id]] = folded
+        elif isinstance(node, ast.FunctionDef) and (
+            node.name == "max_frame_bytes"
+        ):
+            # `return self._capacity // D - S`
+            for ret in ast.walk(node):
+                if not isinstance(ret, ast.Return):
+                    continue
+                expr = ret.value
+                if (
+                    isinstance(expr, ast.BinOp)
+                    and isinstance(expr.op, ast.Sub)
+                    and isinstance(expr.left, ast.BinOp)
+                    and isinstance(expr.left.op, ast.FloorDiv)
+                ):
+                    out["eligibility_divisor"] = _fold_py_int(
+                        expr.left.right
+                    )
+                    out["eligibility_slack"] = _fold_py_int(expr.right)
+    return out
+
+
 def parse_cpp_itemsizes(array_h: str) -> Dict[str, int]:
     """The itemsize() switch -> {'kU8': 1, ...}."""
     m = re.search(
@@ -316,8 +423,71 @@ def check_wire_parity(
     return findings
 
 
+# Human-readable labels for the ring-layout contract fields.
+_RING_FIELD_LABELS = {
+    "header_bytes": "ring header size (ShmRing.HEADER_BYTES / "
+                    "kRingHeaderBytes)",
+    "head_word": "head counter word index (_HEAD / kRingHeadWord)",
+    "tail_word": "tail counter word index (_TAIL / kRingTailWord)",
+    "capacity_word": "capacity word index (_CAP / kRingCapacityWord)",
+    "waiting_word": "waiting-flag word index (_WAITING / kRingWaitingWord)",
+    "wrap_marker": "wrap marker (_WRAP / kRingWrapMarker)",
+    "inline_marker": "inline marker (_INLINE / kRingInlineMarker)",
+    "doorbell_wake": "doorbell WAKE byte (_DOORBELL_WAKE / kDoorbellWake)",
+    "doorbell_inline": "doorbell INLINE byte (_DOORBELL_INLINE / "
+                       "kDoorbellInline)",
+    "eligibility_divisor": "ring-eligibility cap divisor "
+                           "(max_frame_bytes: capacity // D - S)",
+    "eligibility_slack": "ring-eligibility cap slack "
+                         "(max_frame_bytes: capacity // D - S)",
+}
+
+
+def check_ring_parity(
+    transport_ctx: FileContext, shm_h: str
+) -> List[Finding]:
+    """WIRE-PARITY (shm ring layout): a Python env server and a C++
+    actor loop attach the SAME SharedMemory segments, so the header
+    word layout, in-ring wrap/inline markers, doorbell control bytes,
+    and the capacity//2-4 ring-eligibility cap must match byte for
+    byte. Unparseable side = finding, not silence."""
+    findings: List[Finding] = []
+    path = transport_ctx.path
+
+    def finding(msg: str):
+        findings.append(Finding("WIRE-PARITY", path, 1, msg))
+
+    ring_py = parse_py_ring(transport_ctx.tree)
+    ring_cpp = parse_cpp_ring(shm_h)
+    if all(v is None for v in ring_py.values()):
+        finding("could not parse the ShmRing layout (HEADER_BYTES/"
+                "_WRAP/_INLINE/word indices/doorbell bytes) from "
+                "runtime/transport.py — WIRE-PARITY cannot verify the "
+                "shm ring contract")
+        return findings
+    if all(v is None for v in ring_cpp.values()):
+        finding("could not parse the ring layout (kRing*/kDoorbell* "
+                "constants, max_frame_bytes) from csrc/shm.h — "
+                "WIRE-PARITY cannot verify the shm ring contract")
+        return findings
+    for key, label in _RING_FIELD_LABELS.items():
+        py_v, cpp_v = ring_py.get(key), ring_cpp.get(key)
+        if py_v is None:
+            finding(f"shm ring {label}: missing/unparseable on the "
+                    f"Python side (csrc/shm.h says {cpp_v})")
+        elif cpp_v is None:
+            finding(f"shm ring {label}: missing/unparseable on the C++ "
+                    f"side (transport.py says {py_v})")
+        elif py_v != cpp_v:
+            finding(f"shm ring {label}: transport.py says {py_v:#x}, "
+                    f"csrc/shm.h says {cpp_v:#x}")
+    return findings
+
+
 class WireParityRule:
-    """WIRE-PARITY: runtime/wire.py == csrc/ on tags, dtypes, frame bound."""
+    """WIRE-PARITY: runtime/wire.py == csrc/ on tags, dtypes, frame
+    bound — and runtime/transport.py == csrc/shm.h on the shm ring
+    layout."""
 
     name = "WIRE-PARITY"
 
@@ -348,10 +518,24 @@ class WireParityRule:
                     "of the wire contract is gone",
                 )
             ]
-        return check_wire_parity(
+        findings = check_wire_parity(
             py_ctx, wire_h, array_h, client_h,
             by_path.get(config.POLYBEAST_PY),
         )
+        # The shm ring layout contract (ISSUE 9 satellite): checked
+        # whenever transport.py is in scope.
+        transport_ctx = by_path.get(config.TRANSPORT_PY)
+        if transport_ctx is not None:
+            shm_h = read(config.SHM_H)
+            if not shm_h:
+                findings.append(Finding(
+                    self.name, config.TRANSPORT_PY, 1,
+                    "csrc/shm.h missing — the C++ side of the shm ring "
+                    "contract is gone",
+                ))
+            else:
+                findings.extend(check_ring_parity(transport_ctx, shm_h))
+        return findings
 
 
 # ---------------------------------------------------------------------------

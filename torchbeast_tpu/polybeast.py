@@ -215,9 +215,9 @@ def make_parser():
                              "carry slot ids, state gathers/advances/"
                              "scatters inside the jitted acting step, "
                              "and per-env-step host traffic shrinks to "
-                             "obs-down/action-up. Ignored for stateless "
-                             "models and with --native_runtime (the C++ "
-                             "pool speaks the legacy state framing).")
+                             "obs-down/action-up. Both runtimes speak "
+                             "the slot framing; ignored for stateless "
+                             "models (nothing to keep resident).")
     parser.add_argument("--no_device_agent_state",
                         dest="device_agent_state", action="store_false",
                         help="Legacy acting path: agent state rides "
@@ -247,7 +247,8 @@ def make_parser():
                              "K updates). Bit-identical to K sequential "
                              "dispatches; schedules tick per-update "
                              "inside the scan. 1 = today's per-update "
-                             "dispatch. Python runtime only.")
+                             "dispatch. Works on both runtimes (the "
+                             "C++ queue has the same raw-item intake).")
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
     parser.add_argument("--max_actor_reconnects", type=int, default=3,
@@ -329,19 +330,18 @@ def train(flags):
         raise ValueError(
             f"--superstep_k must be >= 1, got {superstep_k}"
         )
-    if superstep_k > 1 and flags.native_runtime:
-        # The C++ BatchingQueue has no raw-item intake for the host
-        # batch arena (and the native learner path predates supersteps).
-        raise RuntimeError(
-            "--superstep_k > 1 is not supported with --native_runtime; "
-            "use the Python runtime"
-        )
     if getattr(flags, "chaos_plan", None) and flags.native_runtime:
-        # The C++ pool owns its own connections: the fault-wrapping
-        # transport (sever/delay/corrupt injectors) cannot interpose.
+        # The ONLY capability still gated off native (ISSUE 9 closed
+        # slot framing, shm, bf16, supersteps, telemetry): the chaos
+        # fault injectors interpose on the Python transport objects
+        # (FaultingTransport wrap via ActorPool's transport_wrap, shm
+        # ring poke through the Python ShmRing) — the C++ pool owns its
+        # connections in C++ threads, so there is nothing to wrap.
         raise RuntimeError(
-            "--chaos_plan is not supported with --native_runtime; "
-            "use the Python runtime"
+            "--chaos_plan is not supported with --native_runtime: the "
+            "fault injectors wrap the Python transport objects, which "
+            "the C++ pool does not use; run chaos plans on the Python "
+            "runtime"
         )
 
     # No-ops (with a log line) when no coordinator is configured by flag
@@ -760,11 +760,6 @@ def train(flags):
         if flags.native_runtime:
             from torchbeast_tpu.runtime.native import import_native
 
-            if any(a.startswith("shm:") for a in addresses):
-                raise RuntimeError(
-                    "--native_runtime does not speak the shm transport "
-                    "yet; use a unix:/tcp pipes_basename"
-                )
             core = import_native()
             if core is None:
                 raise RuntimeError(
@@ -831,13 +826,13 @@ def train(flags):
         # recurrent state lives in a [.., num_actors+1, ..] on-device
         # pytree keyed by actor slot; the jitted acting step gathers,
         # advances, and scatters it in ONE dispatch, so per-env-step
-        # host traffic shrinks to obs-down / action-up. Stateless
-        # models have nothing to keep resident, and the C++ pool
-        # speaks the legacy state framing — both fall back.
+        # host traffic shrinks to obs-down / action-up. Both runtimes
+        # speak the slot framing (the C++ pool drives the same table
+        # through its slot hooks, pymodule.cc); stateless models have
+        # nothing to keep resident and fall back.
         state_table = None
         if (
             getattr(flags, "device_agent_state", True)
-            and not flags.native_runtime
             and jax.tree_util.tree_leaves(act_model.initial_state(1))
         ):
             from torchbeast_tpu.runtime.state_table import DeviceStateTable
@@ -982,13 +977,11 @@ def train(flags):
         )
 
         pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
-        pool_kwargs = {}
+        pool_kwargs = {"max_frame_bytes": flags.max_frame_bytes}
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
-        if not flags.native_runtime:
-            pool_kwargs["max_frame_bytes"] = flags.max_frame_bytes
-            if chaos is not None:
-                pool_kwargs["transport_wrap"] = chaos.wrap_transport
+        if not flags.native_runtime and chaos is not None:
+            pool_kwargs["transport_wrap"] = chaos.wrap_transport
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
@@ -998,6 +991,18 @@ def train(flags):
             max_reconnects=flags.max_actor_reconnects,
             **pool_kwargs,
         )
+        if flags.native_runtime and telemetry_on:
+            # The C++ core has no registry access; fold its per-request
+            # stage stamps + wire/step counters into the same series the
+            # Python runtime writes, on every exported line.
+            from torchbeast_tpu.runtime.native import NativeTelemetryFolder
+
+            tele.add_tick_callback(
+                NativeTelemetryFolder(
+                    reg, pool=actors, batcher=inference_batcher,
+                    queue=learner_queue,
+                ).tick
+            )
         actor_thread = threading.Thread(
             target=actors.run, daemon=True, name="actorpool"
         )

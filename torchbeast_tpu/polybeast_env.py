@@ -116,11 +116,6 @@ def _serve(env_name: str, address: str, native: bool = False,
         def env_init():
             return create_env(env_name, seed=seed_base + next(counter))
     if native:
-        if address.startswith("shm:"):
-            raise RuntimeError(
-                "--native_server does not speak the shm transport yet; "
-                "use a unix:/tcp pipes_basename or the Python server"
-            )
         from torchbeast_tpu.runtime.native import import_native
 
         core = import_native()

@@ -1,14 +1,28 @@
-"""Native runtime loader.
+"""Native runtime loader + telemetry fold.
 
 `import_native()` returns the `_tbt_core` C extension (C++ BatchingQueue /
-DynamicBatcher / ActorPool — actor loops run GIL-free in C++ threads) when
-built, else None; `available()` tells you which. Drivers select with
-`--native_runtime` (polybeast.py). The Python implementations in queues.py /
-actor_pool.py remain the semantic reference and the fallback.
+DynamicBatcher / ActorPool / EnvServer — actor loops run GIL-free in C++
+threads) when built, else None; `available()` tells you which. Drivers
+select with `--native_runtime` (polybeast.py). The Python implementations
+in queues.py / actor_pool.py remain the semantic reference and the
+fallback.
+
+`NativeTelemetryFolder` closes the observability gap (ISSUE 9): the C++
+core stamps enqueue->batch->reply per request and counts wire bytes /
+env steps / queue intake in-process; each driver monitor tick folds that
+interval's aggregates into the process-wide telemetry registry under the
+SAME series names the Python runtime writes (wire.bytes_up/down,
+actor.env_steps/connects/request_rtt_s, recovery.actor_reconnects,
+inference.request_wait_s, learner_queue.items_in/dequeue_wait_s/
+batch_size) — so native runs emit a telemetry.jsonl indistinguishable in
+schema from Python-runtime runs. Histogram folds are exact: the C++ side
+accumulates into the same log-bucket geometry as telemetry/metrics.py
+(csrc/queues.h telemetry_bucket_index) and snapshots reset per interval.
 
 Build: bash scripts/build_native.sh   (setup.py build_ext --inplace)
 """
 
+import threading
 from typing import Optional
 
 
@@ -33,3 +47,77 @@ def import_native() -> Optional[object]:
 
 def available() -> bool:
     return import_native() is not None
+
+
+class NativeTelemetryFolder:
+    """Folds the C++ pool/batcher/queue telemetry into the registry.
+
+    `tick()` runs as a DriverTelemetry tick callback (monitor thread,
+    plus the final shutdown write): counter series are credited with
+    the delta since the previous tick; histogram series fold the C++
+    side's interval snapshot (which resets on read, so min/max are the
+    interval's true extremes). The lock makes the shutdown-path tick
+    safe against a monitor tick still in flight.
+    """
+
+    def __init__(self, registry, pool=None, batcher=None, queue=None):
+        self._pool = pool
+        self._batcher = batcher
+        self._queue = queue
+        self._lock = threading.Lock()
+        self._prev = {}  # counter name -> last cumulative value  # guarded-by: self._lock
+        # Same series names the Python runtime's instruments use.
+        self._c_bytes_up = registry.counter("wire.bytes_up")
+        self._c_bytes_down = registry.counter("wire.bytes_down")
+        self._c_steps = registry.counter("actor.env_steps")
+        self._c_connects = registry.counter("actor.connects")
+        self._c_reconnects = registry.counter("recovery.actor_reconnects")
+        self._h_rtt = registry.histogram("actor.request_rtt_s")
+        self._h_request_wait = registry.histogram("inference.request_wait_s")
+        self._c_queue_in = registry.counter("learner_queue.items_in")
+        self._h_queue_wait = registry.histogram(
+            "learner_queue.dequeue_wait_s"
+        )
+        self._h_queue_batch = registry.histogram("learner_queue.batch_size")
+
+    # beastlint: holds self._lock
+    def _inc_delta(self, counter, key: str, value: int) -> None:
+        prev = self._prev.get(key, 0)
+        if value > prev:
+            counter.inc(value - prev)
+        self._prev[key] = value
+
+    @staticmethod
+    def _fold_hist(histogram, snap: dict) -> None:
+        histogram.observe_aggregate(
+            snap["buckets"], snap["total"], snap["total_sq"],
+            snap["min"], snap["max"],
+        )
+
+    def tick(self) -> None:
+        with self._lock:
+            if self._pool is not None:
+                p = self._pool.telemetry()
+                self._inc_delta(self._c_bytes_up, "bytes_up", p["bytes_up"])
+                self._inc_delta(
+                    self._c_bytes_down, "bytes_down", p["bytes_down"]
+                )
+                self._inc_delta(self._c_steps, "env_steps", p["env_steps"])
+                self._inc_delta(self._c_connects, "connects", p["connects"])
+                self._inc_delta(
+                    self._c_reconnects, "reconnects", p["reconnects"]
+                )
+            if self._batcher is not None:
+                b = self._batcher.telemetry()
+                # batches/rows/batch_size stay with the Python serving
+                # loop's own inference.* instruments (inference.py
+                # observes them for un-instrumented batchers) — folding
+                # them here would double-count.
+                self._fold_hist(self._h_request_wait, b["request_wait_s"])
+                self._fold_hist(self._h_rtt, b["request_rtt_s"])
+            if self._queue is not None:
+                q = self._queue.telemetry()
+                self._inc_delta(self._c_queue_in, "queue_items_in",
+                                q["items_in"])
+                self._fold_hist(self._h_queue_wait, q["dequeue_wait_s"])
+                self._fold_hist(self._h_queue_batch, q["batch_size"])

@@ -65,7 +65,13 @@ _DOORBELL_INLINE = b"\x02"  # one framed message follows on the socket
 # waiting-flag handshake has a (tiny) lost-wakeup window — CPython emits
 # no store-load fence between the sender's head publish and its
 # waiting-flag load — and the periodic re-check bounds that stall.
-_WAKE_RECHECK_S = 0.5
+# 20ms (not the original 500ms): on an oversubscribed box the
+# doorbell hop itself can be late or lost under scheduler pressure, and
+# e2e runs showed the system settling into a degraded mode where a
+# visible fraction of waits ride the recheck — a tight bound caps each
+# such stall at one scheduling quantum instead of half a second, while
+# an idle connection still costs only 50 wakeups/s.
+_WAKE_RECHECK_S = 0.02
 
 # Before arming the waiting flag, the reader spins on the head counter
 # for this long: a producer running at a similar cadence lands its next
@@ -488,6 +494,11 @@ class ShmTransport:
         transport is single-threaded per connection, so any 0x01 queued
         during a send is stale by definition); an inline 0x02 is never
         consumed (it belongs to recv_sized)."""
+        # A consumed 0x02 whose frame bytes are still queued proves the
+        # peer alive AND makes the socket head payload, not doorbell —
+        # probing now could eat a payload byte that happens to be 0x01.
+        if self._inline_consumed:
+            return
         while True:
             try:
                 data = self._sock.recv(

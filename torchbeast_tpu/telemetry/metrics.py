@@ -312,6 +312,30 @@ class Histogram:
         index = bucket_index(value)
         shard.buckets[index] = shard.buckets.get(index, 0) + 1
 
+    def observe_aggregate(self, buckets: Dict[int, int], total: float,
+                          total_sq: float, lo: float, hi: float) -> None:
+        """Credit a pre-aggregated batch of observations — the native-
+        runtime fold path (runtime/native.py): the C++ core accumulates
+        per-request stage stamps into the SAME log-bucket geometry
+        (csrc/queues.h telemetry_bucket_index) and the driver folds each
+        monitor tick's interval here. Exact in buckets and moments;
+        `lo`/`hi` are the interval's true min/max. No-op on an empty
+        interval."""
+        if self._gated and not _ENABLED[0]:
+            return
+        counts = {int(k): int(v) for k, v in buckets.items() if v > 0}
+        if not counts:
+            return
+        shard = self._shard()
+        for index, count in counts.items():
+            shard.buckets[index] = shard.buckets.get(index, 0) + count
+        shard.total += float(total)
+        shard.total_sq += float(total_sq)
+        if lo < shard.min:
+            shard.min = float(lo)
+        if hi > shard.max:
+            shard.max = float(hi)
+
     def merged(self) -> _HistShard:
         """One shard-shaped aggregate over every thread's shard (plus
         the retired fold); count is derived from the bucket sums."""
