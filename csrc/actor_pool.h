@@ -62,6 +62,10 @@ class ActorPool {
     int64_t reconnects = 0;
     int64_t bytes_up = 0;    // env server -> this process
     int64_t bytes_down = 0;  // actions back out
+    // shm doorbell-wait counters (process-wide, csrc/shm.h
+    // ring_wait_counters — cumulative like the fields above).
+    int64_t ring_doorbell_waits = 0;
+    int64_t ring_recheck_wakeups = 0;
   };
 
   ActorPool(int64_t unroll_length, std::shared_ptr<LearnerQueue> learner_queue,
@@ -97,6 +101,10 @@ class ActorPool {
     t.reconnects = reconnect_count_.load();
     t.bytes_up = bytes_up_.load();
     t.bytes_down = bytes_down_.load();
+    t.ring_doorbell_waits =
+        shm::ring_wait_counters().doorbell_waits.load();
+    t.ring_recheck_wakeups =
+        shm::ring_wait_counters().recheck_wakeups.load();
     return t;
   }
 

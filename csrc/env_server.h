@@ -115,7 +115,9 @@ class EnvServer {
       // per-connection rings are created at accept time
       // (shm_server_transport), names exchanged in the handshake —
       // same protocol as runtime/transport.py server_transport.
+      // beastlint: disable=CXX-LOCK-DISCIPLINE  write-before-spawn: stream threads that read shm_ are created after bind_and_listen returns, by the same thread
       shm_ = shm::is_shm_address(address_);
+      // beastlint: disable=CXX-LOCK-DISCIPLINE  atomic handoff: stop() reads unix_path_ only after listen_fd_.exchange() observed the fd stored after this write
       unix_path_ = shm_ ? shm::shm_socket_path(address_)
                         : address_.substr(5);
       ::unlink(unix_path_.c_str());
@@ -202,11 +204,12 @@ class EnvServer {
     }
   }
 
-  // Caller holds mu_. Join threads whose streams already ended so the
-  // vector stays bounded under reconnect-heavy workloads (the Python
-  // server prunes the same way). A finished id's thread is at worst a
-  // few instructions from returning, so these joins are effectively
+  // Join threads whose streams already ended so the vector stays
+  // bounded under reconnect-heavy workloads (the Python server prunes
+  // the same way). A finished id's thread is at worst a few
+  // instructions from returning, so these joins are effectively
   // instant and never wait on a live stream.
+  // beastlint: holds mu_
   void reap_finished_locked() {
     for (std::thread::id id : finished_) {
       for (auto it = threads_.begin(); it != threads_.end(); ++it) {
@@ -222,14 +225,19 @@ class EnvServer {
 
   std::string address_;
   std::function<StreamHooks()> hook_factory_;
+  // unix_path_ / shm_ are written once by bind_and_listen (run()'s
+  // thread) and then only read: stream threads spawn strictly after
+  // bind_and_listen returns (write-before-spawn), and stop() touches
+  // unix_path_ only after listen_fd_.exchange() returned a valid fd —
+  // a seq_cst handoff that happens-after the store publishing the path.
   std::string unix_path_;
   bool shm_ = false;
   std::atomic<int> listen_fd_{-1};
   std::atomic<bool> running_{false};
   std::mutex mu_;
-  std::vector<int> conn_fds_;
-  std::vector<std::thread> threads_;
-  std::vector<std::thread::id> finished_;
+  std::vector<int> conn_fds_;     // guarded-by: mu_
+  std::vector<std::thread> threads_;  // guarded-by: mu_
+  std::vector<std::thread::id> finished_;  // guarded-by: mu_
 };
 
 }  // namespace tbt

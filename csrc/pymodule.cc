@@ -987,12 +987,15 @@ PyObject* pool_reconnect_count(PyActorPool* self, PyObject*) {
 // telemetry registry (runtime/native.py NativeTelemetryFolder).
 PyObject* pool_telemetry(PyActorPool* self, PyObject*) {
   tbt::ActorPool::Telemetry t = self->pool->telemetry();
-  return Py_BuildValue("{s:L,s:L,s:L,s:L,s:L}", "env_steps",
-                       static_cast<long long>(t.env_steps), "connects",
-                       static_cast<long long>(t.connects), "reconnects",
-                       static_cast<long long>(t.reconnects), "bytes_up",
-                       static_cast<long long>(t.bytes_up), "bytes_down",
-                       static_cast<long long>(t.bytes_down));
+  return Py_BuildValue(
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L}", "env_steps",
+      static_cast<long long>(t.env_steps), "connects",
+      static_cast<long long>(t.connects), "reconnects",
+      static_cast<long long>(t.reconnects), "bytes_up",
+      static_cast<long long>(t.bytes_up), "bytes_down",
+      static_cast<long long>(t.bytes_down), "ring_doorbell_waits",
+      static_cast<long long>(t.ring_doorbell_waits), "ring_recheck_wakeups",
+      static_cast<long long>(t.ring_recheck_wakeups));
 }
 
 PyObject* pool_first_error_message(PyActorPool* self, PyObject*) {

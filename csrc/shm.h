@@ -79,6 +79,25 @@ inline uint32_t load_u32le(const uint8_t* p) {
   return x;
 }
 
+// Doorbell-wait observability (mirrors transport.py's
+// ring.doorbell_waits / ring.recheck_wakeups counters; the Python
+// driver folds these into the telemetry registry via
+// NativeTelemetryFolder). Process-wide because transports are
+// per-connection and may die before a telemetry tick — cumulative
+// counters survive the connection. doorbell_waits counts every
+// armed+blocked wait; recheck_wakeups the subset ended by the bounded
+// kWakeRecheckMs poll timeout instead of a doorbell byte. A growing
+// recheck share is the ROADMAP metastability signature.
+struct RingWaitCounters {
+  std::atomic<int64_t> doorbell_waits{0};
+  std::atomic<int64_t> recheck_wakeups{0};
+};
+
+inline RingWaitCounters& ring_wait_counters() {
+  static RingWaitCounters counters;
+  return counters;
+}
+
 // One mapped SPSC ring. Move-only; the mapping is shared with the peer
 // process, so head/tail/waiting go through atomics (the Python side's
 // plain u64 stores are single aligned stores; release/acquire here gives
@@ -262,9 +281,8 @@ class ShmRing {
   }
 
   void release(size_t advance) {
-    auto* tail = word(kRingTailWord);
-    tail->store(tail->load(std::memory_order_relaxed) + advance,
-                std::memory_order_release);
+    uint64_t tail = word(kRingTailWord)->load(std::memory_order_relaxed);
+    word(kRingTailWord)->store(tail + advance, std::memory_order_release);
   }
 
   // -- teardown --------------------------------------------------------
@@ -470,10 +488,14 @@ class ShmTransport : public Transport {
         recv_ring_.set_waiting(false);
         continue;
       }
+      ring_wait_counters().doorbell_waits.fetch_add(
+          1, std::memory_order_relaxed);
       struct pollfd p {fd_, POLLIN, 0};
       int pr = ::poll(&p, 1, kWakeRecheckMs);
       if (pr == 0) {
         recv_ring_.set_waiting(false);
+        ring_wait_counters().recheck_wakeups.fetch_add(
+            1, std::memory_order_relaxed);
         continue;  // re-check the ring (lost-wakeup guard)
       }
       if (pr < 0) {

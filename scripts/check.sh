@@ -1,0 +1,42 @@
+#!/bin/bash
+# The one-command merge gate (ISSUE 10): native build + C++ test suites
+# (plain AND under TSan) + the Python extension, then the full static
+# analysis lane — repo-wide beastlint in CI mode (14 rules incl. the
+# C++ frontend), the rule-fixture selftest, and the exhaustive
+# shm-protocol model check (shipped spec verifies; seeded mutants must
+# produce counterexample traces).
+#
+#   scripts/check.sh            # everything
+#   scripts/check.sh --fast     # skip the native build (analysis only)
+#
+# Exit: nonzero on the first failing stage; each stage prints its own
+# verdict line.
+set -euo pipefail
+cd "$(git -C "$(dirname "$0")" rev-parse --show-toplevel)"
+
+FAST=0
+for arg in "$@"; do
+    case "$arg" in
+        --fast) FAST=1 ;;
+        *)
+            echo "unknown argument: $arg" >&2
+            exit 2
+            ;;
+    esac
+done
+
+if [[ "$FAST" -eq 0 ]]; then
+    echo "== check: native smoke (build + C++ tests, plain + TSan, extension)"
+    bash scripts/build_native.sh --smoke
+fi
+
+echo "== check: beastlint --ci (repo-wide, C++ frontend active)"
+python -m torchbeast_tpu.analysis --ci
+
+echo "== check: beastlint --selftest (rule fixtures)"
+python -m torchbeast_tpu.analysis --selftest
+
+echo "== check: protocol model check (shm ring + doorbell)"
+python -m torchbeast_tpu.analysis --check-protocol
+
+echo "== check: PASS"

@@ -1,7 +1,10 @@
 #!/bin/bash
 # beastlint pre-commit wrapper: lint only the files changed vs a git ref
 # (default HEAD — staged + unstaged + untracked), with the whole-program
-# graph and parity anchors still built repo-wide.
+# graph and parity anchors still built repo-wide. The changed-file
+# filter covers Python AND the C++ core (csrc/*.h, *.cc — ISSUE 10):
+# a csrc-only change runs the C++ rules (GIL-DISCIPLINE, ATOMIC-ORDER,
+# CXX-LOCK-DISCIPLINE) instead of silently skipping the lint.
 #
 #   scripts/lint.sh              # lint your working-tree changes
 #   scripts/lint.sh origin/main  # lint everything since origin/main

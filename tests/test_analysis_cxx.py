@@ -1,0 +1,574 @@
+"""beastlint v3 (ISSUE 10): the C++ frontend, the three cross-language
+concurrency rules, the spec<->implementation conformance pins, and the
+exhaustive shm-protocol model checker.
+
+The conformance tests are the acceptance contract: mutate a header
+access (order OR sequence) in a fixture copy of the REAL transport.py /
+csrc/shm.h and the ATOMIC-ORDER rule must flag it; run the checker on a
+seeded protocol mutation and it must produce a counterexample trace —
+including the two historical bugs (the PR 3 fence-less oversized-path
+lost-wakeup and the PR 9 metastable wait)."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchbeast_tpu import analysis
+from torchbeast_tpu.analysis import config as lint_config
+from torchbeast_tpu.analysis import cxx, cxxrules, protocol
+from torchbeast_tpu.analysis import analyze_cxx_sources
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _rules(report, name):
+    return [f for f in report.findings if f.rule == name]
+
+
+# ---------------------------------------------------------------------------
+# Frontend: lexer + extractor
+
+
+class TestCxxFrontend:
+    SRC = """
+// a file comment
+class Queue {
+ public:
+  Queue() : total_(0) {}
+  void add(int n) {
+    std::lock_guard<std::mutex> lock(mu_);
+    total_ += n;
+    items_.push_back(n);
+  }
+  int drain() {
+    std::unique_lock<std::mutex> l(mu_);
+    int t = total_;
+    l.unlock();
+    total_read_ = t;  /* raw after unlock */
+    return t;
+  }
+  // beastlint: holds mu_
+  void clear_locked() { items_.clear(); }
+
+ private:
+  std::mutex mu_;
+  int total_ = 0;  // guarded-by: mu_
+  int total_read_ = 0;
+  std::vector<int> items_;  // guarded-by: mu_
+};
+
+void spawn_all(Queue* q) {
+  std::vector<std::thread> workers;
+  for (int i = 0; i < 4; ++i) {
+    workers.emplace_back([q] { helper(q); });
+  }
+}
+
+void helper(Queue* q) { q->add(1); }
+"""
+
+    def _ctx(self):
+        return cxx.CxxFileContext("csrc/fixture.h", self.SRC)
+
+    def test_class_and_members_extracted(self):
+        ctx = self._ctx()
+        assert "Queue" in ctx.classes
+        cls = ctx.classes["Queue"]
+        assert set(cls.members) >= {
+            "mu_", "total_", "total_read_", "items_"
+        }
+        assert cls.members["mu_"].is_mutex
+        assert not cls.members["total_"].is_mutex
+        assert cls.guarded == {"total_": "mu_", "items_": "mu_"}
+        assert set(cls.methods) >= {"Queue", "add", "drain",
+                                    "clear_locked"}
+
+    def test_lock_scopes_and_early_unlock(self):
+        ctx = self._ctx()
+        cls = ctx.classes["Queue"]
+        add_accs = cxx.member_accesses(ctx, cls, cls.methods["add"])
+        by_attr = {a.attr: a for a in add_accs}
+        assert "Queue.mu_" in by_attr["total_"].held
+        assert by_attr["total_"].kind == "write" and by_attr["total_"].rmw
+        assert by_attr["items_"].kind == "write"  # push_back mutator
+        drain_accs = cxx.member_accesses(ctx, cls, cls.methods["drain"])
+        held_by_attr = {a.attr: a.held for a in drain_accs}
+        assert "Queue.mu_" in held_by_attr["total_"]
+        # total_read_ is written AFTER l.unlock(): hold ended.
+        assert held_by_attr["total_read_"] == frozenset()
+
+    def test_holds_annotation_recognized(self):
+        ctx = self._ctx()
+        cls = ctx.classes["Queue"]
+        accs = cxx.member_accesses(ctx, cls, cls.methods["clear_locked"])
+        items = [a for a in accs if a.attr == "items_"]
+        assert items and "Queue.mu_" in items[0].held
+
+    def test_thread_spawns_in_loop_are_multi(self):
+        ctx = self._ctx()
+        spawns = cxx.thread_spawns(ctx)
+        assert len(spawns) == 1
+        assert spawns[0].multi  # emplace_back inside a for loop
+        assert "helper" in spawns[0].callees
+
+    def test_constructor_is_init_exempt(self):
+        ctx = self._ctx()
+        cls = ctx.classes["Queue"]
+        ctor_accs = cxx.member_accesses(ctx, cls, cls.methods["Queue"])
+        assert all(a.in_init for a in ctor_accs)
+
+    def test_comment_stripping_keeps_line_numbers(self):
+        ctx = self._ctx()
+        # guarded-by annotations land on the declaration lines.
+        cls = ctx.classes["Queue"]
+        assert cls.members["total_"].line < cls.members["items_"].line
+
+
+class TestGilEvents:
+    SRC = """
+void worker() {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* obj = PyLong_FromLong(1);
+  call_nogil([&] { queue->dequeue_many(); cv.wait(lk); });
+  Py_DECREF(obj);
+  PyGILState_Release(gil);
+}
+"""
+
+    def test_nogil_span_and_api_calls(self):
+        ctx = cxx.CxxFileContext("csrc/fixture.cc", self.SRC)
+        fn = ctx.function_named("worker")
+        events = cxx.gil_events(fn)
+        kinds = [e.kind for e in events]
+        assert "ensure" in kinds and "release" in kinds
+        assert "nogil_start" in kinds and "nogil_end" in kinds
+        api = [e.name for e in events if e.kind == "api_call"]
+        assert "PyLong_FromLong" in api and "Py_DECREF" in api
+        # The wait inside the call_nogil span sits between the span
+        # markers (the rule treats it as released).
+        start = next(e.index for e in events if e.kind == "nogil_start")
+        end = next(e.index for e in events if e.kind == "nogil_end")
+        wait = next(e for e in events if e.kind == "blocking_call")
+        assert start < wait.index < end
+
+    def test_signature_is_not_a_self_call(self):
+        ctx = cxx.CxxFileContext("csrc/fixture.cc", self.SRC)
+        fn = ctx.function_named("worker")
+        assert "worker" not in {
+            e.name for e in cxx.gil_events(fn) if e.kind == "call"
+        }
+
+
+# ---------------------------------------------------------------------------
+# GIL-DISCIPLINE semantics beyond the selftest fixtures
+
+
+class TestGilDiscipline:
+    def test_nogil_wrapped_callee_is_safe_to_call_held(self):
+        """A helper that blocks INSIDE call_nogil releases the GIL
+        first — calling it with the GIL held must not flag (the
+        queue_enqueue/pool_run idiom)."""
+        src = """
+void safe_helper() {
+  call_nogil([&] { cv.wait(lk); });
+}
+void hook() {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  safe_helper();
+  PyGILState_Release(gil);
+}
+"""
+        report = analyze_cxx_sources({"csrc/actor_pool.h": src})
+        assert not _rules(report, "GIL-DISCIPLINE"), [
+            f.render() for f in report.findings
+        ]
+
+    def test_bare_blocking_callee_flags_when_called_held(self):
+        src = """
+void raw_helper() { cv.wait(lk); }
+void hook() {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  raw_helper();
+  PyGILState_Release(gil);
+}
+"""
+        report = analyze_cxx_sources({"csrc/actor_pool.h": src})
+        assert _rules(report, "GIL-DISCIPLINE")
+
+    def test_stl_name_collision_does_not_flag(self):
+        """vector::reserve shares a name with ring reserve(): the
+        name-based summary must not infect it."""
+        src = """
+void reserve() { cv.wait(lk); }
+void hook() {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::vector<int> v;
+  v.reserve(16);
+  PyGILState_Release(gil);
+}
+"""
+        report = analyze_cxx_sources({"csrc/actor_pool.h": src})
+        assert not _rules(report, "GIL-DISCIPLINE"), [
+            f.render() for f in report.findings
+        ]
+
+    def test_unbalanced_allow_threads_flags(self):
+        src = """
+void hook() {
+  Py_BEGIN_ALLOW_THREADS
+  do_work();
+}
+"""
+        report = analyze_cxx_sources({"csrc/actor_pool.h": src})
+        found = _rules(report, "GIL-DISCIPLINE")
+        assert found and "unbalanced" in found[0].message
+
+    def test_real_binding_layer_is_clean(self):
+        """pymodule.cc + actor_pool.h as shipped: every CPython call is
+        GIL-dominated and every blocking call releases first."""
+        report = analysis.analyze_paths(["csrc"], root=REPO)
+        found = _rules(report, "GIL-DISCIPLINE")
+        assert not found, [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# ATOMIC-ORDER: access discipline + cross-language conformance
+
+
+class TestAtomicOrder:
+    def _both(self, shm_src=None, transport_src=None):
+        return analyze_cxx_sources({
+            lint_config.SHM_H: shm_src or _read("csrc/shm.h"),
+            lint_config.TRANSPORT_PY: (
+                transport_src
+                or _read("torchbeast_tpu/runtime/transport.py")
+            ),
+        })
+
+    def test_shipped_implementations_conform(self):
+        """The in-anger pin: today's transport.py and csrc/shm.h both
+        conform to the model-checked spec (no findings)."""
+        report = self._both()
+        found = _rules(report, "ATOMIC-ORDER")
+        assert not found, [f.render() for f in found]
+
+    def test_cpp_order_weakening_flags(self):
+        """Weakening the head publish to relaxed is the PR 3 bug class:
+        the documented-order table catches it."""
+        mutated = _read("csrc/shm.h").replace(
+            "word(kRingHeadWord)->store(publish_head_, "
+            "std::memory_order_release);",
+            "word(kRingHeadWord)->store(publish_head_, "
+            "std::memory_order_relaxed);",
+        )
+        assert mutated != _read("csrc/shm.h")
+        report = self._both(shm_src=mutated)
+        found = _rules(report, "ATOMIC-ORDER")
+        assert any("memory_order_release" in f.message for f in found)
+
+    def test_cpp_access_reorder_flags(self):
+        """Publishing head BEFORE the payload memcpy breaks the
+        data-then-head sequence the spec requires."""
+        original = (
+            "    std::memcpy(data() + pos, &len, 4);\n"
+            "    std::memcpy(data() + pos + 4, frame, n);\n"
+            "    word(kRingHeadWord)->store(publish_head_, "
+            "std::memory_order_release);"
+        )
+        mutated_block = (
+            "    word(kRingHeadWord)->store(publish_head_, "
+            "std::memory_order_release);\n"
+            "    std::memcpy(data() + pos, &len, 4);\n"
+            "    std::memcpy(data() + pos + 4, frame, n);"
+        )
+        src = _read("csrc/shm.h")
+        assert original in src
+        report = self._both(shm_src=src.replace(original, mutated_block))
+        found = _rules(report, "ATOMIC-ORDER")
+        assert any(
+            "write_frame" in f.message and "conform" in f.message
+            for f in found
+        )
+
+    def test_py_access_reorder_flags(self):
+        """Same mutation on the Python side: publish before pack."""
+        original = (
+            '        struct.pack_into("<I", self._data, pos, '
+            "self._INLINE)\n"
+            "        self._u64[self._HEAD] = self._publish_head"
+        )
+        mutated = (
+            "        self._u64[self._HEAD] = self._publish_head\n"
+            '        struct.pack_into("<I", self._data, pos, '
+            "self._INLINE)"
+        )
+        src = _read("torchbeast_tpu/runtime/transport.py")
+        assert original in src
+        report = self._both(transport_src=src.replace(original, mutated))
+        found = _rules(report, "ATOMIC-ORDER")
+        assert any(
+            "write_inline_marker" in f.message for f in found
+        ), [f.render() for f in found]
+
+    def test_py_raw_index_flags(self):
+        src = _read("torchbeast_tpu/runtime/transport.py").replace(
+            "self._u64[self._HEAD] = self._publish_head",
+            "self._u64[0] = self._publish_head",
+        )
+        report = self._both(transport_src=src)
+        found = _rules(report, "ATOMIC-ORDER")
+        assert any("raw index" in f.message for f in found)
+
+    def test_recheck_constant_drift_flags(self):
+        """The bounded-recheck period is part of the verified spec:
+        changing one side must flag against protocol.RECHECK_MS."""
+        src = _read("csrc/shm.h").replace(
+            "constexpr int kWakeRecheckMs = 20;",
+            "constexpr int kWakeRecheckMs = 500;",
+        )
+        report = self._both(shm_src=src)
+        found = _rules(report, "ATOMIC-ORDER")
+        assert any("kWakeRecheckMs" in f.message for f in found)
+
+    def test_missing_cpp_side_is_a_finding(self):
+        report = analyze_cxx_sources({
+            lint_config.TRANSPORT_PY: _read(
+                "torchbeast_tpu/runtime/transport.py"
+            ),
+        })
+        found = _rules(report, "ATOMIC-ORDER")
+        assert any("unchecked" in f.message for f in found)
+
+    def test_spec_sequences_match_both_languages_directly(self):
+        """Belt and braces: the extracted per-method sequences equal
+        SPEC_ACCESS verbatim in both languages (not merely 'no
+        finding')."""
+        shm_ctx = cxx.CxxFileContext(
+            lint_config.SHM_H, _read("csrc/shm.h")
+        )
+        tree = ast.parse(_read("torchbeast_tpu/runtime/transport.py"))
+        ring_cls = next(
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef) and n.name == "ShmRing"
+        )
+        for fn_name, spec in protocol.SPEC_ACCESS.items():
+            cpp = tuple(cxx.collapse(
+                cxx.access_sequence(shm_ctx, "ShmRing", fn_name)
+            ))
+            py = tuple(cxx.collapse(
+                cxxrules._py_access_sequence(ring_cls, fn_name)
+            ))
+            assert cpp == spec, (fn_name, cpp, spec)
+            assert py == spec, (fn_name, py, spec)
+
+
+# ---------------------------------------------------------------------------
+# CXX-LOCK-DISCIPLINE semantics beyond the selftest fixtures
+
+
+class TestCxxLockDiscipline:
+    def test_guarded_member_unlocked_access_flags(self):
+        src = """
+class Q {
+ public:
+  int peek() { return total_; }
+ private:
+  std::mutex mu_;
+  int total_ = 0;  // guarded-by: mu_
+};
+"""
+        report = analyze_cxx_sources({"csrc/fixture.h": src})
+        found = _rules(report, "CXX-LOCK-DISCIPLINE")
+        assert found and "guarded-by" in found[0].message
+
+    def test_early_unlock_ends_the_hold(self):
+        src = """
+class Q {
+ public:
+  int peek() {
+    std::unique_lock<std::mutex> l(mu_);
+    l.unlock();
+    return total_;
+  }
+ private:
+  std::mutex mu_;
+  int total_ = 0;  // guarded-by: mu_
+};
+"""
+        report = analyze_cxx_sources({"csrc/fixture.h": src})
+        assert _rules(report, "CXX-LOCK-DISCIPLINE")
+
+    def test_cross_root_conflict_without_guard_flags(self):
+        src = """
+class Pump {
+ public:
+  void start() {
+    threads_.emplace_back([this] { drain(); });
+    threads_.emplace_back([this] { publish(); });
+  }
+  void drain() { seen_ = 1; }
+  void publish() { int x = seen_; }
+ private:
+  std::mutex mu_;
+  int seen_ = 0;
+  std::vector<std::thread> threads_;
+};
+"""
+        report = analyze_cxx_sources({"csrc/fixture.h": src})
+        found = _rules(report, "CXX-LOCK-DISCIPLINE")
+        assert found and "no common lock" in found[0].message
+
+    def test_atomic_member_is_exempt(self):
+        src = """
+class Pump {
+ public:
+  void start() {
+    threads_.emplace_back([this] { drain(); });
+    threads_.emplace_back([this] { publish(); });
+  }
+  void drain() { seen_.store(1); }
+  void publish() { int x = seen_.load(); }
+ private:
+  std::mutex mu_;
+  std::atomic<int> seen_{0};
+  std::vector<std::thread> threads_;
+};
+"""
+        report = analyze_cxx_sources({"csrc/fixture.h": src})
+        assert not _rules(report, "CXX-LOCK-DISCIPLINE"), [
+            f.render() for f in report.findings
+        ]
+
+    def test_real_csrc_is_clean_with_reasoned_suppressions(self):
+        """The shipped C++ core passes: annotations + suppressions
+        carry reasons (the burn-down contract)."""
+        report = analysis.analyze_paths(["csrc"], root=REPO)
+        found = _rules(report, "CXX-LOCK-DISCIPLINE")
+        assert not found, [f.render() for f in found]
+        for finding, sup in report.suppressed:
+            if finding.rule == "CXX-LOCK-DISCIPLINE":
+                assert sup.reason
+
+
+# ---------------------------------------------------------------------------
+# The protocol model checker
+
+
+class TestProtocolChecker:
+    def test_shipped_spec_verifies_exhaustively(self):
+        result = protocol.check_protocol()
+        assert result.ok, result.as_dict()
+        assert result.properties == {
+            "fifo": True, "error_free": True, "no_wedge": True,
+            "success_reachable": True,
+        }
+        # Exhaustive means a real state space, not a trivial one.
+        assert result.states > 500
+
+    def test_safe_slower_variant_also_verifies(self):
+        """Coalescing off (ring every send) is the safe-slow variant:
+        the checker accepts it — it rejects broken protocols, not
+        different ones."""
+        result = protocol.check_protocol(
+            protocol.Spec(coalesce_wakeups=False)
+        )
+        assert result.ok, result.as_dict()
+
+    def test_every_seeded_mutant_is_caught_with_a_trace(self):
+        for name, spec in protocol.MUTATIONS.items():
+            result = protocol.check_protocol(spec)
+            assert not result.ok, name
+            assert result.violations, name
+            for v in result.violations:
+                assert v.trace, (name, v.detail)
+
+    def test_metastable_wait_mutant_wedges(self):
+        """The PR 9 metastable-wait class: no bounded recheck => a lost
+        wakeup parks the reader forever, found as a wedge trace ending
+        in a blocked reader with undelivered frames."""
+        result = protocol.check_protocol(
+            protocol.MUTATIONS["no_wake_recheck"]
+        )
+        wedges = [v for v in result.violations if v.kind == "wedge"]
+        assert wedges
+        assert any("reader=blocked" in v.detail for v in wedges)
+        assert any("r:block" in step for v in wedges for step in v.trace)
+
+    def test_fenceless_oversized_path_mutant_reproduces_pr3_bug(self):
+        """THE historical counterexample: without inline recovery, the
+        fence-less waiting-flag race lands the 0x02 byte on a blocked
+        reader — the checker must find the exact sequence (sender skips
+        the WAKE on stale waiting=0, reader blocks, inline byte
+        arrives)."""
+        result = protocol.check_protocol(
+            protocol.MUTATIONS["no_inline_recovery"]
+        )
+        assert not result.ok
+        traces = [
+            v.trace for v in result.violations
+            if any("r:inline_byte_blocked" in s for s in v.trace)
+        ]
+        assert traces, result.as_dict()
+        trace = traces[0]
+        assert any(s.startswith("w:skip_bell") for s in trace)
+        assert any(s == "r:block" for s in trace)
+        assert any(s == "w:send_inline_byte" for s in trace)
+
+    def test_acceptance_bundle(self):
+        verdict = protocol.verify_shipped_and_mutants()
+        assert verdict["ok"], verdict
+        assert verdict["shipped"]["ok"]
+        assert set(verdict["mutants"]) == set(protocol.MUTATIONS)
+        for name, m in verdict["mutants"].items():
+            assert not m["ok"] and m["violations"], name
+
+    def test_render_trace_format(self):
+        """The README's documented counterexample format: numbered
+        actor:action steps, then the violated property."""
+        v = protocol.Violation(
+            "wedge", "success unreachable",
+            ["w:publish[0:ring]", "r:arm_waiting", "r:block"],
+        )
+        text = protocol.render_trace(v)
+        lines = text.splitlines()
+        assert lines[0].strip().startswith("1. w:publish")
+        assert lines[-1].strip() == "=> WEDGE: success unreachable"
+
+    def test_cli_check_protocol(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchbeast_tpu.analysis",
+             "--check-protocol"],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        verdict = json.loads(proc.stdout.splitlines()[0])
+        assert verdict["ok"]
+        assert all(
+            m["found"] for m in verdict["mutants"].values()
+        )
+        assert "counterexample" in proc.stdout
+
+    def test_state_cap_raises_instead_of_truncating(self):
+        with pytest.raises(RuntimeError, match="state space"):
+            protocol.check_protocol(max_states=10)
+
+
+# ---------------------------------------------------------------------------
+# --diff mode covers csrc
+
+
+def test_diff_patterns_include_cxx():
+    from torchbeast_tpu.analysis.__main__ import DIFF_PATTERNS
+
+    assert "*.h" in DIFF_PATTERNS and "*.cc" in DIFF_PATTERNS
+    assert "*.py" in DIFF_PATTERNS

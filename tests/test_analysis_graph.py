@@ -716,7 +716,12 @@ class TestDiffMode:
 
         changed = changed_files(REPO, "HEAD")
         assert isinstance(changed, set)
-        assert all(p.endswith(".py") for p in changed)
+        # ISSUE 10: the diff scope covers Python AND the C++ core, so a
+        # csrc-only change still runs the C++ rules.
+        assert all(
+            p.endswith((".py", ".h", ".hpp", ".cc", ".cpp"))
+            for p in changed
+        )
 
     def test_only_paths_filters_findings_but_not_graph(self):
         bad = (

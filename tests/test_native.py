@@ -704,6 +704,12 @@ def test_native_pool_shm_end_to_end():
     assert telemetry["bytes_up"] > 0
     assert telemetry["bytes_down"] > 0
     assert telemetry["connects"] == 1
+    # Doorbell-wait counters (ISSUE 10): cumulative, recheck wakeups
+    # are a subset of armed waits.
+    assert telemetry["ring_doorbell_waits"] >= 0
+    assert 0 <= telemetry["ring_recheck_wakeups"] <= (
+        telemetry["ring_doorbell_waits"]
+    )
 
 
 def _shm_segments():
@@ -793,21 +799,44 @@ def test_native_telemetry_fold():
     queue.enqueue(np.zeros((1, 2), np.float32))
     queue.dequeue_many()
 
+    class FakePool:
+        """pool.telemetry() shape incl. the ISSUE 10 ring counters."""
+
+        def __init__(self):
+            self.waits = 7
+            self.rechecks = 2
+
+        def telemetry(self):
+            return {
+                "env_steps": 0, "connects": 0, "reconnects": 0,
+                "bytes_up": 0, "bytes_down": 0,
+                "ring_doorbell_waits": self.waits,
+                "ring_recheck_wakeups": self.rechecks,
+            }
+
+    fake_pool = FakePool()
     registry = MetricsRegistry()
     folder = NativeTelemetryFolder(
-        registry, pool=None, batcher=batcher, queue=queue
+        registry, pool=fake_pool, batcher=batcher, queue=queue
     )
     folder.tick()
+    assert registry.counter("ring.doorbell_waits").value() == 7
+    assert registry.counter("ring.recheck_wakeups").value() == 2
+    # Delta semantics: the fold credits increments, not absolutes.
+    fake_pool.waits = 10
     assert registry.counter("learner_queue.items_in").value() == 1
     rtt = registry.histogram("actor.request_rtt_s")
     wait = registry.histogram("inference.request_wait_s")
     assert rtt.count == 1 and wait.count == 1
     assert rtt.mean >= wait.mean >= 0.0
     assert registry.histogram("learner_queue.batch_size").count == 1
-    # Second tick: interval semantics — nothing new happened, nothing
-    # double-counted.
+    # Second tick: interval semantics — the queue/batcher series saw
+    # nothing new (no double counting), and the ring counters credit
+    # only the delta since the previous tick.
     folder.tick()
     assert registry.counter("learner_queue.items_in").value() == 1
     assert rtt.count == 1
+    assert registry.counter("ring.doorbell_waits").value() == 10
+    assert registry.counter("ring.recheck_wakeups").value() == 2
     queue.close()
     batcher.close()

@@ -98,6 +98,38 @@ def test_shm_pipe_fuzz_roundtrip():
         client.close()
 
 
+def test_ring_wait_counters():
+    """ring.doorbell_waits / ring.recheck_wakeups (ISSUE 10): a blocked
+    recv that never sees a doorbell byte rides the bounded recheck, and
+    both counters record it — the metastability hunt's data source."""
+    from torchbeast_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    waits0 = reg.counter("ring.doorbell_waits").value()
+    rechecks0 = reg.counter("ring.recheck_wakeups").value()
+    server, client = transport.shm_pipe(
+        obs_ring_bytes=4096, act_ring_bytes=4096
+    )
+    try:
+        client._recv_timeout_s = 0.08
+        with pytest.raises(socket.timeout):
+            client.recv_sized()
+        waits = reg.counter("ring.doorbell_waits").value() - waits0
+        rechecks = reg.counter("ring.recheck_wakeups").value() - rechecks0
+        assert rechecks >= 1  # at least one bounded recheck fired
+        assert waits >= rechecks  # every recheck rode an armed wait
+        # A frame that arrives while unblocked is consumed without
+        # touching the doorbell: the counters are wait-path-only.
+        waits1 = reg.counter("ring.doorbell_waits").value()
+        server.send({"x": 1})
+        value, _ = client.recv_sized()
+        assert value == {"x": 1}
+        assert reg.counter("ring.doorbell_waits").value() == waits1
+    finally:
+        server.close()
+        client.close()
+
+
 def test_shm_ring_wraparound():
     """Many variable-size frames through a tiny ring force every wrap
     case (marker wrap, <4-byte implicit wrap, exact fit)."""

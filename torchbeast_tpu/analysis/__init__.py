@@ -35,6 +35,26 @@ summaries in analysis/summaries.py:
                         call site; device-returning helpers taint
                         their callers
 
+Cross-language C++ rules (ISSUE 10) ride the stdlib-only C++ frontend
+in analysis/cxx.py (lexer + extractor over csrc/*.h|*.cc — no libclang)
+and the protocol spec in analysis/protocol.py (whose exhaustive model
+checker runs as `--check-protocol`):
+
+    GIL-DISCIPLINE       CPython API calls in the binding layer only
+                         with the GIL held; no blocking calls (waits,
+                         recvs, queue dequeues — direct or via the
+                         may-block call summary) while holding it;
+                         acquire/release pairing balanced
+    ATOMIC-ORDER         shm ring header words only through the
+                         designated atomic accessors with the documented
+                         memory orders (C++) / named offsets (Python);
+                         both languages' access sequences conform to the
+                         model-checked protocol spec
+    CXX-LOCK-DISCIPLINE  `// guarded-by: mu_` members only touched under
+                         an RAII guard, plus cross-root conflicts over
+                         std::thread spawn sites and Python-facing entry
+                         methods (the C++ half of PR 7's thread graph)
+
 See README "Static analysis" for the suppression syntax and how to add a
 rule. The package is stdlib-only by contract (enforced by its own
 IMPORT-PURITY entry).
@@ -52,13 +72,15 @@ from .engine import (  # noqa: F401
     run_rules,
     write_baseline,
 )
+from .cxxrules import CXX_RULES  # noqa: F401
 from .parity import REPO_RULES as PARITY_RULES  # noqa: F401
 from .rules import CONCURRENCY_RULES, FILE_RULES  # noqa: F401
 
-# Repo-level rules: cross-language/cross-driver parity plus the
+# Repo-level rules: cross-language/cross-driver parity, the
 # whole-program concurrency rules (which share one Program model per
-# run via graph.get_program's cache).
-REPO_RULES = list(PARITY_RULES) + list(CONCURRENCY_RULES)
+# run via graph.get_program's cache), and the C++ concurrency rules
+# over the analysis/cxx.py frontend contexts.
+REPO_RULES = list(PARITY_RULES) + list(CONCURRENCY_RULES) + list(CXX_RULES)
 
 ALL_RULE_NAMES = (
     {r.name for r in FILE_RULES}
@@ -90,6 +112,29 @@ def analyze_sources(sources, repo_rules=None):
         contexts,
         FILE_RULES,
         repo_rules if repo_rules is not None else list(CONCURRENCY_RULES),
+        root="/",
+        known_rules=ALL_RULE_NAMES,
+    )
+
+
+def analyze_cxx_sources(sources, repo_rules=None):
+    """Lint a {path: source} fixture program through the C++ frontend:
+    .h/.cc paths load as CxxFileContext, .py paths as FileContext, and
+    the C++ rules (by default) run over the whole set — the selftest /
+    test harness entry for GIL-DISCIPLINE, ATOMIC-ORDER, and
+    CXX-LOCK-DISCIPLINE fixtures."""
+    from . import cxx
+
+    contexts = [
+        cxx.CxxFileContext(path, src)
+        if path.endswith((".h", ".hpp", ".cc", ".cpp"))
+        else FileContext(path, src)
+        for path, src in sources.items()
+    ]
+    return run_rules(
+        contexts,
+        [],
+        repo_rules if repo_rules is not None else list(CXX_RULES),
         root="/",
         known_rules=ALL_RULE_NAMES,
     )

@@ -34,17 +34,24 @@ from .rules import FILE_RULES
 DEFAULT_BASELINE = ".beastlint-baseline.json"
 
 
+# The changed-file filter's path patterns: Python sources AND the C++
+# core (ISSUE 10 satellite — the pre-commit wrapper used to feed only
+# Python paths, so a csrc-only change skipped the C++ rules entirely).
+DIFF_PATTERNS = ("*.py", "*.h", "*.hpp", "*.cc", "*.cpp")
+
+
 def changed_files(root: str, ref: str):
-    """Repo-relative .py files changed vs `ref` (committed + working
-    tree + untracked) — the `--diff` scope. Raises on git failure so
-    the CLI exits 2 instead of silently linting nothing."""
+    """Repo-relative .py/.h/.cc files changed vs `ref` (committed +
+    working tree + untracked) — the `--diff` scope. Raises on git
+    failure so the CLI exits 2 instead of silently linting nothing."""
     out = subprocess.run(
-        ["git", "-C", root, "diff", "--name-only", ref, "--", "*.py"],
+        ["git", "-C", root, "diff", "--name-only", ref, "--",
+         *DIFF_PATTERNS],
         capture_output=True, text=True, check=True,
     ).stdout
     untracked = subprocess.run(
         ["git", "-C", root, "ls-files", "--others",
-         "--exclude-standard", "--", "*.py"],
+         "--exclude-standard", "--", *DIFF_PATTERNS],
         capture_output=True, text=True, check=True,
     ).stdout
     return {
@@ -70,6 +77,12 @@ def main(argv=None) -> int:
     parser.add_argument("--selftest", action="store_true",
                         help="Run the embedded rule fixtures and print a "
                              "JSON verdict.")
+    parser.add_argument("--check-protocol", action="store_true",
+                        help="Exhaustively model-check the shm ring + "
+                             "doorbell protocol spec (and prove the "
+                             "seeded mutations produce counterexample "
+                             "traces); prints a JSON verdict plus the "
+                             "mutants' traces.")
     parser.add_argument("--diff", metavar="GIT_REF", default=None,
                         help="Lint only files changed vs GIT_REF "
                              "(committed, working tree, and untracked); "
@@ -91,6 +104,11 @@ def main(argv=None) -> int:
         from .selftest import main as selftest_main
 
         return selftest_main()
+
+    if args.check_protocol:
+        from .protocol import main as protocol_main
+
+        return protocol_main()
 
     if args.list_rules:
         for rule in (*FILE_RULES, *REPO_RULES):
@@ -125,14 +143,16 @@ def main(argv=None) -> int:
                         "findings": [], "suppressed": [],
                         "baselined": [], "files_scanned": 0,
                         "elapsed_s": 0.0,
-                        "note": f"no .py files changed vs {args.diff}",
+                        "note": "no .py/.h/.cc files changed vs "
+                                f"{args.diff}",
                     }
                     if args.ci:
                         doc["ci"] = "PASS"
                     print(json.dumps(doc))
                 else:
                     print(
-                        f"beastlint: no .py files changed vs {args.diff}"
+                        "beastlint: no .py/.h/.cc files changed vs "
+                        f"{args.diff}"
                     )
                     if args.ci:
                         print("beastlint-ci: PASS")
@@ -147,7 +167,7 @@ def main(argv=None) -> int:
             f"beastlint: --diff failed: {e.stderr or e}", file=sys.stderr
         )
         return 2
-    except Exception as e:  # noqa: BLE001 - CLI boundary
+    except Exception as e:  # beastlint: disable=EXCEPT-SWALLOW  CLI boundary: the failure is printed to stderr and surfaced as exit code 2
         print(f"beastlint: internal error: {e}", file=sys.stderr)
         return 2
     report.elapsed_s = round(time.perf_counter() - t0, 3)

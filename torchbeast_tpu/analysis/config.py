@@ -35,11 +35,16 @@ PURITY = {
 # `except Exception:` / `except BaseException:` body must re-raise,
 # log, or count the failure. These are the pipeline's failure-handling
 # layers — a silent swallow here is exactly how a DEGRADED run hides
-# (ISSUE 6). Other packages stay out of scope: broad-but-silent guards
-# in benches/tests are noise, not hidden outages.
+# (ISSUE 6). telemetry/ and analysis/ joined in ISSUE 10: a swallowed
+# exporter failure silently drops observability, and a swallowed
+# analyzer failure silently stops checking a contract. Other packages
+# stay out of scope: broad-but-silent guards in benches/tests are
+# noise, not hidden outages.
 EXCEPT_SWALLOW_PATHS = (
     "torchbeast_tpu/runtime",
     "torchbeast_tpu/resilience",
+    "torchbeast_tpu/telemetry",
+    "torchbeast_tpu/analysis",
 )
 
 # WIRE-PARITY anchors: the Python codec and its C++ mirrors.
@@ -127,6 +132,43 @@ CONCURRENCY_PATHS = (
 # they appear inside CONCURRENCY_PATHS (the driver main loops of
 # polybeast/monobeast/anakin/polybeast_env/chaos_run).
 THREAD_ROOT_FUNCTIONS = ("main", "train", "cli")
+
+# ---------------------------------------------------------------------
+# C++ analysis scope (ISSUE 10, analysis/cxx.py + cxxrules.py).
+
+# GIL-DISCIPLINE: files whose CPython API calls must be dominated by a
+# GIL acquire (in-function or via the call summary) and whose GIL-held
+# regions must not make blocking calls (waits, socket recvs, queue
+# dequeues). pymodule.cc is the binding layer; actor_pool.h hosts the
+# slot hooks' call sites (its threads run GIL-free by design, so a
+# CPython call appearing there without an acquire is a bug by
+# construction).
+GIL_FILES = (
+    "csrc/pymodule.cc",
+    "csrc/actor_pool.h",
+)
+
+# CXX-LOCK-DISCIPLINE / cross-root conflict scope: every C++ source the
+# frontend lexes. Classes are in conflict scope only when they own a
+# mutex or one of their methods is a thread-spawn target — same
+# "you lock because you share" heuristic as the Python RACE rule.
+CXX_PATHS = ("csrc",)
+
+# ATOMIC-ORDER: the required memory order at the KEY publish/Dekker
+# sites of csrc/shm.h, keyed by (function, word, op). Sites not listed
+# only need an EXPLICIT order through the designated accessor; listed
+# sites must use exactly this one (weakening the publish to relaxed is
+# a lost-wakeup, not a style choice).
+ATOMIC_ORDER_REQUIRED = {
+    ("write_frame", "head", "store"): "release",
+    ("write_inline_marker", "head", "store"): "release",
+    ("release", "tail", "store"): "release",
+    ("set_waiting", "waiting", "store"): "seq_cst",
+    ("has_frame", "head", "load"): "acquire",
+    ("reader_waiting", "waiting", "load"): "acquire",
+    ("read_frame", "head", "load"): "acquire",
+    ("wait_free", "tail", "load"): "acquire",
+}
 
 # Shared by HOTPATH-SYNC (intraprocedural) and HOTPATH-SYNC-XPROC
 # (summary-based): jax.* namespaces that do HOST work (rooted there does

@@ -1,0 +1,1086 @@
+"""beastlint C++ frontend (ISSUE 10): a stdlib-only lexer + extractor
+over `csrc/*.h` / `*.cc`.
+
+No libclang, no compiler — the same purity contract as the rest of the
+package (enforced by its own IMPORT-PURITY entry). The frontend is NOT a
+C++ parser: it is a tokenizer plus a small set of shape-matchers scoped
+to the declaration idioms this repo actually uses (trailing-underscore
+members one per line, `std::lock_guard`/`unique_lock` RAII locking,
+brace-balanced function bodies, `PyMethodDef` tables). Rules built on it
+(analysis/cxxrules.py) stay conservative: anything the matchers cannot
+resolve is silence, not a guess — except where a contract says an
+unparseable side must itself be a finding (WIRE-PARITY precedent).
+
+What it extracts per file (`CxxFileContext`):
+
+- comments (line -> text) and the beastlint annotation grammar in its
+  `//` spelling: `// beastlint: disable=RULE  reason` (trailing or
+  standalone-covering-next-line), `// beastlint: holds mu_`,
+  `// guarded-by: mu_` — same semantics as the Python engine, so one
+  suppression mechanism covers both languages.
+- classes with their member declarations (name, type text, line,
+  atomic/mutex/const classification, guarded-by annotations).
+- functions (free + methods) with token spans, a name-based call graph,
+  lexical lock-held scopes, `std::thread`/`emplace_back(lambda)` spawn
+  sites, and per-token GIL state (PyGILState_Ensure/Release,
+  Py_BEGIN/END_ALLOW_THREADS, the `call_nogil(...)` idiom, RAII
+  GILGuard).
+- shm ring header accesses: every use of the kRing*Word constants with
+  its accessor shape and explicit memory order (ATOMIC-ORDER's raw
+  material), plus data-region accesses for the protocol conformance
+  sequences.
+"""
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext, Finding, Suppression
+
+# ---------------------------------------------------------------------------
+# Lexer
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<id>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<num>0[xX][0-9a-fA-F']+|\d[\d.']*(?:[eE][+-]?\d+)?[uUlLfF]*)
+  | (?P<str>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<punct>->|\+\+|--|<<=|>>=|<=|>=|==|!=|&&|\|\||\+=|-=|\*=|/=|\|=|&=|\^=|::|[{}()\[\];,<>=+\-*/!&|^~%?:.\#])
+    """,
+    re.VERBOSE,
+)
+
+_LINE_COMMENT_RE = re.compile(r"//[^\n]*")
+_BLOCK_COMMENT_RE = re.compile(r"/\*.*?\*/", re.DOTALL)
+
+_DISABLE_RE = re.compile(r"//\s*beastlint:\s*disable=([A-Za-z0-9_,\-]+)\s*(.*)$")
+_HOLDS_RE = re.compile(r"//\s*beastlint:\s*holds\s+(\S+)")
+_GUARDED_RE = re.compile(r"//\s*guarded-by:\s*(\S+)")
+
+_KEYWORDS = {
+    "if", "else", "for", "while", "do", "switch", "case", "return",
+    "throw", "try", "catch", "new", "delete", "sizeof", "static_cast",
+    "reinterpret_cast", "const_cast", "dynamic_cast", "using",
+    "namespace", "class", "struct", "enum", "template", "typename",
+    "public", "private", "protected", "const", "constexpr", "static",
+    "inline", "virtual", "override", "final", "noexcept", "mutable",
+    "default", "break", "continue", "auto", "void", "bool", "int",
+    "char", "float", "double", "unsigned", "signed", "long", "short",
+    "true", "false", "nullptr", "this", "operator", "friend", "explicit",
+    "typedef", "extern", "goto", "alignas", "alignof", "decltype",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Token:
+    kind: str  # 'id' | 'num' | 'str' | 'punct'
+    text: str
+    line: int
+
+
+def lex(source: str) -> Tuple[List[Token], Dict[int, str], Dict[int, bool]]:
+    """(tokens, comments{line: text}, comment_only{line: bool}).
+
+    Comments and string literals are stripped before tokenizing (a `{`
+    in a string must not unbalance brace matching); comments are kept
+    aside for the annotation grammar.
+    """
+    comments: Dict[int, str] = {}
+    code_lines: Set[int] = set()
+
+    def _blank(match: "re.Match[str]") -> str:
+        # Replace with same-shape whitespace so line numbers survive.
+        return re.sub(r"[^\n]", " ", match.group(0))
+
+    # Block comments first (a // inside /* */ is not a line comment).
+    stripped = _BLOCK_COMMENT_RE.sub(_blank, source)
+    lines = stripped.split("\n")
+    out_lines = []
+    for i, line in enumerate(lines, start=1):
+        m = _LINE_COMMENT_RE.search(line)
+        if m is not None:
+            comments[i] = m.group(0)
+            line = line[: m.start()]
+        out_lines.append(line)
+    stripped = "\n".join(out_lines)
+
+    tokens: List[Token] = []
+    line = 1
+    pos = 0
+    for m in _TOKEN_RE.finditer(stripped):
+        line += stripped.count("\n", pos, m.start())
+        pos = m.start()
+        kind = m.lastgroup or "punct"
+        if kind != "str":
+            tokens.append(Token(kind, m.group(0), line))
+        else:
+            tokens.append(Token("str", "<str>", line))
+        code_lines.add(line)
+
+    comment_only = {
+        ln: ln not in code_lines for ln in comments
+    }
+    return tokens, comments, comment_only
+
+
+# ---------------------------------------------------------------------------
+# Declarations
+
+@dataclasses.dataclass
+class CxxMember:
+    name: str
+    line: int
+    type_text: str
+    is_atomic: bool
+    is_mutex: bool
+    is_const: bool
+
+
+@dataclasses.dataclass
+class CxxClass:
+    name: str
+    start_line: int
+    end_line: int
+    members: Dict[str, CxxMember]
+    guarded: Dict[str, str]  # member -> lock member (guarded-by)
+    methods: Dict[str, "CxxFunction"]
+
+    @property
+    def lock_attrs(self) -> Set[str]:
+        return {m.name for m in self.members.values() if m.is_mutex}
+
+
+@dataclasses.dataclass
+class CxxFunction:
+    name: str
+    qual: str  # Class::name or ::name
+    class_name: Optional[str]
+    start_line: int
+    end_line: int
+    # Token span: signature start .. closing brace (inclusive), so
+    # mem-initializer lists are part of the searchable region.
+    tokens: List[Token]
+    body_start: int  # index into `tokens` of the opening '{'
+
+
+_MEMBER_LINE_RE = re.compile(
+    r"^\s*(?:mutable\s+)?(?P<type>[A-Za-z_][\w:<>,*&\s.()]*?)\s+"
+    r"(?P<name>[A-Za-z_]\w*)\s*(?:\{[^{}]*\}\s*|=\s*[^;]*)?;\s*$"
+)
+
+
+class CxxFileContext:
+    """One lexed C++ source file plus its beastlint annotations.
+
+    Mirrors the engine FileContext interface the suppression machinery
+    needs (`path`, `suppressions`, `suppression_for`, `comment_only`) so
+    `run_rules` applies inline suppressions to C++ findings exactly as
+    it does to Python ones. `is_cxx` keeps the Python file rules away.
+    """
+
+    is_cxx = True
+
+    def __init__(self, path: str, source: str, abspath: str = ""):
+        import os
+
+        self.path = path.replace(os.sep, "/")
+        self.abspath = abspath or path
+        self.source = source
+        self.tokens, self.comments, self._comment_only = lex(source)
+        self.suppressions: List[Suppression] = []
+        self._holds: Dict[int, str] = {}
+        self.guarded_annotations: Dict[int, str] = {}
+        self._parse_annotations()
+        self.functions: List[CxxFunction] = []
+        self.classes: Dict[str, CxxClass] = {}
+        self._fn_end_index: Dict[int, int] = {}
+        self._extract()
+
+    # -- annotations (same grammar as engine.FileContext, // spelling) ------
+
+    def _parse_annotations(self) -> None:
+        for line, text in self.comments.items():
+            m = _DISABLE_RE.search(text)
+            if m:
+                rules_text, reason = m.group(1), m.group(2).strip()
+                names = {r.strip() for r in rules_text.split(",") if r.strip()}
+                self.suppressions.append(
+                    Suppression(
+                        line=line,
+                        rules=None if "all" in names else names,
+                        reason=reason,
+                        standalone=self._comment_only.get(line, False),
+                    )
+                )
+                continue
+            m = _HOLDS_RE.search(text)
+            if m:
+                self._holds[line] = m.group(1)
+            m = _GUARDED_RE.search(text)
+            if m:
+                self.guarded_annotations[line] = m.group(1)
+
+    def comment_only(self, line: int) -> bool:
+        return self._comment_only.get(line, False)
+
+    def holds_annotation_for_line(self, line: int) -> Optional[str]:
+        for ln in (line - 1, line):
+            if ln in self._holds:
+                return self._holds[ln]
+        return None
+
+    # The engine's window semantics, literally shared (one suppression
+    # mechanism for both languages — a change to the coverage rules in
+    # engine.py applies here by construction).
+    suppression_for = FileContext.suppression_for
+
+    # -- structure extraction ----------------------------------------------
+
+    def _extract(self) -> None:
+        toks = self.tokens
+        n = len(toks)
+        i = 0
+        # Scope stack entries: (kind, name, close_depth) where kind in
+        # {"namespace", "class"}; depth = brace depth the scope closes at.
+        depth = 0
+        scope: List[Tuple[str, str, int]] = []
+        class_spans: List[Tuple[str, int, int]] = []  # (name, start_i, end_i)
+
+        def current_class() -> Optional[str]:
+            for kind, name, _ in reversed(scope):
+                if kind == "class":
+                    return name
+            return None
+
+        while i < n:
+            tok = toks[i]
+            if tok.kind == "punct" and tok.text == "{":
+                depth += 1
+                i += 1
+                continue
+            if tok.kind == "punct" and tok.text == "}":
+                depth -= 1
+                while scope and scope[-1][2] > depth:
+                    kind, name, _ = scope.pop()
+                i += 1
+                continue
+            if tok.kind == "id" and tok.text in ("namespace",):
+                # namespace X { ... }
+                j = i + 1
+                name = ""
+                if j < n and toks[j].kind == "id":
+                    name = toks[j].text
+                    j += 1
+                if j < n and toks[j].text == "{":
+                    scope.append(("namespace", name, depth + 1))
+                    depth += 1
+                    i = j + 1
+                    continue
+                i = j
+                continue
+            if tok.kind == "id" and tok.text in ("class", "struct") and (
+                i + 1 < n and toks[i + 1].kind == "id"
+            ):
+                # class NAME [: bases] { ... }   (skip `class X;` decls and
+                # `enum class`).
+                if i > 0 and toks[i - 1].kind == "id" and (
+                    toks[i - 1].text == "enum"
+                ):
+                    i += 1
+                    continue
+                name = toks[i + 1].text
+                j = i + 2
+                while j < n and toks[j].text not in ("{", ";"):
+                    j += 1
+                if j < n and toks[j].text == "{":
+                    scope.append(("class", name, depth + 1))
+                    start_i = j + 1
+                    # record span lazily: find matching close
+                    d = 1
+                    k = start_i
+                    while k < n and d > 0:
+                        if toks[k].text == "{":
+                            d += 1
+                        elif toks[k].text == "}":
+                            d -= 1
+                        k += 1
+                    class_spans.append((name, start_i, k - 1))
+                    depth += 1
+                    i = j + 1
+                    continue
+                i = j
+                continue
+            # Function definition candidate: ID '(' ... ')' ...opt... '{'
+            if tok.kind == "id" and tok.text not in _KEYWORDS and (
+                i + 1 < n and toks[i + 1].text == "("
+            ):
+                fn = self._try_function(i, depth, current_class())
+                if fn is not None:
+                    self.functions.append(fn)
+                    # Skip past the body to avoid nested re-extraction
+                    # (lambdas stay part of this function).
+                    i = self._fn_end_index[id(fn)]
+                    continue
+            # operator overloads: `operator` punct... '('
+            if tok.kind == "id" and tok.text == "operator":
+                j = i + 1
+                name = "operator"
+                while j < n and toks[j].kind == "punct" and toks[j].text != "(":
+                    name += toks[j].text
+                    j += 1
+                if j < n and toks[j].text == "(":
+                    fn = self._try_function(i, depth, current_class(),
+                                            name_override=name,
+                                            paren_index=j)
+                    if fn is not None:
+                        self.functions.append(fn)
+                        i = self._fn_end_index[id(fn)]
+                        continue
+            i += 1
+
+        # Attach methods to classes + parse member declarations.
+        fn_ranges = [(f.start_line, f.end_line) for f in self.functions]
+        src_lines = self.source.split("\n")
+        line_spans = [
+            (name, toks[s].line if s < n else 0,
+             toks[e].line if e < n else 0)
+            for name, s, e in class_spans
+        ]
+        for name, start_line, end_line in line_spans:
+            # Lines belonging to a class NESTED inside this one must not
+            # contribute members here (struct Frame inside ShmRing).
+            nested = [
+                (a, b) for other, a, b in line_spans
+                if other != name and a > start_line and b <= end_line
+            ]
+            members: Dict[str, CxxMember] = {}
+            guarded: Dict[str, str] = {}
+            methods = {
+                f.name: f for f in self.functions
+                if f.class_name == name
+            }
+            for ln in range(start_line, end_line + 1):
+                if any(a <= ln <= b for a, b in fn_ranges):
+                    continue  # inside a method body
+                if any(a - 1 <= ln <= b for a, b in nested):
+                    continue  # a nested class's declaration lines
+                raw = src_lines[ln - 1] if ln - 1 < len(src_lines) else ""
+                code = _LINE_COMMENT_RE.sub("", raw)
+                if "= delete" in code or "= default" in code:
+                    continue  # deleted/defaulted special members
+                m = _MEMBER_LINE_RE.match(code)
+                if not m:
+                    continue
+                type_text = m.group("type").strip()
+                mname = m.group("name")
+                if mname == "operator" or "operator" in type_text.split():
+                    continue
+                if type_text in ("return", "delete", "case", "goto"):
+                    continue
+                if "using" in type_text.split() or type_text.startswith(
+                    ("typedef", "friend")
+                ):
+                    continue
+                members[mname] = CxxMember(
+                    name=mname,
+                    line=ln,
+                    type_text=type_text,
+                    is_atomic="atomic" in type_text,
+                    is_mutex=bool(re.search(r"\bmutex\b", type_text)),
+                    is_const=bool(
+                        re.match(r"\s*(static\s+)?(constexpr|const)\b",
+                                 type_text)
+                    ),
+                )
+                annotation = self.guarded_annotations.get(ln)
+                if annotation is None and self._comment_only.get(ln - 1):
+                    annotation = self.guarded_annotations.get(ln - 1)
+                if annotation is not None:
+                    guarded[mname] = annotation.split(".")[-1]
+            self.classes[name] = CxxClass(
+                name=name, start_line=start_line, end_line=end_line,
+                members=members, guarded=guarded, methods=methods,
+            )
+
+    def _try_function(self, name_i: int, depth: int,
+                      class_name: Optional[str],
+                      name_override: Optional[str] = None,
+                      paren_index: Optional[int] = None
+                      ) -> Optional[CxxFunction]:
+        """Match ID '(' params ')' [qualifiers / mem-inits] '{' body '}'.
+
+        Returns None when the shape is a call / declaration / macro use
+        rather than a definition with a body.
+        """
+        toks = self.tokens
+        n = len(toks)
+        name = name_override or toks[name_i].text
+        # Heuristic: a definition is preceded by a type/qualifier token,
+        # '}'/';'/'{'/access-specifier ':' — NOT by '.', '->', '=', '(',
+        # ',', 'return' etc. (those are calls).
+        prev = toks[name_i - 1] if name_i > 0 else None
+        if prev is not None:
+            if prev.kind == "punct" and prev.text not in (
+                "}", ";", "{", ":", "&", "*", ">",
+            ):
+                return None
+            if prev.kind == "id" and prev.text in (
+                "return", "throw", "new", "case", "else", "do",
+            ):
+                return None
+            # `Foo::name(` — a qualified out-of-line definition; take the
+            # class from the qualifier.
+            if prev.text == "::" and name_i >= 2 and toks[name_i - 2].kind == "id":
+                class_name = toks[name_i - 2].text
+        j = paren_index if paren_index is not None else name_i + 1
+        # matching ')'
+        d = 0
+        while j < n:
+            if toks[j].text == "(":
+                d += 1
+            elif toks[j].text == ")":
+                d -= 1
+                if d == 0:
+                    break
+            j += 1
+        if j >= n:
+            return None
+        # After ')': allow qualifiers, mem-initializer lists, ->type,
+        # until '{' (definition) or ';'/'='/',' (declaration / something
+        # else). Track paren depth for mem-inits.
+        k = j + 1
+        d_paren = 0
+        while k < n:
+            t = toks[k]
+            if d_paren == 0 and t.text == "{":
+                break
+            if d_paren == 0 and t.text in (";", "=", ","):
+                return None
+            if t.text == "(":
+                d_paren += 1
+            elif t.text == ")":
+                d_paren -= 1
+            k += 1
+        if k >= n:
+            return None
+        body_open = k
+        d = 0
+        end = body_open
+        while end < n:
+            if toks[end].text == "{":
+                d += 1
+            elif toks[end].text == "}":
+                d -= 1
+                if d == 0:
+                    break
+            end += 1
+        if end >= n:
+            return None
+        span = toks[name_i : end + 1]
+        fn = CxxFunction(
+            name=name,
+            qual=f"{class_name}::{name}" if class_name else name,
+            class_name=class_name,
+            start_line=toks[name_i].line,
+            end_line=toks[end].line,
+            tokens=span,
+            body_start=body_open - name_i,
+        )
+        self._fn_end_index[id(fn)] = end + 1
+        return fn
+
+    # -- queries ------------------------------------------------------------
+
+    def function_named(self, name: str,
+                       class_name: Optional[str] = None
+                       ) -> Optional[CxxFunction]:
+        for fn in self.functions:
+            if fn.name == name and (
+                class_name is None or fn.class_name == class_name
+            ):
+                return fn
+        return None
+
+    def address_taken_names(self) -> Set[str]:
+        """Function names referenced somewhere WITHOUT a following '('
+        — address taken (PyMethodDef tables, slot assignments). Those
+        are CPython entry points: called with the GIL held."""
+        defined = {f.name for f in self.functions}
+        spans = []
+        for f in self.functions:
+            spans.append((f.start_line, f.end_line))
+        out: Set[str] = set()
+        toks = self.tokens
+        for i, tok in enumerate(toks):
+            if tok.kind != "id" or tok.text not in defined:
+                continue
+            nxt = toks[i + 1] if i + 1 < len(toks) else None
+            if nxt is not None and nxt.text == "(":
+                continue
+            # Skip the definition site itself (name followed by '(' is
+            # already excluded; qualified definition `Class :: name` is
+            # followed by '(' too).
+            out.add(tok.text)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Lexical lock scopes
+
+@dataclasses.dataclass
+class LockScope:
+    lock: str  # member/variable name of the mutex
+    start_index: int  # token index (within fn.tokens) where held begins
+    end_index: int  # exclusive
+
+
+_GUARD_TYPES = {"lock_guard", "unique_lock", "scoped_lock"}
+
+
+def lock_scopes(fn: CxxFunction) -> List[LockScope]:
+    """Lexical spans of fn.tokens where a named mutex is held via an
+    RAII guard. Handles `std::lock_guard<std::mutex> l(mu_);` (held to
+    the end of the enclosing brace block) and `l.unlock()` (releases a
+    unique_lock early). A `cv.wait(l)` keeps the lock held (it is
+    reacquired before returning)."""
+    toks = fn.tokens
+    n = len(toks)
+    scopes: List[LockScope] = []
+    open_guards: List[Tuple[str, str, int, int]] = []  # (var, lock, start, depth)
+    depth = 0
+    i = 0
+    while i < n:
+        t = toks[i]
+        if t.text == "{":
+            depth += 1
+        elif t.text == "}":
+            # close guards opened at this depth
+            for var, lock, start, d in list(open_guards):
+                if d >= depth:
+                    scopes.append(LockScope(lock, start, i))
+                    open_guards.remove((var, lock, start, d))
+            depth -= 1
+        elif t.kind == "id" and t.text in _GUARD_TYPES:
+            # ... lock_guard < ... > VAR ( LOCKEXPR ) ;
+            j = i + 1
+            angle = 0
+            while j < n:
+                if toks[j].text == "<":
+                    angle += 1
+                elif toks[j].text == ">":
+                    angle -= 1
+                elif angle == 0 and toks[j].kind == "id":
+                    break
+                j += 1
+            if j < n and j + 1 < n and toks[j + 1].text == "(":
+                var = toks[j].text
+                k = j + 2
+                d2 = 1
+                lock_name = ""
+                while k < n and d2 > 0:
+                    if toks[k].text == "(":
+                        d2 += 1
+                    elif toks[k].text == ")":
+                        d2 -= 1
+                    elif toks[k].kind == "id":
+                        lock_name = toks[k].text
+                    k += 1
+                if lock_name:
+                    open_guards.append((var, lock_name, k, depth))
+                i = k
+                continue
+        elif t.kind == "id":
+            # var.unlock() ends the hold early.
+            if (
+                i + 3 < n
+                and toks[i + 1].text == "."
+                and toks[i + 2].text == "unlock"
+                and toks[i + 3].text == "("
+            ):
+                for g in list(open_guards):
+                    if g[0] == t.text:
+                        scopes.append(LockScope(g[1], g[2], i))
+                        open_guards.remove(g)
+        i += 1
+    for var, lock, start, d in open_guards:
+        scopes.append(LockScope(lock, start, n))
+    return scopes
+
+
+def held_locks_at(scopes: Sequence[LockScope], index: int) -> Set[str]:
+    return {s.lock for s in scopes if s.start_index <= index < s.end_index}
+
+
+# ---------------------------------------------------------------------------
+# Member accesses
+
+@dataclasses.dataclass
+class CxxAccess:
+    owner: str  # class name
+    attr: str
+    kind: str  # 'read' | 'write'
+    func: str  # qualified function name
+    path: str
+    line: int
+    held: frozenset
+    in_init: bool
+    rmw: bool = False
+
+
+_MUTATORS = {
+    "push_back", "emplace_back", "emplace", "pop_front", "pop_back",
+    "clear", "erase", "insert", "swap", "push", "pop", "resize",
+}
+
+_WRITE_NEXT = {"=", "+=", "-=", "*=", "/=", "|=", "&=", "^=", "++", "--"}
+
+
+def member_accesses(ctx: CxxFileContext, cls: CxxClass,
+                    fn: CxxFunction) -> List[CxxAccess]:
+    """Occurrences of `cls` members inside `fn`, with lock context.
+
+    Constructors, the destructor, and move/copy assignment are marked
+    in_init (no concurrent observers during construction / ownership
+    transfer — same exemption as the Python rules' __init__)."""
+    in_init = (
+        fn.name == cls.name
+        or fn.name == f"~{cls.name}"
+        or fn.name.startswith("operator=")
+        or fn.name == "operator="
+    )
+    scopes = lock_scopes(fn)
+    holds = ctx.holds_annotation_for_line(fn.start_line)
+    extra_held: Set[str] = set()
+    if holds:
+        extra_held.add(holds.split(".")[-1])
+    # `// Caller holds mu_.` style doc comments are NOT annotations; only
+    # the formal grammar counts.
+    out: List[CxxAccess] = []
+    toks = fn.tokens
+    n = len(toks)
+    for i, t in enumerate(toks):
+        if t.kind != "id" or t.text not in cls.members:
+            continue
+        member = cls.members[t.text]
+        if member.is_mutex:
+            continue  # touching the lock IS acquiring it
+        prev = toks[i - 1] if i > 0 else None
+        nxt = toks[i + 1] if i + 1 < n else None
+        # `other.base_` in move ops: still this class's member; keep.
+        kind = "read"
+        rmw = False
+        if nxt is not None and nxt.kind == "punct":
+            if nxt.text in _WRITE_NEXT and nxt.text != "==":
+                kind = "write"
+                rmw = nxt.text in ("+=", "-=", "*=", "/=", "|=", "&=",
+                                   "^=", "++", "--")
+            elif nxt.text == "." and i + 2 < n and (
+                toks[i + 2].text in _MUTATORS
+            ):
+                kind = "write"
+                rmw = True
+        if prev is not None and prev.text in ("++", "--"):
+            kind = "write"
+            rmw = True
+        held = frozenset(
+            f"{cls.name}.{lk}" for lk in (held_locks_at(scopes, i) | extra_held)
+        )
+        out.append(
+            CxxAccess(
+                owner=f"cxx::{cls.name}",
+                attr=t.text,
+                kind=kind,
+                func=f"cxx::{fn.qual}",
+                path=ctx.path,
+                line=t.line,
+                held=held,
+                in_init=in_init,
+                rmw=rmw,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Thread spawns + call graph
+
+@dataclasses.dataclass
+class SpawnSite:
+    line: int
+    callees: Set[str]
+    multi: bool  # spawn site lexically inside a loop
+    func: str  # spawning function qual
+
+
+def thread_spawns(ctx: CxxFileContext) -> List[SpawnSite]:
+    """`std::thread(...)` constructions and `*.emplace_back([..]{...})`
+    on a vector<std::thread> (recognized lexically: emplace_back whose
+    argument starts with a lambda). Callees = identifiers called inside
+    the thread body/lambda."""
+    out: List[SpawnSite] = []
+    for fn in ctx.functions:
+        toks = fn.tokens
+        n = len(toks)
+        loop_depths: List[int] = []
+        depth = 0
+        i = 0
+        while i < n:
+            t = toks[i]
+            if t.text == "{":
+                depth += 1
+            elif t.text == "}":
+                depth -= 1
+                loop_depths = [d for d in loop_depths if d <= depth]
+            elif t.kind == "id" and t.text in ("for", "while"):
+                loop_depths.append(depth + 1)
+            lam_start = None
+            if (
+                t.kind == "id"
+                and t.text == "thread"
+                and i + 1 < n
+                and toks[i + 1].text in ("(", "{")
+            ):
+                lam_start = i + 1
+            elif (
+                t.kind == "id"
+                and t.text == "emplace_back"
+                and i + 1 < n
+                and toks[i + 1].text == "("
+                and i + 2 < n
+                and toks[i + 2].text == "["
+            ):
+                lam_start = i + 1
+            if lam_start is not None:
+                d = 0
+                j = lam_start
+                callees: Set[str] = set()
+                while j < n:
+                    if toks[j].text == "(":
+                        d += 1
+                    elif toks[j].text == ")":
+                        d -= 1
+                        if d == 0:
+                            break
+                    elif toks[j].kind == "id" and j + 1 < n and (
+                        toks[j + 1].text == "("
+                    ) and toks[j].text not in _KEYWORDS:
+                        if j != lam_start:
+                            callees.add(toks[j].text)
+                    j += 1
+                out.append(
+                    SpawnSite(
+                        line=t.line,
+                        callees=callees,
+                        multi=bool(loop_depths),
+                        func=f"cxx::{fn.qual}",
+                    )
+                )
+                i = j
+                continue
+            i += 1
+    return out
+
+
+def call_edges(ctx: CxxFileContext) -> Dict[str, Set[str]]:
+    """Name-based call graph: fn qual -> set of callee NAMES (resolved
+    by the caller against known functions; method calls `x->f(` and
+    `x.f(` contribute `f`)."""
+    edges: Dict[str, Set[str]] = {}
+    for fn in ctx.functions:
+        callees: Set[str] = set()
+        toks = fn.tokens
+        n = len(toks)
+        for i, t in enumerate(toks[fn.body_start:], start=fn.body_start):
+            if t.kind != "id" or t.text in _KEYWORDS:
+                continue
+            if i + 1 < n and toks[i + 1].text == "(":
+                callees.add(t.text)
+        edges[fn.qual] = callees
+    return edges
+
+
+def resolve_callees(ctxs: Sequence[CxxFileContext],
+                    names: Set[str]) -> Dict[str, List[CxxFunction]]:
+    """Callee name -> candidate function definitions across files."""
+    out: Dict[str, List[CxxFunction]] = {}
+    for ctx in ctxs:
+        for fn in ctx.functions:
+            out.setdefault(fn.name, []).append(fn)
+    return {name: out.get(name, []) for name in names}
+
+
+# ---------------------------------------------------------------------------
+# GIL events
+
+@dataclasses.dataclass
+class GilEvent:
+    index: int  # token index within fn.tokens
+    line: int
+    kind: str  # ensure | release | begin_allow | end_allow | nogil_start |
+    #            nogil_end | guard (RAII) | api_call | blocking_call
+    name: str = ""
+
+
+_GIL_EXEMPT = {
+    "PyGILState_Ensure", "PyGILState_Release", "PyGILState_STATE",
+    "Py_BEGIN_ALLOW_THREADS", "Py_END_ALLOW_THREADS", "PyObject_HEAD",
+    "PyVarObject_HEAD_INIT", "PyModuleDef_HEAD_INIT", "Py_ssize_t",
+}
+
+# Direct blocking primitives: condition/future waits, socket syscalls,
+# sleeps. Matched as called names; the interprocedural summary lifts
+# them through helpers (a GIL-held call to BatchingQueue::enqueue is a
+# finding because enqueue can wait on can_enqueue_).
+BLOCKING_PRIMITIVES = {
+    "wait", "wait_for", "wait_until", "sleep_for", "sleep_until",
+    "recv", "recvmsg", "accept", "poll", "select", "connect",
+    "recv_exact", "recv_sized", "sendall", "sendmsg",
+}
+
+# Method names shared with the standard containers/strings. The
+# may-block summary is NAME-based (no type resolution), so these never
+# participate in it: `list.reserve(n)` must not inherit may-block-ness
+# from ShmRing::reserve. The cost is a missed finding if binding code
+# ever calls such a same-named repo function directly while holding the
+# GIL — silence over a guess, the frontend's standing contract.
+STL_METHOD_NAMES = {
+    "reserve", "resize", "insert", "erase", "clear", "swap", "count",
+    "find", "at", "map", "get", "front", "back", "begin", "end",
+    "emplace", "emplace_back", "push_back", "pop_back", "push_front",
+    "pop_front", "data", "size", "empty", "str", "c_str", "reset",
+    "release", "substr", "append",
+}
+
+
+def gil_events(fn: CxxFunction) -> List[GilEvent]:
+    """Lexical GIL-relevant events in order: acquire/release ops, the
+    call_nogil(...) released region, CPython API calls (`Py*`/`_Py*`/
+    `PyArray_*` identifiers followed by '('), and potentially-blocking
+    calls. The scan is lexical (no CFG): adequate for the straight-line
+    acquire..release shapes this repo uses; anything cleverer needs an
+    inline suppression with the reasoning.
+
+    The signature (everything before the body's '{') is skipped: the
+    function's own name token would otherwise read as a recursive call
+    to itself, poisoning the may-block fixpoint."""
+    toks = fn.tokens
+    n = len(toks)
+    events: List[GilEvent] = []
+    nogil_until: List[int] = []  # stack of close indices for call_nogil spans
+    i = fn.body_start
+    while i < n:
+        t = toks[i]
+        if t.kind == "id":
+            nxt = toks[i + 1] if i + 1 < n else None
+            called = nxt is not None and nxt.text == "("
+            if t.text == "PyGILState_Ensure" and called:
+                events.append(GilEvent(i, t.line, "ensure"))
+            elif t.text == "PyGILState_Release" and called:
+                events.append(GilEvent(i, t.line, "release"))
+            elif t.text == "Py_BEGIN_ALLOW_THREADS":
+                events.append(GilEvent(i, t.line, "begin_allow"))
+            elif t.text == "Py_END_ALLOW_THREADS":
+                events.append(GilEvent(i, t.line, "end_allow"))
+            elif t.text == "GILGuard" and nxt is not None and (
+                nxt.kind == "id" or nxt.text in ("(", "{")
+            ):
+                events.append(GilEvent(i, t.line, "guard"))
+            elif t.text == "call_nogil" and called:
+                # The lambda argument runs between Py_BEGIN/END inside
+                # call_nogil: mark the span released.
+                d = 0
+                j = i + 1
+                while j < n:
+                    if toks[j].text == "(":
+                        d += 1
+                    elif toks[j].text == ")":
+                        d -= 1
+                        if d == 0:
+                            break
+                    j += 1
+                events.append(GilEvent(i, t.line, "nogil_start"))
+                events.append(GilEvent(j, toks[min(j, n - 1)].line,
+                                       "nogil_end"))
+            elif called and re.match(r"^(_?Py[A-Z]|Py_[A-Z]|PyArray)", t.text) \
+                    and t.text not in _GIL_EXEMPT:
+                # Py_RETURN_* are statement macros without parens; the
+                # paren requirement keeps casts/types out.
+                events.append(GilEvent(i, t.line, "api_call", t.text))
+            elif called and t.text in BLOCKING_PRIMITIVES:
+                events.append(GilEvent(i, t.line, "blocking_call", t.text))
+            elif called and t.text not in _KEYWORDS:
+                events.append(GilEvent(i, t.line, "call", t.text))
+        i += 1
+    events.sort(key=lambda e: e.index)
+    return events
+
+
+# ---------------------------------------------------------------------------
+# shm ring header accesses (ATOMIC-ORDER raw material)
+
+HEADER_WORDS = {
+    "kRingHeadWord": "head",
+    "kRingTailWord": "tail",
+    "kRingCapacityWord": "capacity",
+    "kRingWaitingWord": "waiting",
+}
+
+
+@dataclasses.dataclass
+class HeaderAccess:
+    word: str  # head | tail | capacity | waiting
+    op: str  # load | store | raw
+    order: str  # memory_order suffix ('' when missing/raw)
+    func: str  # enclosing function name
+    line: int
+
+
+def ring_header_accesses(ctx: CxxFileContext) -> List[HeaderAccess]:
+    """Every use of a kRing*Word constant, classified by accessor shape:
+    `word(kX)->load/store(..., std::memory_order_Y)` is the designated
+    pattern; anything else is op='raw' (a finding for ATOMIC-ORDER)."""
+    out: List[HeaderAccess] = []
+    for fn in ctx.functions:
+        toks = fn.tokens
+        n = len(toks)
+        for i, t in enumerate(toks):
+            if t.kind != "id" or t.text not in HEADER_WORDS:
+                continue
+            word = HEADER_WORDS[t.text]
+            # Expect: word ( kX ) -> load|store ( ... memory_order_Y ... )
+            prev_ok = (
+                i >= 2
+                and toks[i - 1].text == "("
+                and toks[i - 2].kind == "id"
+                and toks[i - 2].text == "word"
+            )
+            op = "raw"
+            order = ""
+            if prev_ok and i + 2 < n and toks[i + 1].text == ")" and (
+                toks[i + 2].text == "->"
+            ) and i + 3 < n and toks[i + 3].text in ("load", "store"):
+                op = toks[i + 3].text
+                # scan the call parens for a memory_order token
+                j = i + 4
+                d = 0
+                while j < n:
+                    if toks[j].text == "(":
+                        d += 1
+                    elif toks[j].text == ")":
+                        d -= 1
+                        if d == 0:
+                            break
+                    elif toks[j].kind == "id" and toks[j].text.startswith(
+                        "memory_order"
+                    ):
+                        order = toks[j].text.replace("memory_order_", "")
+                    j += 1
+            out.append(HeaderAccess(word, op, order, fn.name, t.line))
+    return out
+
+
+def raw_u64_casts(ctx: CxxFileContext) -> List[Tuple[str, int]]:
+    """reinterpret_cast<...uint64_t*>(...) sites NOT casting to
+    std::atomic — a raw header-word deref candidate. Returns
+    (enclosing function, line)."""
+    out: List[Tuple[str, int]] = []
+    for fn in ctx.functions:
+        toks = fn.tokens
+        n = len(toks)
+        for i, t in enumerate(toks):
+            if t.kind != "id" or t.text != "reinterpret_cast":
+                continue
+            j = i + 1
+            angle = 0
+            saw_u64 = False
+            saw_atomic = False
+            while j < n:
+                if toks[j].text == "<":
+                    angle += 1
+                elif toks[j].text == ">":
+                    angle -= 1
+                    if angle == 0:
+                        break
+                elif toks[j].kind == "id":
+                    if toks[j].text == "uint64_t":
+                        saw_u64 = True
+                    elif toks[j].text == "atomic":
+                        saw_atomic = True
+                j += 1
+            if saw_u64 and not saw_atomic:
+                out.append((fn.name, t.line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Data-region + header access SEQUENCES (protocol conformance)
+
+def access_sequence(ctx: CxxFileContext, class_name: str, fn_name: str,
+                    _depth: int = 0) -> List[str]:
+    """Ordered header/data ops for one ShmRing method, with same-class
+    helper calls spliced in (depth 2): 'R:head', 'W:head', 'R:tail',
+    'W:tail', 'R:waiting', 'W:waiting', 'R:data', 'W:data'."""
+    fn = ctx.function_named(fn_name, class_name) or ctx.function_named(fn_name)
+    if fn is None:
+        return []
+    cls = ctx.classes.get(class_name)
+    method_names = set(cls.methods) if cls is not None else set()
+    toks = fn.tokens
+    n = len(toks)
+    seq: List[str] = []
+    for i, t in enumerate(toks):
+        if t.kind != "id":
+            continue
+        if t.text in HEADER_WORDS:
+            word = HEADER_WORDS[t.text]
+            op = "R"
+            if i + 3 < n and toks[i + 1].text == ")" and (
+                toks[i + 2].text == "->"
+            ) and toks[i + 3].text == "store":
+                op = "W"
+            seq.append(f"{op}:{word}")
+        elif t.text in ("memcpy", "load_u32le") and i + 1 < n and (
+            toks[i + 1].text == "("
+        ):
+            # memcpy(data() + ..., src, n) writes the data region;
+            # load_u32le(data() + pos) reads it. Only count calls whose
+            # argument window mentions data().
+            j = i + 1
+            d = 0
+            mentions_data = False
+            first_arg_data = False
+            arg_index = 0
+            while j < n:
+                if toks[j].text == "(":
+                    d += 1
+                elif toks[j].text == ")":
+                    d -= 1
+                    if d == 0:
+                        break
+                elif d == 1 and toks[j].text == ",":
+                    arg_index += 1
+                elif toks[j].kind == "id" and toks[j].text == "data":
+                    mentions_data = True
+                    if arg_index == 0:
+                        first_arg_data = True
+                j += 1
+            if mentions_data:
+                seq.append(
+                    "W:data" if t.text == "memcpy" and first_arg_data
+                    else "R:data"
+                )
+        elif t.text in method_names and t.text != fn_name and _depth < 2 and (
+            i + 1 < n and toks[i + 1].text == "("
+        ):
+            seq.extend(access_sequence(ctx, class_name, t.text,
+                                       _depth + 1))
+    return seq
+
+
+def collapse(seq: Sequence[str]) -> List[str]:
+    """Adjacent-duplicate collapse ('W:data W:data' -> 'W:data')."""
+    out: List[str] = []
+    for op in seq:
+        if not out or out[-1] != op:
+            out.append(op)
+    return out

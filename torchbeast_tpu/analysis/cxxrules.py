@@ -1,0 +1,932 @@
+"""beastlint C++ rules (ISSUE 10): the concurrency contracts PR 9's
+native core lives by, checked statically across the language boundary.
+
+All three are REPO rules (they need the full context set: the C++
+frontend contexts from csrc/, and for ATOMIC-ORDER the Python
+transport.py AST as well):
+
+    GIL-DISCIPLINE       every CPython API call in csrc/pymodule.cc /
+                         actor_pool.h is dominated by a GIL acquire
+                         (PyGILState_Ensure, RAII GILGuard, or entry
+                         from a Python-registered callable), acquire/
+                         release pairing is balanced, and NO potentially
+                         blocking call (condition waits, socket recvs,
+                         queue dequeues — direct or via the per-function
+                         may-block summary) happens while the GIL is
+                         held outside a call_nogil region.
+    ATOMIC-ORDER         every load/store of the shm ring header words
+                         goes through the designated accessors with an
+                         explicit (and, at the publish/Dekker sites, the
+                         exact documented) memory order; raw u64 casts
+                         of the header are findings; the Python side's
+                         memoryview header accesses must name their
+                         offsets (`self._u64[self._HEAD]`, never a bare
+                         index); and BOTH implementations' access
+                         sequences must conform to the protocol spec
+                         (analysis/protocol.py SPEC_ACCESS) — WIRE-
+                         PARITY extended from layout to access
+                         discipline.
+    CXX-LOCK-DISCIPLINE  `// guarded-by: mu_` members only touched
+                         under an RAII guard on that mutex (ctor/dtor/
+                         move exempt; `// beastlint: holds mu_` for
+                         helpers called locked) — the C++ twin of the
+                         Python LOCK-DISCIPLINE rule — plus cross-root
+                         conflict detection: std::thread spawn sites
+                         join the thread-root graph (each Python-facing
+                         entry method is its own root, mirroring PR 7's
+                         driver roots), and an unguarded non-atomic
+                         member written from one root and touched from
+                         another is a finding.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import config, cxx, protocol
+from .engine import Finding
+
+
+def _cxx_contexts(contexts) -> List["cxx.CxxFileContext"]:
+    return [
+        ctx for ctx in contexts
+        if getattr(ctx, "is_cxx", False) and any(
+            ctx.path.startswith(prefix + "/") or ctx.path == prefix
+            for prefix in config.CXX_PATHS
+        )
+    ]
+
+
+# ---------------------------------------------------------------------------
+# GIL-DISCIPLINE
+
+
+class GilDisciplineRule:
+    """GIL-DISCIPLINE: CPython API calls only with the GIL; no blocking
+    calls while holding it.
+
+    The binding layer's two invariants (csrc/pymodule.cc):
+
+    - a `Py*` call without the GIL corrupts the interpreter. Entry
+      points registered with Python (PyMethodDef tables, type slots —
+      recognized by their address being taken) START with the GIL held;
+      everything else must acquire it (PyGILState_Ensure / RAII
+      GILGuard) before the first API call, in-function or via the call
+      summary (a helper only ever called from GIL-held sites inherits
+      held-ness).
+    - a blocking call (condition wait, socket recv, queue dequeue —
+      direct, or via the per-function may-block summary over the csrc
+      call graph) while the GIL is held starves every Python thread;
+      the `call_nogil([&]{...})` idiom releases it for exactly the
+      lambda's span, and Py_BEGIN/END_ALLOW_THREADS pairs must balance.
+
+    The scan is lexical per function (no CFG): right for the
+    straight-line acquire..release shapes this repo uses. A cleverer
+    control flow needs an inline `// beastlint: disable=GIL-DISCIPLINE
+    <why>` with the path reasoning.
+    """
+
+    name = "GIL-DISCIPLINE"
+
+    def check_repo(self, root: str, contexts) -> List[Finding]:
+        ctxs = [
+            ctx for ctx in _cxx_contexts(contexts)
+            if ctx.path in config.GIL_FILES
+        ]
+        if not ctxs:
+            return []
+        all_cxx = _cxx_contexts(contexts)
+        may_block = self._may_block_summary(all_cxx)
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            entry = ctx.address_taken_names()
+            entry |= {
+                f.name for f in ctx.functions
+                if f.name.startswith("PyInit")
+            }
+            held_entry = self._entry_states(ctx, entry)
+            for fn in ctx.functions:
+                findings.extend(
+                    self._check_function(
+                        ctx, fn, held_entry.get(fn.qual, False),
+                        may_block,
+                    )
+                )
+        return findings
+
+    # -- interprocedural summaries -------------------------------------
+
+    @staticmethod
+    def _may_block_summary(ctxs) -> Set[str]:
+        """Function NAMES that may block WITHOUT releasing the GIL
+        first: contain a blocking primitive, or call a may-block
+        function, OUTSIDE any call_nogil/Py_BEGIN_ALLOW_THREADS span
+        (name-resolved fixpoint).
+
+        The nogil exclusion is the point: BatchingQueue::enqueue can
+        wait, so `queue->enqueue(...)` bare under the GIL is a finding —
+        but pymodule's queue_enqueue wraps it in call_nogil, so CALLING
+        queue_enqueue with the GIL held is fine and must not flag.
+        STL-collision-prone names (cxx.STL_METHOD_NAMES) never enter
+        the propagation: `list.reserve(n)` is not ShmRing::reserve."""
+        primitives = set(cxx.BLOCKING_PRIMITIVES) | {"join"}
+        edges: Dict[str, Set[str]] = {}
+        blocking: Set[str] = set()
+        for ctx in ctxs:
+            for fn in ctx.functions:
+                callees: Set[str] = set()
+                nogil_depth = 0
+                for ev in cxx.gil_events(fn):
+                    if ev.kind in ("nogil_start", "begin_allow"):
+                        nogil_depth += 1
+                        continue
+                    if ev.kind in ("nogil_end", "end_allow"):
+                        nogil_depth = max(0, nogil_depth - 1)
+                        continue
+                    if nogil_depth:
+                        continue  # released span: blocking here is fine
+                    if ev.kind == "blocking_call":
+                        blocking.add(fn.name)
+                    elif ev.kind == "call" and ev.name in primitives:
+                        blocking.add(fn.name)
+                    elif ev.kind == "call" and (
+                        ev.name not in cxx.STL_METHOD_NAMES
+                    ):
+                        callees.add(ev.name)
+                edges.setdefault(fn.name, set()).update(callees)
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in edges.items():
+                if name not in blocking and callees & blocking:
+                    blocking.add(name)
+                    changed = True
+        return blocking
+
+    def _entry_states(self, ctx, entry: Set[str]) -> Dict[str, bool]:
+        """fn qual -> GIL held at entry. Python-registered callables
+        start held; others inherit from their call sites (any caller
+        that calls them at a held point makes them held — conservative
+        in the direction that CHECKS the API calls inside). Functions
+        never called in-file default to the file's nature: held in the
+        binding layer (a helper for entry code), unheld elsewhere."""
+        default_held = ctx.path.endswith(".cc")
+        states: Dict[str, bool] = {}
+        for fn in ctx.functions:
+            states[fn.qual] = fn.name in entry
+        by_name: Dict[str, List] = {}
+        for fn in ctx.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        called: Set[str] = set()
+        for _ in range(3):
+            changed = False
+            for fn in ctx.functions:
+                held = states[fn.qual] or fn.name in entry
+                for ev, held_at in self._walk_held(fn, held):
+                    # STL-collision names never resolve name-based
+                    # (same contract as the may-block summary).
+                    if ev.kind == "call" and ev.name in by_name and (
+                        ev.name not in cxx.STL_METHOD_NAMES
+                    ):
+                        for callee in by_name[ev.name]:
+                            called.add(callee.qual)
+                            if held_at and not states[callee.qual]:
+                                states[callee.qual] = True
+                                changed = True
+            if not changed:
+                break
+        for fn in ctx.functions:
+            if fn.qual not in called and fn.name not in entry:
+                states[fn.qual] = default_held
+        for name in entry:
+            for fn in by_name.get(name, []):
+                states[fn.qual] = True
+        return states
+
+    @staticmethod
+    def _walk_held(fn, entry_held: bool):
+        """Yield (event, gil_held_at_event) lexically."""
+        held = entry_held
+        nogil_depth = 0
+        nogil_ends: List[int] = []
+        saved: List[bool] = []
+        for ev in cxx.gil_events(fn):
+            while nogil_ends and ev.index >= nogil_ends[-1]:
+                nogil_ends.pop()
+                held = saved.pop()
+            if ev.kind == "ensure" or ev.kind == "guard":
+                yield ev, held
+                held = True
+            elif ev.kind == "release":
+                yield ev, held
+                held = False
+            elif ev.kind == "begin_allow":
+                yield ev, held
+                saved.append(held)
+                nogil_ends.append(1 << 60)  # until end_allow
+                held = False
+            elif ev.kind == "end_allow":
+                if nogil_ends:
+                    nogil_ends.pop()
+                    held = saved.pop()
+                yield ev, held
+            elif ev.kind == "nogil_start":
+                yield ev, held
+                saved.append(held)
+                held = False
+            elif ev.kind == "nogil_end":
+                if saved:
+                    held = saved.pop()
+                yield ev, held
+            else:
+                yield ev, held
+
+    def _check_function(self, ctx, fn, entry_held: bool,
+                        may_block: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        ensures = releases = begins = ends = 0
+        is_raii = fn.class_name is not None and (
+            fn.name == fn.class_name or fn.name == f"~{fn.class_name}"
+        )
+        for ev, held in self._walk_held(fn, entry_held):
+            if ev.kind == "ensure":
+                ensures += 1
+            elif ev.kind == "release":
+                releases += 1
+            elif ev.kind == "begin_allow":
+                begins += 1
+            elif ev.kind == "end_allow":
+                ends += 1
+            elif ev.kind == "api_call" and not held:
+                findings.append(Finding(
+                    self.name, ctx.path, ev.line,
+                    f"CPython API call `{ev.name}` on a path without "
+                    "the GIL (acquire via PyGILState_Ensure/GILGuard, "
+                    "or keep the call out of the released region)",
+                ))
+            elif held and (
+                ev.kind == "blocking_call"
+                or (ev.kind == "call" and ev.name in may_block
+                    and ev.name not in cxx.STL_METHOD_NAMES)
+            ):
+                via = (
+                    "" if ev.kind == "blocking_call"
+                    else f" (it can wait: see `{ev.name}`'s body)"
+                )
+                findings.append(Finding(
+                    self.name, ctx.path, ev.line,
+                    f"potentially blocking call `{ev.name}` while the "
+                    f"GIL is held{via} — wrap it in "
+                    "call_nogil/Py_BEGIN_ALLOW_THREADS",
+                ))
+        if ensures and not releases and not is_raii:
+            findings.append(Finding(
+                self.name, ctx.path, fn.start_line,
+                f"{fn.name}: PyGILState_Ensure with no matching "
+                "PyGILState_Release on any path (RAII ctor/dtor pairs "
+                "are exempt)",
+            ))
+        if releases and not ensures and not is_raii and not entry_held:
+            findings.append(Finding(
+                self.name, ctx.path, fn.start_line,
+                f"{fn.name}: PyGILState_Release with no matching "
+                "PyGILState_Ensure",
+            ))
+        if begins != ends:
+            findings.append(Finding(
+                self.name, ctx.path, fn.start_line,
+                f"{fn.name}: Py_BEGIN_ALLOW_THREADS/"
+                f"Py_END_ALLOW_THREADS unbalanced ({begins} vs {ends})",
+            ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# ATOMIC-ORDER (incl. cross-language access-discipline conformance)
+
+
+_PY_WORD_NAMES = {
+    "_HEAD": "head", "_TAIL": "tail", "_CAP": "capacity",
+    "_WAITING": "waiting",
+}
+
+
+def _py_access_sequence(cls_node: ast.ClassDef, fn_name: str,
+                        _depth: int = 0) -> List[str]:
+    """Ordered header/data ops for one transport.py ShmRing method,
+    same vocabulary as cxx.access_sequence, with self._method calls
+    spliced (depth 2) and locals aliased from self._data tracked."""
+    fn = next(
+        (n for n in cls_node.body
+         if isinstance(n, ast.FunctionDef) and n.name == fn_name),
+        None,
+    )
+    if fn is None:
+        return []
+    seq: List[str] = []
+    data_aliases: Set[str] = set()
+
+    def is_u64(node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and node.attr == "_u64"
+
+    def is_data(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr == "_data":
+            return True
+        return isinstance(node, ast.Name) and node.id in data_aliases
+
+    def word_of(index: ast.AST) -> str:
+        if isinstance(index, ast.Attribute) and (
+            index.attr in _PY_WORD_NAMES
+        ):
+            return _PY_WORD_NAMES[index.attr]
+        return "?"
+
+    def emit_expr(node: ast.AST, store: bool = False) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Subscript):
+            emit_expr(node.value)
+            if not is_u64(node.value):
+                emit_expr(node.slice)
+            if is_u64(node.value):
+                seq.append(("W:" if store else "R:") + word_of(node.slice))
+                return
+            if is_data(node.value):
+                seq.append("W:data" if store else "R:data")
+                return
+            return
+        if isinstance(node, ast.Call):
+            chain = _attr_text(node.func)
+            if chain in ("struct.pack_into", "struct.unpack_from") and (
+                len(node.args) >= 2
+            ):
+                for arg in node.args:
+                    emit_expr(arg)
+                if is_data(node.args[1]) or (
+                    isinstance(node.args[1], ast.Name)
+                    and node.args[1].id in data_aliases
+                ):
+                    seq.append(
+                        "W:data" if chain == "struct.pack_into"
+                        else "R:data"
+                    )
+                return
+            # self._method(...) splice
+            if (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and _depth < 2
+            ):
+                for arg in node.args:
+                    emit_expr(arg)
+                seq.extend(
+                    _py_access_sequence(cls_node, node.func.attr,
+                                        _depth + 1)
+                )
+                return
+            for child in ast.iter_child_nodes(node):
+                emit_expr(child)
+            return
+        for child in ast.iter_child_nodes(node):
+            emit_expr(child)
+
+    def emit_stmt(stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            emit_expr(stmt.value)
+            for target in stmt.targets:
+                if isinstance(target, ast.Name) and isinstance(
+                    stmt.value, ast.Attribute
+                ) and stmt.value.attr == "_data":
+                    data_aliases.add(target.id)
+                    continue
+                if isinstance(target, (ast.Subscript,)):
+                    emit_expr(target, store=True)
+        elif isinstance(stmt, ast.AugAssign):
+            emit_expr(stmt.value)
+            if isinstance(stmt.target, ast.Subscript):
+                emit_expr(stmt.target)  # read half
+                emit_expr(stmt.target, store=True)
+        elif isinstance(stmt, (ast.If,)):
+            emit_expr(stmt.test)
+            for s in stmt.body:
+                emit_stmt(s)
+            for s in stmt.orelse:
+                emit_stmt(s)
+        elif isinstance(stmt, (ast.While,)):
+            emit_expr(stmt.test)
+            for s in stmt.body:
+                emit_stmt(s)
+            for s in stmt.orelse:
+                emit_stmt(s)
+        elif isinstance(stmt, (ast.For,)):
+            emit_expr(stmt.iter)
+            for s in stmt.body:
+                emit_stmt(s)
+        elif isinstance(stmt, ast.Return):
+            emit_expr(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            emit_expr(stmt.value)
+        elif isinstance(stmt, (ast.Try,)):
+            for s in stmt.body:
+                emit_stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    emit_stmt(s)
+            for s in stmt.orelse:
+                emit_stmt(s)
+            for s in stmt.finalbody:
+                emit_stmt(s)
+        elif isinstance(stmt, ast.Raise):
+            emit_expr(stmt.exc)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    emit_stmt(child)
+                elif isinstance(child, ast.expr):
+                    emit_expr(child)
+
+    for stmt in fn.body:
+        emit_stmt(stmt)
+    return seq
+
+
+def _attr_text(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _check_sequence(fn_name: str, lang: str, seq: List[str],
+                    path: str, line: int) -> List[Finding]:
+    """One implementation's collapsed sequence against the spec."""
+    findings: List[Finding] = []
+    spec = protocol.SPEC_ACCESS.get(fn_name)
+    if spec is None:
+        return findings
+    collapsed = tuple(cxx.collapse(seq))
+    if not collapsed:
+        findings.append(Finding(
+            "ATOMIC-ORDER", path, line,
+            f"{fn_name} ({lang}): could not extract any header/data "
+            "accesses — the conformance pin against the protocol spec "
+            "is broken",
+        ))
+        return findings
+    if collapsed != spec:
+        findings.append(Finding(
+            "ATOMIC-ORDER", path, line,
+            f"{fn_name} ({lang}): header access sequence "
+            f"{list(collapsed)} does not conform to the protocol "
+            f"spec {list(spec)} (analysis/protocol.py SPEC_ACCESS — "
+            "reordering header accesses changes the publish contract "
+            "the model checker verified)",
+        ))
+    final = protocol.SPEC_FINAL_OP.get(fn_name)
+    if final is not None and collapsed and collapsed[-1] != final:
+        findings.append(Finding(
+            "ATOMIC-ORDER", path, line,
+            f"{fn_name} ({lang}): the final header op must be {final} "
+            f"(publish/release last), got {collapsed[-1]}",
+        ))
+    return findings
+
+
+class AtomicOrderRule:
+    """ATOMIC-ORDER: shm ring header access discipline, both languages.
+
+    C++ (csrc/shm.h): every kRing*Word use must be
+    `word(kX)->load/store(.., std::memory_order_Y)` — the designated
+    accessor with an EXPLICIT order; the publish/Dekker sites must use
+    exactly the documented order (config.ATOMIC_ORDER_REQUIRED: head
+    publish = release, waiting store = seq_cst, consumer head load =
+    acquire...). A reinterpret_cast to a non-atomic u64 pointer is a
+    raw header deref and flags.
+
+    Python (runtime/transport.py): header words go through the cast
+    memoryview with NAMED indices (`self._u64[self._HEAD]`); a bare
+    numeric index is an access-discipline finding even though it
+    reads/writes the same bytes — the named offset is what WIRE-PARITY
+    cross-checks against the C++ word constants.
+
+    Cross-language: both implementations' per-method access sequences
+    must conform to analysis/protocol.py SPEC_ACCESS (the spec the
+    model checker exhaustively verified), the header-word coverage sets
+    must agree, and the bounded recheck must be protocol.RECHECK_MS in
+    both (transport.py _WAKE_RECHECK_S, shm.h kWakeRecheckMs).
+    """
+
+    name = "ATOMIC-ORDER"
+
+    def check_repo(self, root: str, contexts) -> List[Finding]:
+        by_path = {ctx.path: ctx for ctx in contexts}
+        shm_ctx = by_path.get(config.SHM_H)
+        transport_ctx = by_path.get(config.TRANSPORT_PY)
+        if shm_ctx is None and transport_ctx is None:
+            return []  # partial scan: the ring is not in scope
+        findings: List[Finding] = []
+
+        cpp_words: Set[str] = set()
+        if shm_ctx is not None and getattr(shm_ctx, "is_cxx", False):
+            findings.extend(self._check_cpp(shm_ctx, cpp_words))
+        elif shm_ctx is None and transport_ctx is not None:
+            findings.append(Finding(
+                self.name, config.TRANSPORT_PY, 1,
+                "csrc/shm.h missing from the scan — the C++ side of "
+                "the ring access discipline is unchecked",
+            ))
+
+        py_words: Set[str] = set()
+        if transport_ctx is not None and not getattr(
+            transport_ctx, "is_cxx", False
+        ):
+            findings.extend(self._check_py(transport_ctx, py_words))
+
+        # Cross-language coverage + conformance + recheck pin need both.
+        if shm_ctx is None or transport_ctx is None:
+            return findings
+        if cpp_words and py_words and cpp_words != py_words:
+            findings.append(Finding(
+                self.name, config.TRANSPORT_PY, 1,
+                "header-word coverage differs across languages: "
+                f"Python touches {sorted(py_words)}, C++ touches "
+                f"{sorted(cpp_words)} — both sides must drive the "
+                "same protocol words",
+            ))
+        findings.extend(self._check_conformance(transport_ctx, shm_ctx))
+        findings.extend(self._check_recheck(transport_ctx, shm_ctx))
+        return findings
+
+    # -- C++ side -------------------------------------------------------
+
+    def _check_cpp(self, ctx, words_out: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for acc in cxx.ring_header_accesses(ctx):
+            words_out.add(acc.word)
+            if acc.op == "raw":
+                findings.append(Finding(
+                    self.name, ctx.path, acc.line,
+                    f"ring header word `{acc.word}` used outside the "
+                    "designated `word(k...)->load/store(memory_order)` "
+                    "accessor pattern (raw header access in "
+                    f"{acc.func})",
+                ))
+                continue
+            if not acc.order:
+                findings.append(Finding(
+                    self.name, ctx.path, acc.line,
+                    f"{acc.func}: {acc.op} of header word "
+                    f"`{acc.word}` without an explicit memory order "
+                    "(implicit seq_cst hides the documented publish "
+                    "contract)",
+                ))
+                continue
+            required = config.ATOMIC_ORDER_REQUIRED.get(
+                (acc.func, acc.word, acc.op)
+            )
+            if required is not None and acc.order != required:
+                findings.append(Finding(
+                    self.name, ctx.path, acc.line,
+                    f"{acc.func}: {acc.op} of `{acc.word}` uses "
+                    f"memory_order_{acc.order}, the protocol requires "
+                    f"memory_order_{required} here (weakening this is "
+                    "a lost wakeup, not a style choice)",
+                ))
+        for fn_name, line in cxx.raw_u64_casts(ctx):
+            if fn_name == "word":
+                continue  # the designated accessor's own atomic cast
+            findings.append(Finding(
+                self.name, ctx.path, line,
+                f"{fn_name}: reinterpret_cast to a non-atomic u64 "
+                "pointer — ring header words may only be touched "
+                "through the std::atomic accessor",
+            ))
+        return findings
+
+    # -- Python side ----------------------------------------------------
+
+    def _check_py(self, ctx, words_out: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        ring_cls = next(
+            (n for n in ast.walk(ctx.tree)
+             if isinstance(n, ast.ClassDef) and n.name == "ShmRing"),
+            None,
+        )
+        if ring_cls is None:
+            findings.append(Finding(
+                self.name, ctx.path, 1,
+                "ShmRing class not found in transport.py — the Python "
+                "side of the ring access discipline is unparseable",
+            ))
+            return findings
+        for node in ast.walk(ring_cls):
+            if not isinstance(node, ast.Subscript):
+                continue
+            base = node.value
+            if not (
+                isinstance(base, ast.Attribute) and base.attr == "_u64"
+            ):
+                continue
+            index = node.slice
+            if isinstance(index, ast.Attribute) and (
+                index.attr in _PY_WORD_NAMES
+            ):
+                words_out.add(_PY_WORD_NAMES[index.attr])
+                continue
+            findings.append(Finding(
+                self.name, ctx.path, node.lineno,
+                "header word accessed with a raw index — name the "
+                "offset (`self._u64[self._HEAD]`): the named constant "
+                "is what WIRE-PARITY cross-checks against csrc/shm.h",
+            ))
+        return findings
+
+    # -- cross-language -------------------------------------------------
+
+    def _check_conformance(self, transport_ctx, shm_ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        ring_cls = next(
+            (n for n in ast.walk(transport_ctx.tree)
+             if isinstance(n, ast.ClassDef) and n.name == "ShmRing"),
+            None,
+        )
+        for fn_name in protocol.SPEC_ACCESS:
+            if ring_cls is not None:
+                py_seq = _py_access_sequence(ring_cls, fn_name)
+                py_line = next(
+                    (n.lineno for n in ring_cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == fn_name),
+                    1,
+                )
+                findings.extend(_check_sequence(
+                    fn_name, "transport.py", py_seq,
+                    transport_ctx.path, py_line,
+                ))
+            cpp_seq = cxx.access_sequence(shm_ctx, "ShmRing", fn_name)
+            cpp_fn = shm_ctx.function_named(fn_name, "ShmRing")
+            findings.extend(_check_sequence(
+                fn_name, "csrc/shm.h", cpp_seq, shm_ctx.path,
+                cpp_fn.start_line if cpp_fn is not None else 1,
+            ))
+        return findings
+
+    def _check_recheck(self, transport_ctx, shm_ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        py_ms: Optional[float] = None
+        for node in ast.walk(transport_ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name) and (
+                    target.id == "_WAKE_RECHECK_S"
+                ) and isinstance(node.value, ast.Constant):
+                    py_ms = float(node.value.value) * 1000.0
+        m = re.search(
+            r"constexpr\s+int\s+kWakeRecheckMs\s*=\s*(\d+)",
+            shm_ctx.source,
+        )
+        cpp_ms = float(m.group(1)) if m else None
+        for label, value, path in (
+            ("_WAKE_RECHECK_S", py_ms, transport_ctx.path),
+            ("kWakeRecheckMs", cpp_ms, shm_ctx.path),
+        ):
+            if value is None:
+                findings.append(Finding(
+                    self.name, path, 1,
+                    f"could not parse {label} — the bounded-recheck "
+                    "pin against the protocol spec is broken",
+                ))
+            elif abs(value - protocol.RECHECK_MS) > 1e-9:
+                findings.append(Finding(
+                    self.name, path, 1,
+                    f"{label} is {value:g} ms, the verified protocol "
+                    f"spec says {protocol.RECHECK_MS} ms "
+                    "(analysis/protocol.py RECHECK_MS) — change both "
+                    "together or re-verify",
+                ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# CXX-LOCK-DISCIPLINE (guarded-by + cross-root conflicts)
+
+
+class CxxLockDisciplineRule:
+    """CXX-LOCK-DISCIPLINE: the Python LOCK-DISCIPLINE/RACE contracts,
+    applied to the C++ core via the frontend.
+
+    Guarded members: `type member_;  // guarded-by: mu_` may only be
+    touched inside a lexical scope holding an RAII guard
+    (`std::lock_guard`/`unique_lock`/`scoped_lock`) on `mu_`.
+    Constructors, the destructor, and move/copy assignment are exempt
+    (no concurrent observers); `// beastlint: holds mu_` above a method
+    documents callers hold the lock. An early `l.unlock()` ends the
+    held region (csrc/queues.h dequeue_item's shape).
+
+    Cross-root conflicts: thread roots are std::thread /
+    emplace_back(lambda) spawn sites (multi-instance when spawned in a
+    loop) PLUS one root per Python-facing entry method (a method of a
+    csrc class invoked from pymodule.cc runs on whatever Python thread
+    calls it — the cross-language half of PR 7's thread-root graph).
+    Within classes that own a mutex or a spawned method, a non-atomic
+    non-const member with no guarded-by annotation that is WRITTEN from
+    one root and touched from another (or written twice from a
+    multi-instance root) with no common lock is a finding. Benign
+    orderings (atomic-handoff publication, write-before-spawn) are
+    suppressed inline with the interleaving described, same as RACE.
+    """
+
+    name = "CXX-LOCK-DISCIPLINE"
+
+    def check_repo(self, root: str, contexts) -> List[Finding]:
+        ctxs = _cxx_contexts(contexts)
+        if not ctxs:
+            return []
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            findings.extend(self._check_guarded(ctx))
+        findings.extend(self._check_conflicts(ctxs))
+        return findings
+
+    # -- guarded-by assertions ------------------------------------------
+
+    def _check_guarded(self, ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in ctx.classes.values():
+            if not cls.guarded:
+                continue
+            for fn in cls.methods.values():
+                for acc in cxx.member_accesses(ctx, cls, fn):
+                    lock = cls.guarded.get(acc.attr)
+                    if lock is None or acc.in_init:
+                        continue
+                    if f"{cls.name}.{lock}" not in acc.held:
+                        findings.append(Finding(
+                            self.name, ctx.path, acc.line,
+                            f"`{acc.attr}` ({cls.name}) is guarded-by "
+                            f"`{lock}` but accessed in "
+                            f"{fn.name} without holding it",
+                        ))
+        return findings
+
+    # -- cross-root conflicts -------------------------------------------
+
+    def _check_conflicts(self, ctxs) -> List[Finding]:
+        # Name-based call graph over ALL csrc contexts.
+        edges: Dict[str, Set[str]] = {}
+        fn_by_name: Dict[str, List[Tuple[object, object]]] = {}
+        for ctx in ctxs:
+            for fn in ctx.functions:
+                fn_by_name.setdefault(fn.name, []).append((ctx, fn))
+            for qual, callees in cxx.call_edges(ctx).items():
+                edges.setdefault(qual, set()).update(callees)
+
+        def reachable(seed_names: Set[str]) -> Set[str]:
+            """Function QUALS reachable from callee names."""
+            out: Set[str] = set()
+            stack = list(seed_names)
+            while stack:
+                name = stack.pop()
+                for ctx, fn in fn_by_name.get(name, []):
+                    if fn.qual in out:
+                        continue
+                    out.add(fn.qual)
+                    stack.extend(edges.get(fn.qual, ()))
+            return out
+
+        # Roots: spawn sites + Python-facing entry methods.
+        roots: Dict[str, Tuple[Set[str], bool]] = {}  # id -> (quals, multi)
+        for ctx in ctxs:
+            for site in cxx.thread_spawns(ctx):
+                callees = {
+                    name for name in site.callees if name in fn_by_name
+                }
+                if not callees:
+                    continue
+                rid = f"cxx-thread:{site.func}:{site.line}"
+                roots[rid] = (reachable(callees), site.multi)
+            if ctx.path.endswith("pymodule.cc"):
+                # `obj->method(` / `obj.method(` sites in the binding
+                # layer: each bound method is its own Python-side root
+                # (different Python threads drive different entries).
+                for name in self._bound_methods(ctx, fn_by_name):
+                    roots[f"py-entry:{name}"] = (reachable({name}), False)
+
+        # Conflict scan per shared-owner class.
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            spawned_methods = {
+                callee for site in cxx.thread_spawns(ctx)
+                for callee in site.callees
+            }
+            for cls in ctx.classes.values():
+                in_scope = bool(cls.lock_attrs) or bool(
+                    spawned_methods & set(cls.methods)
+                )
+                if not in_scope:
+                    continue
+                accesses: List[cxx.CxxAccess] = []
+                for fn in cls.methods.values():
+                    accesses.extend(cxx.member_accesses(ctx, cls, fn))
+                findings.extend(
+                    self._conflicts_for_class(ctx, cls, accesses, roots)
+                )
+        return findings
+
+    @staticmethod
+    def _bound_methods(ctx, fn_by_name) -> Set[str]:
+        out: Set[str] = set()
+        for fn in ctx.functions:
+            toks = fn.tokens
+            n = len(toks)
+            for i, t in enumerate(toks):
+                if t.kind == "punct" and t.text in ("->", ".") and (
+                    i + 2 < n
+                    and toks[i + 1].kind == "id"
+                    and toks[i + 2].text == "("
+                    and toks[i + 1].text in fn_by_name
+                ):
+                    out.add(toks[i + 1].text)
+        return out
+
+    def _conflicts_for_class(self, ctx, cls, accesses, roots
+                             ) -> List[Finding]:
+        findings: List[Finding] = []
+        by_attr: Dict[str, List] = {}
+        for acc in accesses:
+            member = cls.members.get(acc.attr)
+            if member is None or member.is_atomic or member.is_const:
+                continue
+            if acc.attr in cls.guarded:
+                continue  # the guarded-by assertion covers these
+            if acc.in_init:
+                continue
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in sorted(by_attr.items()):
+            writes = [a for a in accs if a.kind == "write"]
+            if not writes:
+                continue  # immutable after construction
+            # Map accesses to roots.
+            per_root: Dict[str, List] = {}
+            multi_roots: Set[str] = set()
+            for acc in accs:
+                qual = acc.func.replace("cxx::", "")
+                for rid, (quals, multi) in roots.items():
+                    if qual in quals:
+                        per_root.setdefault(rid, []).append(acc)
+                        if multi:
+                            multi_roots.add(rid)
+            conflict: List = []
+            root_ids: Set[str] = set()
+            for ra, a_accs in per_root.items():
+                a_writes = [a for a in a_accs if a.kind == "write"]
+                for rb, b_accs in per_root.items():
+                    if rb == ra or not a_writes:
+                        continue
+                    conflict.extend(a_writes + b_accs)
+                    root_ids |= {ra, rb}
+                if ra in multi_roots and a_writes and (
+                    len(a_accs) > len(a_writes) or len(a_writes) > 1
+                    or any(a.rmw for a in a_writes)
+                ):
+                    conflict.extend(a_accs)
+                    root_ids.add(ra)
+            if not conflict:
+                continue
+            common = frozenset.intersection(
+                *[a.held for a in conflict]
+            )
+            if common:
+                continue
+            anchor = min(
+                (a for a in conflict if a.kind == "write"),
+                key=lambda a: (a.path, a.line),
+            )
+            other = next(
+                (a for a in sorted(conflict,
+                                   key=lambda x: (x.path, x.line))
+                 if (a.path, a.line) != (anchor.path, anchor.line)),
+                anchor,
+            )
+            roots_text = ", ".join(sorted(root_ids)[:3])
+            findings.append(Finding(
+                self.name, anchor.path, anchor.line,
+                f"`{attr}` ({cls.name}) is written from roots "
+                f"{roots_text} with no common lock and no guarded-by "
+                f"annotation (counterpart at {other.path}:"
+                f"{other.line}) — guard it, make it atomic, or "
+                "suppress with the safe interleaving described",
+            ))
+        return findings
+
+
+CXX_RULES = [
+    GilDisciplineRule(),
+    AtomicOrderRule(),
+    CxxLockDisciplineRule(),
+]

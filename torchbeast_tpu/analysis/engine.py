@@ -104,6 +104,10 @@ class Suppression:
 class FileContext:
     """One parsed source file plus its beastlint annotations."""
 
+    # C++ sources load as analysis.cxx.CxxFileContext (is_cxx=True);
+    # file rules only see Python contexts, repo rules see both.
+    is_cxx = False
+
     def __init__(self, path: str, source: str, abspath: str = ""):
         self.path = path.replace(os.sep, "/")
         self.abspath = abspath or path
@@ -214,12 +218,19 @@ class FileContext:
         return None
 
 
+# C++ sources the frontend (analysis/cxx.py) lexes; the C++ rules
+# (GIL-DISCIPLINE, ATOMIC-ORDER, CXX-LOCK-DISCIPLINE) run over these.
+CXX_SUFFIXES = (".h", ".hpp", ".cc", ".cpp")
+
+
 def discover_files(paths: Sequence[str], root: str) -> List[str]:
-    """Expand files/directories into a sorted list of .py files."""
+    """Expand files/directories into a sorted list of .py and C++
+    (.h/.cc) sources."""
+    suffixes = (".py",) + CXX_SUFFIXES
     out: Set[str] = set()
     for p in paths:
         ap = p if os.path.isabs(p) else os.path.join(root, p)
-        if os.path.isfile(ap) and ap.endswith(".py"):
+        if os.path.isfile(ap) and ap.endswith(suffixes):
             out.add(os.path.abspath(ap))
         elif os.path.isdir(ap):
             for dirpath, dirnames, filenames in os.walk(ap):
@@ -228,7 +239,7 @@ def discover_files(paths: Sequence[str], root: str) -> List[str]:
                     if d not in SKIP_DIRS and not d.endswith(".egg-info")
                 ]
                 for fn in filenames:
-                    if fn.endswith(".py"):
+                    if fn.endswith(suffixes):
                         out.add(os.path.abspath(os.path.join(dirpath, fn)))
     return sorted(out)
 
@@ -238,6 +249,10 @@ def load_context(abspath: str, root: str) -> Optional[FileContext]:
     try:
         with open(abspath, "r", encoding="utf-8", errors="replace") as f:
             source = f.read()
+        if abspath.endswith(CXX_SUFFIXES):
+            from . import cxx  # local import: engine stays ast-only
+
+            return cxx.CxxFileContext(rel, source, abspath=abspath)
         return FileContext(rel, source, abspath=abspath)
     except (SyntaxError, ValueError, OSError):
         return None
@@ -306,6 +321,8 @@ def run_rules(
     ctx_by_path: Dict[str, FileContext] = {}
     for ctx in contexts:
         ctx_by_path[ctx.path] = ctx
+        if ctx.is_cxx:
+            continue  # Python file rules; C++ rules are repo rules
         for rule in file_rules:
             raw.extend(rule.check(ctx))
     for rule in repo_rules:

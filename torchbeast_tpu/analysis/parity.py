@@ -30,7 +30,11 @@ def _fold_cpp_int(expr: str) -> Optional[int]:
         return None
     try:
         return int(eval(cleaned, {"__builtins__": {}}, {}))  # noqa: S307
-    except Exception:
+    except (SyntaxError, NameError, ValueError, TypeError,
+            ArithmeticError):
+        # Unparseable constant expression -> None; callers treat an
+        # unresolved anchor as its own parity finding, so nothing is
+        # silently swallowed here.
         return None
 
 

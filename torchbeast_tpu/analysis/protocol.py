@@ -1,0 +1,598 @@
+"""Exhaustive explicit-state model checking of the SPSC ring +
+coalesced-doorbell protocol (ISSUE 10 tentpole).
+
+The shm transport's correctness argument has always been an English
+paragraph ("the re-check after set_waiting closes the lost-wakeup
+window; the send syscall fences the marker publish") backed by
+probabilistic dynamic tests. This module writes the protocol down ONCE
+as a small transition system and enumerates EVERY interleaving of one
+reader + one writer over a bounded ring — turning the paragraph into a
+machine-checked proof, and turning each historical bug into a seeded
+mutation whose counterexample trace the checker must reproduce.
+
+What is modeled (matching runtime/transport.py and csrc/shm.h):
+
+- The ring as a bounded FIFO of frame entries (frames and inline
+  markers). Head/tail arithmetic, wrap markers, and byte sizes are
+  abstracted away: they are layout, pinned separately by WIRE-PARITY;
+  the protocol questions (who blocks, who rings, who re-checks) live at
+  the entry level.
+- The doorbell socket as an ordered byte queue: WAKE (0x01), INLINE
+  (0x02), and abstract inline payloads.
+- Store buffers: the writer's head-publish and the reader's
+  waiting-flag store each sit in a per-process one-way buffer until a
+  nondeterministic flush — CPython emits no store-load fence between
+  the publish and the waiting-flag load, so the model must be able to
+  reorder exactly the way x86 TSO does. A syscall (send/recv/poll)
+  flushes the issuing process's buffer first: this is the "the sendmsg
+  syscall fences the marker publish" property the inline recovery path
+  relies on, stated as a model rule instead of a comment.
+- The reader's bounded recheck (the 20 ms poll timeout) as a timeout
+  transition enabled while blocked; the 100 us empty-spin is a latency
+  optimization with no protocol content and is not modeled.
+
+Checked properties (check_protocol):
+
+- FIFO: every delivery appends the next message id, in order.
+- error-free: no reachable state raises a protocol error ("bad doorbell
+  byte", "inline byte with an empty ring", teardown on a live stream).
+- no wedge (deadlock AND lost-wakeup freedom): from every reachable
+  state, a completed state (all messages delivered, both sides done)
+  is still reachable. This subsumes deadlock (no enabled transition)
+  and livelock (cycles that cannot make progress): a lost wakeup that
+  the recheck recovers is fine; one that wedges the run is a trace.
+
+Seeded mutations (MUTATIONS) re-run the checker on a broken spec and
+must FIND the bug as a counterexample trace:
+
+- no_wake_recheck: remove the bounded poll timeout — the PR 9
+  "metastable wait" (a lost wakeup parks the reader forever).
+- no_inline_recovery: treat an INLINE byte arriving on a blocked reader
+  as a protocol error — the PR 3 fence-less oversized-path lost-wakeup
+  (sender reads stale waiting=0, skips WAKE, lands 0x02 on a blocked
+  reader).
+
+Conformance (SPEC_ACCESS / RECHECK_MS): the spec's accessor sequences
+are pinned against BOTH implementations by the ATOMIC-ORDER rule via
+the C++ frontend and the transport.py AST — reordering a header access
+in either language breaks the pin (see cxxrules.check_conformance).
+"""
+
+import dataclasses
+import json
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# The spec, as data
+
+# The bounded recheck period both implementations must use (ms):
+# transport.py _WAKE_RECHECK_S * 1000 == csrc/shm.h kWakeRecheckMs == this.
+RECHECK_MS = 20
+
+# Canonical per-method header/data access sequences (adjacent-duplicate
+# collapsed), identical for transport.py's ShmRing and csrc/shm.h's —
+# the two implementations must match each other AND this table.
+SPEC_ACCESS: Dict[str, Tuple[str, ...]] = {
+    "write_frame": ("R:head", "R:tail", "W:data", "R:tail", "W:data",
+                    "W:head"),
+    "write_inline_marker": ("R:head", "R:tail", "W:data", "R:tail",
+                            "W:data", "W:head"),
+    "read_frame": ("R:tail", "R:head", "R:data"),
+    "release": ("R:tail", "W:tail"),
+    "has_frame": ("R:head", "R:tail"),
+    "set_waiting": ("W:waiting",),
+    "reader_waiting": ("R:waiting",),
+}
+
+# Ordering invariants that survive branch-shape differences: per method,
+# (op_a, op_b) pairs meaning every occurrence of op_a precedes the LAST
+# occurrence of op_b, plus a required final op. These are the
+# release-publish facts the model checker's atomicity assumptions rest
+# on (data is visible when head is).
+SPEC_FINAL_OP: Dict[str, str] = {
+    "write_frame": "W:head",  # publish LAST: data before head
+    "write_inline_marker": "W:head",
+    "release": "W:tail",  # the slot frees only after the frame is done
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """Protocol variant knobs. The shipped configuration is Spec();
+    mutations flip one knob each (MUTATIONS)."""
+
+    # Reader: the blocked doorbell wait re-checks the ring every
+    # RECHECK_MS even without a byte (the lost-wakeup bound).
+    wake_recheck: bool = True
+    # Reader: an INLINE byte landing while blocked in the wait loop is
+    # recovered (re-check the ring — the marker is fenced in by the
+    # sender's syscall — and deliver via the marker path).
+    inline_recovery: bool = True
+    # Writer: ring the bell only when the reader's waiting flag is set
+    # (coalescing). Disabling makes every send ring (safe, slower).
+    coalesce_wakeups: bool = True
+    # Reader: re-check the ring between arming the waiting flag and
+    # blocking (the Dekker half of the handshake).
+    post_arm_recheck: bool = True
+
+
+MUTATIONS: Dict[str, Spec] = {
+    # PR 9's metastable-wait class: without the bounded recheck a lost
+    # wakeup parks the reader until the next (never-coming) doorbell.
+    "no_wake_recheck": Spec(wake_recheck=False),
+    # PR 3's historical fence-less oversized-path bug: the INLINE byte
+    # lands on a blocked reader that treats it as a protocol error.
+    "no_inline_recovery": Spec(inline_recovery=False),
+    # Removing the post-arm recheck AND the timeout wedges even under
+    # sequential consistency (kept as a third seeded mutant: it shows
+    # the two guards are independently load-bearing).
+    "no_arm_recheck_no_timeout": Spec(wake_recheck=False,
+                                      post_arm_recheck=False),
+}
+
+
+# ---------------------------------------------------------------------------
+# State
+#
+# Immutable tuples throughout; the whole state is hashable.
+#
+#   ring      tuple of ('F', id) / ('M', id) entries VISIBLE in memory
+#   wbuf      writer store buffer: tuple of pending ring entries
+#   rbuf      reader store buffer: pending waiting value or None
+#   waiting   waiting flag value in memory (0/1)
+#   sock      tuple of socket tokens: 'W', 'I', ('P', id)
+#   wphase    writer phase (see below), windex = current message index
+#   rphase    reader phase, delivered = count of delivered messages
+#   held      reader holds an unreleased ring slot (freed at next recv)
+#   inline_consumed  reader consumed the 0x02 during the wait loop
+#
+# Writer phases: 'space' -> 'waitcheck' -> ('bell' | next) for ring
+# messages; 'space' -> 'waitcheck' -> ('bell_inline' | 'inline_byte')
+# -> 'payload' for inline ones; 'done'.
+# Reader phases: 'recv' (release+check) -> 'arm' -> 'recheck' ->
+# 'blocked' -> ... ; 'inline_wait' reads the socket for the payload;
+# 'done'; 'error'.
+
+State = Tuple
+
+
+def _initial(n_msgs: int) -> State:
+    return (
+        (),      # ring
+        (),      # wbuf
+        None,    # rbuf
+        0,       # waiting
+        (),      # sock
+        "space", 0,   # wphase, windex
+        "recv", 0,    # rphase, delivered
+        False,   # held slot
+        False,   # inline_consumed
+    )
+
+
+_RING, _WBUF, _RBUF, _WAITING, _SOCK = 0, 1, 2, 3, 4
+_WPHASE, _WIDX, _RPHASE, _DELIVERED, _HELD, _INLINE = 5, 6, 7, 8, 9, 10
+
+
+def _with(state: State, **kw) -> State:
+    names = ["ring", "wbuf", "rbuf", "waiting", "sock", "wphase",
+             "windex", "rphase", "delivered", "held", "inline_consumed"]
+    vals = list(state)
+    for key, value in kw.items():
+        vals[names.index(key)] = value
+    return tuple(vals)
+
+
+def _flush_writer(state: State) -> State:
+    if not state[_WBUF]:
+        return state
+    return _with(state, ring=state[_RING] + state[_WBUF], wbuf=())
+
+
+def _flush_reader(state: State) -> State:
+    if state[_RBUF] is None:
+        return state
+    return _with(state, waiting=state[_RBUF], rbuf=None)
+
+
+def _reader_sees_ring(state: State) -> Tuple:
+    # The reader sees memory; the writer's unflushed entries are
+    # invisible (that IS the race).
+    return state[_RING]
+
+
+def _writer_occupancy(state: State) -> int:
+    # The writer sees its own buffered entries plus memory; consumed
+    # entries left in memory until release still occupy space — modeled
+    # by the reader's `held` flag keeping one slot accounted.
+    return len(state[_RING]) + len(state[_WBUF]) + (1 if state[_HELD] else 0)
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str  # 'fifo' | 'error' | 'wedge'
+    detail: str
+    trace: List[str]
+
+
+@dataclasses.dataclass
+class Result:
+    ok: bool
+    states: int
+    violations: List[Violation]
+    properties: Dict[str, bool]
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "states": self.states,
+            "properties": self.properties,
+            "violations": [
+                {"kind": v.kind, "detail": v.detail, "trace": v.trace}
+                for v in self.violations
+            ],
+        }
+
+
+def transitions(state: State, spec: Spec, script: Tuple[str, ...],
+                capacity: int) -> Iterator[Tuple[str, State, Optional[str]]]:
+    """Yield (label, next_state, error) for every enabled atomic step.
+
+    `script` is the writer's message plan: 'ring' or 'inline' per
+    message. `capacity` is the ring size in entries. `error` is a
+    protocol-error description when the step lands in a violation state
+    (the caller records it and stops exploring that branch).
+    """
+    n_msgs = len(script)
+    (ring, wbuf, rbuf, waiting, sock, wphase, widx, rphase, delivered,
+     held, inline_consumed) = state
+
+    # -- store-buffer flushes (hardware, any time) -----------------------
+    if wbuf:
+        yield "w:flush", _flush_writer(state), None
+    if rbuf is not None:
+        yield "r:flush", _flush_reader(state), None
+
+    # -- writer ----------------------------------------------------------
+    if wphase == "space" and widx < n_msgs:
+        kind = script[widx]
+        if _writer_occupancy(state) < capacity:
+            entry = ("F", widx) if kind == "ring" else ("M", widx)
+            yield (
+                f"w:publish[{widx}:{kind}]",
+                _with(state, wbuf=wbuf + (entry,), wphase="waitcheck"),
+                None,
+            )
+    elif wphase == "waitcheck":
+        kind = script[widx]
+        # Reads waiting from MEMORY (the reader's buffered store is
+        # invisible — the fence-less half of the race).
+        sees_waiting = waiting != 0 or not spec.coalesce_wakeups
+        if kind == "ring":
+            if sees_waiting:
+                yield "w:bell", _with(state, wphase="bell"), None
+            else:
+                nxt = "space" if widx + 1 < n_msgs else "done"
+                yield (
+                    f"w:skip_bell[{widx}]",
+                    _with(state, wphase=nxt, windex=widx + 1),
+                    None,
+                )
+        else:
+            yield (
+                "w:inline_head",
+                _with(state, wphase="bell_inline" if sees_waiting
+                      else "inline_byte"),
+                None,
+            )
+    elif wphase == "bell":
+        # sendall(WAKE): syscall -> flush own buffer, then the byte.
+        flushed = _flush_writer(state)
+        nxt = "space" if widx + 1 < n_msgs else "done"
+        yield (
+            "w:send_wake",
+            _with(flushed, sock=flushed[_SOCK] + ("W",), wphase=nxt,
+                  windex=widx + 1),
+            None,
+        )
+    elif wphase == "bell_inline":
+        flushed = _flush_writer(state)
+        yield (
+            "w:send_wake",
+            _with(flushed, sock=flushed[_SOCK] + ("W",),
+                  wphase="inline_byte"),
+            None,
+        )
+    elif wphase == "inline_byte":
+        flushed = _flush_writer(state)
+        yield (
+            "w:send_inline_byte",
+            _with(flushed, sock=flushed[_SOCK] + ("I",),
+                  wphase="payload"),
+            None,
+        )
+    elif wphase == "payload":
+        flushed = _flush_writer(state)
+        nxt = "space" if widx + 1 < n_msgs else "done"
+        yield (
+            f"w:send_payload[{widx}]",
+            _with(flushed, sock=flushed[_SOCK] + (("P", widx),),
+                  wphase=nxt, windex=widx + 1),
+            None,
+        )
+
+    # -- reader ----------------------------------------------------------
+    def deliver(st: State, entry, label: str):
+        """Read the front entry: frame -> deliver; marker -> switch to
+        the socket for the payload. The slot stays occupied until the
+        NEXT recv (held)."""
+        kind_e, msg_id = entry
+        if msg_id != st[_DELIVERED]:
+            return (
+                label,
+                st,
+                f"FIFO violation: delivered message {msg_id} while "
+                f"expecting {st[_DELIVERED]}",
+            )
+        base = _with(st, ring=st[_RING][1:], held=True)
+        if kind_e == "F":
+            done = base[_DELIVERED] + 1
+            return (
+                label + f" deliver[{msg_id}]",
+                _with(base, delivered=done,
+                      rphase="done" if done == n_msgs else "recv"),
+                None,
+            )
+        return (label + f" marker[{msg_id}]",
+                _with(base, rphase="inline_wait"), None)
+
+    if rphase == "recv":
+        seen = _reader_sees_ring(state)
+        st = _with(state, held=False)  # release the previous slot
+        if seen:
+            yield deliver(st, seen[0], "r:read_frame")
+        else:
+            yield "r:arm_waiting", _with(st, rbuf=1, rphase="recheck"), None
+    elif rphase == "recheck":
+        seen = _reader_sees_ring(state)
+        if spec.post_arm_recheck and seen:
+            # Dekker half 2: the post-arm re-check. Clearing the flag is
+            # another buffered store.
+            yield deliver(_with(state, rbuf=0), seen[0],
+                          "r:recheck_hit")
+        else:
+            # Enter the blocking recv: kernel entry flushes the waiting
+            # store (it becomes visible no later than the block).
+            yield ("r:block", _with(_flush_reader(state),
+                                    rphase="blocked"), None)
+    elif rphase == "blocked":
+        if sock:
+            byte, rest = sock[0], sock[1:]
+            cleared = _with(state, sock=rest, rbuf=0)
+            if byte == "W":
+                yield "r:wake_byte", _with(cleared, rphase="recv"), None
+            elif byte == "I":
+                if not spec.inline_recovery:
+                    yield (
+                        "r:inline_byte_blocked",
+                        _with(cleared, rphase="error"),
+                        "protocol error: INLINE byte on a blocked "
+                        "reader (stream teardown)",
+                    )
+                else:
+                    seen = _reader_sees_ring(cleared)
+                    if not seen:
+                        yield (
+                            "r:inline_byte_blocked",
+                            _with(cleared, rphase="error"),
+                            "inline byte with an empty ring (the "
+                            "sender's syscall should have fenced the "
+                            "marker in)",
+                        )
+                    else:
+                        yield deliver(
+                            _with(cleared, inline_consumed=True),
+                            seen[0], "r:inline_recover",
+                        )
+            else:
+                yield (
+                    "r:payload_byte_blocked",
+                    _with(cleared, rphase="error"),
+                    "protocol error: payload byte read as doorbell",
+                )
+        elif spec.wake_recheck:
+            # The bounded poll timeout: clear the flag, re-check.
+            yield ("r:recheck_timeout",
+                   _with(state, rbuf=0, rphase="recv"), None)
+    elif rphase == "inline_wait":
+        # Skip stale WAKEs up to the 0x02 (unless already consumed),
+        # then the payload token delivers the message.
+        if inline_consumed:
+            if sock and sock[0][0] == "P":
+                msg_id = sock[0][1]
+                done = delivered + 1
+                if msg_id != delivered:
+                    yield (
+                        "r:inline_payload",
+                        state,
+                        f"FIFO violation: inline payload {msg_id} while "
+                        f"expecting {delivered}",
+                    )
+                else:
+                    yield (
+                        f"r:inline_payload[{msg_id}]",
+                        _with(state, sock=sock[1:], delivered=done,
+                              inline_consumed=False,
+                              rphase="done" if done == n_msgs
+                              else "recv"),
+                        None,
+                    )
+        elif sock:
+            byte, rest = sock[0], sock[1:]
+            if byte == "W":
+                yield ("r:skip_stale_wake",
+                       _with(state, sock=rest), None)
+            elif byte == "I":
+                yield ("r:inline_byte",
+                       _with(state, sock=rest, inline_consumed=True),
+                       None)
+            else:
+                yield (
+                    "r:payload_before_inline",
+                    state,
+                    "protocol error: payload byte before the INLINE "
+                    "byte",
+                )
+
+
+def _is_success(state: State, n_msgs: int) -> bool:
+    return (
+        state[_WPHASE] == "done"
+        and state[_RPHASE] == "done"
+        and state[_DELIVERED] == n_msgs
+    )
+
+
+def check_protocol(spec: Spec = Spec(),
+                   script: Tuple[str, ...] = ("ring", "ring", "inline",
+                                              "ring"),
+                   capacity: int = 2,
+                   max_states: int = 2_000_000) -> Result:
+    """Enumerate every interleaving; verify FIFO + error-freedom +
+    no-wedge. Counterexamples carry the full transition-label trace
+    from the initial state."""
+    n_msgs = len(script)
+    init = _initial(n_msgs)
+    # BFS with predecessor tracking for trace reconstruction.
+    parents: Dict[State, Optional[Tuple[State, str]]] = {init: None}
+    order: List[State] = [init]
+    violations: List[Violation] = []
+    succ: Dict[State, List[State]] = {}
+    i = 0
+    while i < len(order):
+        state = order[i]
+        i += 1
+        if len(parents) > max_states:
+            raise RuntimeError(
+                f"state space exceeded {max_states} states — shrink the "
+                "script/capacity"
+            )
+        outs: List[State] = []
+        for label, nxt, error in transitions(state, spec, script,
+                                             capacity):
+            if error is not None:
+                kind = "fifo" if error.startswith("FIFO") else "error"
+                violations.append(
+                    Violation(kind, error,
+                              _trace(parents, state) + [label]))
+                continue
+            outs.append(nxt)
+            if nxt not in parents:
+                parents[nxt] = (state, label)
+                order.append(nxt)
+        succ[state] = outs
+
+    # No-wedge: backward reachability from success states.
+    can_finish = {s for s in parents if _is_success(s, n_msgs)}
+    changed = True
+    while changed:
+        changed = False
+        for state, outs in succ.items():
+            if state not in can_finish and any(
+                o in can_finish for o in outs
+            ):
+                can_finish.add(state)
+                changed = True
+    wedged = [s for s in parents if s not in can_finish]
+    if wedged:
+        # Report the first wedged state in BFS order (shortest trace).
+        first = min(wedged, key=lambda s: len(_trace(parents, s)))
+        detail = (
+            "wedged state: success unreachable "
+            f"(writer={first[_WPHASE]}, reader={first[_RPHASE]}, "
+            f"delivered={first[_DELIVERED]}/{n_msgs}, "
+            f"ring={list(first[_RING])}, wbuf={list(first[_WBUF])}, "
+            f"waiting={first[_WAITING]}, sock={list(first[_SOCK])})"
+        )
+        violations.append(Violation("wedge", detail,
+                                    _trace(parents, first)))
+
+    properties = {
+        "fifo": not any(v.kind == "fifo" for v in violations),
+        "error_free": not any(v.kind == "error" for v in violations),
+        "no_wedge": not wedged,
+        "success_reachable": bool(can_finish),
+    }
+    return Result(
+        ok=all(properties.values()),
+        states=len(parents),
+        violations=violations,
+        properties=properties,
+    )
+
+
+def _trace(parents, state: State) -> List[str]:
+    labels: List[str] = []
+    cur = state
+    while parents.get(cur) is not None:
+        prev, label = parents[cur]
+        labels.append(label)
+        cur = prev
+    return list(reversed(labels))
+
+
+def render_trace(violation: Violation) -> str:
+    """The counterexample format the README documents: one numbered
+    `actor:action` step per line, then the violated property."""
+    lines = [
+        f"  {i + 1:3d}. {step}" for i, step in enumerate(violation.trace)
+    ]
+    lines.append(f"  => {violation.kind.upper()}: {violation.detail}")
+    return "\n".join(lines)
+
+
+def verify_shipped_and_mutants(script=("ring", "ring", "inline", "ring"),
+                               capacity: int = 2) -> dict:
+    """The acceptance bundle (also `--check-protocol` in the CLI): the
+    shipped spec must verify clean; every seeded mutation must produce
+    a counterexample trace."""
+    out: dict = {"script": list(script), "capacity": capacity}
+    shipped = check_protocol(Spec(), script, capacity)
+    out["shipped"] = shipped.as_dict()
+    out["mutants"] = {}
+    for name, spec in MUTATIONS.items():
+        res = check_protocol(spec, script, capacity)
+        out["mutants"][name] = res.as_dict()
+    out["ok"] = shipped.ok and all(
+        not m["ok"] and m["violations"]
+        for m in out["mutants"].values()
+    )
+    return out
+
+
+def main() -> int:
+    verdict = verify_shipped_and_mutants()
+    print(json.dumps({
+        "protocol": "shm-ring-doorbell",
+        "ok": verdict["ok"],
+        "shipped": verdict["shipped"]["properties"],
+        "shipped_states": verdict["shipped"]["states"],
+        "mutants": {
+            name: {"found": bool(m["violations"]),
+                   "kinds": sorted({v["kind"] for v in m["violations"]})}
+            for name, m in verdict["mutants"].items()
+        },
+    }))
+    if not verdict["ok"]:
+        for name, m in verdict["mutants"].items():
+            if m["ok"]:
+                print(f"mutant {name}: NOT caught")
+    else:
+        # Show one counterexample per mutant (the README's documented
+        # trace format).
+        for name, m in verdict["mutants"].items():
+            v = m["violations"][0]
+            print(f"-- counterexample for mutant {name}:")
+            print(render_trace(Violation(v["kind"], v["detail"],
+                                         v["trace"])))
+    return 0 if verdict["ok"] else 1

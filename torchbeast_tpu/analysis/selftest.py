@@ -8,7 +8,12 @@ verdict line — the cheap CI guard that the analyzer itself still works
 import json
 import time
 
-from . import ALL_RULE_NAMES, analyze_source, analyze_sources
+from . import (
+    ALL_RULE_NAMES,
+    analyze_cxx_sources,
+    analyze_source,
+    analyze_sources,
+)
 from .engine import FileContext, run_rules
 from .parity import check_flag_parity, check_wire_parity
 from .rules import FILE_RULES
@@ -355,6 +360,149 @@ def act(env, n):
     return to_host(host), to_host(n)
 '''
 
+# -- C++ rule fixtures (ISSUE 10) -------------------------------------------
+# These load through the analysis/cxx.py frontend (analyze_cxx_sources).
+# Paths matter: GIL-DISCIPLINE only checks config.GIL_FILES (the .h
+# fixture path gives non-entry functions an UNHELD default, so a bare
+# API call seeds a finding); ATOMIC-ORDER's C++ half anchors on
+# config.SHM_H; CXX-LOCK-DISCIPLINE covers all of csrc/.
+
+_GIL_POSITIVE = """
+void helper_wait() { cv.wait(lk); }
+
+void loop_body() {
+  PyObject* obj = PyLong_FromLong(1);
+}
+
+void hook() {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  helper_wait();
+  PyGILState_Release(gil);
+}
+"""
+
+_GIL_CLEAN = """
+void helper_wait() { cv.wait(lk); }
+
+void loop_body() {
+  GILGuard gil;
+  PyObject* obj = PyLong_FromLong(1);
+}
+
+void hook() {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* obj = PyLong_FromLong(1);
+  PyGILState_Release(gil);
+  helper_wait();
+}
+"""
+
+_ATOMIC_POSITIVE = """
+constexpr size_t kRingHeadWord = 0;
+constexpr size_t kRingTailWord = 1;
+
+class ShmRing {
+ public:
+  void write_frame() {
+    word(kRingHeadWord)->store(1);
+  }
+  bool has_frame() const {
+    return word(kRingHeadWord)->load(std::memory_order_relaxed) != 0;
+  }
+  void peek() {
+    uint64_t* raw = reinterpret_cast<uint64_t*>(base_) + kRingTailWord;
+  }
+ private:
+  std::atomic<uint64_t>* word(size_t i) const;
+  uint8_t* base_;
+};
+"""
+
+_ATOMIC_CLEAN = """
+constexpr size_t kRingHeadWord = 0;
+constexpr size_t kRingTailWord = 1;
+
+class ShmRing {
+ public:
+  void write_frame() {
+    word(kRingHeadWord)->store(1, std::memory_order_release);
+  }
+  bool has_frame() const {
+    return word(kRingHeadWord)->load(std::memory_order_acquire) !=
+           word(kRingTailWord)->load(std::memory_order_relaxed);
+  }
+ private:
+  std::atomic<uint64_t>* word(size_t i) const {
+    return reinterpret_cast<std::atomic<uint64_t>*>(base_ + 8 * i);
+  }
+  uint8_t* base_;
+};
+"""
+
+_CXX_LOCK_POSITIVE = """
+class Pump {
+ public:
+  void start() {
+    threads_.emplace_back([this] { drain(); });
+    threads_.emplace_back([this] { publish(); });
+  }
+  void drain() {
+    total_ += 1;
+    seen_ = total_;
+  }
+  void publish() {
+    last_ = seen_;
+  }
+  int snapshot() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return total_;
+  }
+ private:
+  std::mutex mu_;
+  int total_ = 0;  // guarded-by: mu_
+  int seen_ = 0;
+  int last_ = 0;
+  std::vector<std::thread> threads_;
+};
+"""
+
+_CXX_LOCK_CLEAN = """
+class Pump {
+ public:
+  void start() {
+    threads_.emplace_back([this] { drain(); });
+  }
+  void drain() {
+    std::lock_guard<std::mutex> lock(mu_);
+    total_ += 1;
+    seen_ += 1;
+  }
+  int snapshot() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return total_ + seen_;
+  }
+ private:
+  std::mutex mu_;
+  int total_ = 0;  // guarded-by: mu_
+  int seen_ = 0;
+  std::vector<std::thread> threads_;
+};
+"""
+
+# A seeded violation silenced by the C++ `//` suppression grammar — the
+# one suppression mechanism must cover both languages.
+_CXX_SUPPRESSED = """
+class Pump {
+ public:
+  void drain() {
+    total_ += 1;  // beastlint: disable=CXX-LOCK-DISCIPLINE  fixture: init-only path, no reader yet
+  }
+ private:
+  std::mutex mu_;
+  int total_ = 0;  // guarded-by: mu_
+};
+"""
+
 # -- wire-parity fixtures ---------------------------------------------------
 
 _WIRE_PY = '''
@@ -489,6 +637,30 @@ def run_selftest() -> dict:
             ),
         }
 
+    cxx_pairs = {
+        "GIL-DISCIPLINE": (
+            _GIL_POSITIVE, _GIL_CLEAN, "csrc/actor_pool.h",
+        ),
+        "ATOMIC-ORDER": (
+            _ATOMIC_POSITIVE, _ATOMIC_CLEAN, "csrc/shm.h",
+        ),
+        "CXX-LOCK-DISCIPLINE": (
+            _CXX_LOCK_POSITIVE, _CXX_LOCK_CLEAN, "csrc/queues.h",
+        ),
+    }
+    for name, (positive, clean, path) in cxx_pairs.items():
+        pos_report = analyze_cxx_sources({path: positive})
+        clean_report = analyze_cxx_sources({path: clean})
+        rules[name] = {
+            "positive": any(f.rule == name for f in pos_report.findings),
+            "clean": not any(
+                f.rule == name for f in clean_report.findings
+            ),
+            "isolated": all(
+                f.rule == name for f in pos_report.findings
+            ),
+        }
+
     wire_ctx = FileContext("torchbeast_tpu/runtime/wire.py", _WIRE_PY)
     drifted = check_wire_parity(
         wire_ctx, _WIRE_H_DRIFTED, _ARRAY_H, _CLIENT_H, None
@@ -528,9 +700,14 @@ def run_selftest() -> dict:
         baseline=baseline,
         known_rules=ALL_RULE_NAMES,
     )
+    cxx_sup_report = analyze_cxx_sources({"csrc/queues.h": _CXX_SUPPRESSED})
     mechanics = {
         "suppression": (
             not sup_report.findings and len(sup_report.suppressed) == 1
+        ),
+        "cxx_suppression": (
+            not cxx_sup_report.findings
+            and len(cxx_sup_report.suppressed) == 1
         ),
         "suppress_reason": any(
             f.rule == "SUPPRESS-REASON" for f in reasonless_report.findings

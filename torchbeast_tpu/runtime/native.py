@@ -72,6 +72,11 @@ class NativeTelemetryFolder:
         self._c_steps = registry.counter("actor.env_steps")
         self._c_connects = registry.counter("actor.connects")
         self._c_reconnects = registry.counter("recovery.actor_reconnects")
+        # shm doorbell-wait counters (ISSUE 10): same series names the
+        # Python transport increments directly (transport.py
+        # _ring_instruments), so mixed-runtime runs aggregate.
+        self._c_ring_waits = registry.counter("ring.doorbell_waits")
+        self._c_ring_rechecks = registry.counter("ring.recheck_wakeups")
         self._h_rtt = registry.histogram("actor.request_rtt_s")
         self._h_request_wait = registry.histogram("inference.request_wait_s")
         self._c_queue_in = registry.counter("learner_queue.items_in")
@@ -106,6 +111,16 @@ class NativeTelemetryFolder:
                 self._inc_delta(self._c_connects, "connects", p["connects"])
                 self._inc_delta(
                     self._c_reconnects, "reconnects", p["reconnects"]
+                )
+                # .get: an extension built before ISSUE 10 reports no
+                # ring counters; the fold must not KeyError on it.
+                self._inc_delta(
+                    self._c_ring_waits, "ring_doorbell_waits",
+                    p.get("ring_doorbell_waits", 0),
+                )
+                self._inc_delta(
+                    self._c_ring_rechecks, "ring_recheck_wakeups",
+                    p.get("ring_recheck_wakeups", 0),
                 )
             if self._batcher is not None:
                 b = self._batcher.telemetry()

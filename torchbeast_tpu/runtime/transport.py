@@ -73,6 +73,29 @@ _DOORBELL_INLINE = b"\x02"  # one framed message follows on the socket
 # an idle connection still costs only 50 wakeups/s.
 _WAKE_RECHECK_S = 0.02
 
+# Doorbell-wait observability (ISSUE 10 satellite; same lazy-resolve
+# idiom as wire._instruments so --no_telemetry runs get no-ops):
+# ring.doorbell_waits counts every armed+blocked doorbell wait,
+# ring.recheck_wakeups the subset ended by the bounded recheck instead
+# of a doorbell byte. The ratio is the ROADMAP metastability hunt's
+# signal — a healthy pair wakes on bytes, a degraded one rides the
+# recheck.
+_tm_doorbell_waits = None
+_tm_recheck_wakeups = None
+
+
+def _ring_instruments():
+    global _tm_doorbell_waits, _tm_recheck_wakeups
+    if _tm_doorbell_waits is None:
+        from torchbeast_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        # beastlint: disable=RACE  benign double-init: the registry's get-or-create is idempotent, so racing threads store the SAME instrument object; each store is GIL-atomic
+        _tm_doorbell_waits = reg.counter("ring.doorbell_waits")
+        # beastlint: disable=RACE  same idempotent lazy-init as _tm_doorbell_waits above
+        _tm_recheck_wakeups = reg.counter("ring.recheck_wakeups")
+    return _tm_doorbell_waits, _tm_recheck_wakeups
+
 # Before arming the waiting flag, the reader spins on the head counter
 # for this long: a producer running at a similar cadence lands its next
 # frame inside the spin window, keeping BOTH sides syscall-free. Without
@@ -292,7 +315,7 @@ class ShmRing:
             data[off : off + n] = v
             off += n
         # Publish after the payload bytes are in place.
-        self._u64[0] = self._publish_head
+        self._u64[self._HEAD] = self._publish_head
 
     def write_inline_marker(self, timeout_s: float = 120.0,
                             peer_check=None) -> None:
@@ -302,7 +325,7 @@ class ShmRing:
         the socket for one message."""
         pos = self._reserve(4, timeout_s, peer_check)
         struct.pack_into("<I", self._data, pos, self._INLINE)
-        self._u64[0] = self._publish_head
+        self._u64[self._HEAD] = self._publish_head
 
     def _reserve(self, need: int, timeout_s: float, peer_check=None) -> int:
         """Wait for `need` contiguous bytes at head (writing a wrap
@@ -528,6 +551,7 @@ class ShmTransport:
         ring = self._recv_ring
         sock = self._sock
         mv = self._doorbell_mv
+        waits, rechecks = _ring_instruments()
         deadline = (
             None if self._recv_timeout_s is None
             else time.monotonic() + self._recv_timeout_s
@@ -547,10 +571,12 @@ class ShmTransport:
             try:
                 if ring.has_frame():
                     continue
+                waits.inc()
                 sock.settimeout(_WAKE_RECHECK_S)
                 try:
                     n = sock.recv_into(mv, 1)
                 except socket.timeout:
+                    rechecks.inc()
                     continue  # re-check the ring (lost-wakeup guard)
                 finally:
                     sock.settimeout(None)
