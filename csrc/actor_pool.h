@@ -34,12 +34,27 @@
 #include <thread>
 #include <vector>
 
+#include "backoff.h"
+#include "chaos.h"
 #include "client.h"
 #include "queues.h"
 #include "shm.h"
 #include "wire.h"
 
 namespace tbt {
+
+// A slot-hook failure that is the DeviceStateTable's poison window, not
+// an actor bug (runtime/errors.StateTablePoisonedError crossing the GIL
+// boundary, pymodule.cc throw_py_error_typed). Derives from AsyncError
+// so ONE catch handler covers both inference-side failure classes —
+// the same shape as the Python pool's single
+// `except (AsyncError, StateTablePoisonedError)` clause: both ride the
+// budgeted retry path instead of retiring the actor while the
+// supervisor rebuilds the table concurrently (ISSUE 6 contract).
+class StateTableError : public AsyncError {
+ public:
+  using AsyncError::AsyncError;
+};
 
 inline const std::vector<std::string>& env_keys() {
   static const std::vector<std::string> keys = {
@@ -60,6 +75,7 @@ class ActorPool {
     int64_t env_steps = 0;
     int64_t connects = 0;
     int64_t reconnects = 0;
+    int64_t batch_retries = 0;
     int64_t bytes_up = 0;    // env server -> this process
     int64_t bytes_down = 0;  // actions back out
     // shm doorbell-wait counters (process-wide, csrc/shm.h
@@ -74,7 +90,8 @@ class ActorPool {
             double connect_timeout_s = 600, int64_t max_reconnects = 0,
             bool use_slots = false, SlotHook slot_reset = nullptr,
             SlotHook slot_read = nullptr,
-            size_t max_frame_bytes = wire::kMaxFrameBytes)
+            size_t max_frame_bytes = wire::kMaxFrameBytes,
+            bool enable_fault_hooks = false)
       : unroll_length_(unroll_length),
         learner_queue_(std::move(learner_queue)),
         inference_batcher_(std::move(inference_batcher)),
@@ -89,16 +106,39 @@ class ActorPool {
     if (use_slots_ && (!slot_reset_ || !slot_read_))
       throw std::invalid_argument(
           "slot framing needs slot_reset and slot_read hooks");
+    // Chaos interposition (csrc/chaos.h): constructed only when armed —
+    // unarmed pools never wrap a transport, so the hot path pays zero.
+    if (enable_fault_hooks) fault_hooks_ = std::make_unique<FaultHooks>();
   }
 
   int64_t count() const { return count_.load(); }
+  // COMPLETED recoveries (the stream re-established AND delivering
+  // again), not granted retry attempts — the Python pool's contract,
+  // which is what lets chaos_run assert reconnects == injected faults
+  // exactly on both runtimes (ISSUE 12 satellite).
   int64_t reconnect_count() const { return reconnect_count_.load(); }
+
+  // Actor loops still running; the driver's health machine runs
+  // DEGRADED while this stays >= --min_live_actors and halts (clean
+  // checkpoint-and-exit) below it — same contract as the Python pool.
+  int64_t live_actors() const {
+    return static_cast<int64_t>(addresses_.size()) - dead_.load();
+  }
+
+  std::vector<std::string> error_messages() const {
+    std::lock_guard<std::mutex> lock(error_mu_);
+    return error_messages_;
+  }
+
+  // The chaos entry points' target (null when not armed).
+  FaultHooks* fault_hooks() { return fault_hooks_.get(); }
 
   Telemetry telemetry() const {
     Telemetry t;
     t.env_steps = count_.load();
     t.connects = connects_.load();
     t.reconnects = reconnect_count_.load();
+    t.batch_retries = batch_retries_.load();
     t.bytes_up = bytes_up_.load();
     t.bytes_down = bytes_down_.load();
     t.ring_doorbell_waits =
@@ -136,59 +176,108 @@ class ActorPool {
   }
 
  private:
-  void record_first_error() {
+  // Record inside a catch block (std::current_exception must be live).
+  void record_error(const std::string& message) {
     std::lock_guard<std::mutex> lock(error_mu_);
+    error_messages_.push_back(message);
     if (!first_error_) first_error_ = std::current_exception();
   }
 
+  bool shutting_down() const {
+    return inference_batcher_->is_closed() || learner_queue_->is_closed();
+  }
+
   void guarded_loop(int64_t index, const std::string& address) {
-    int64_t reconnects = 0;
+    // ANY exit — clean shutdown or a burned budget — retires this
+    // actor; live_actors() feeds the driver's health machine (the
+    // Python pool's _guarded_loop finally-block contract).
+    struct Retire {
+      ActorPool* pool;
+      ~Retire() { pool->dead_.fetch_add(1); }
+    } retire{this};
+    // One budget for BOTH failure classes (transport failures and
+    // failed inference batches), refilled by a full recovered unroll —
+    // mirroring the Python pool's _recovering_loop. Retries ride the
+    // decorrelated-jitter Backoff (csrc/backoff.h) so a dead address
+    // is never re-dialed in a tight loop and a mass server restart
+    // never thundering-herds the fresh listener.
+    int64_t failures = 0;
     int64_t progress = 0;  // this actor's env steps across reconnects
+    bool reconnect_pending = false;
+    Backoff backoff(0.1, 2.0);
+    auto abort_sleep = [this] { return shutting_down(); };
     while (true) {
       int64_t steps_at_connect = progress;
-      // Transport failure (env-server death / stream cut / corrupt shm
-      // frame): optionally reconnect with a fresh env + reset agent
-      // state. During pipeline shutdown exit cleanly; a full recovery
-      // (>= one unroll streamed since the last connect) earns the
-      // budget back. Returns true to retry the stream.
-      auto transport_failure = [&]() -> bool {
-        if (inference_batcher_->is_closed() || learner_queue_->is_closed())
-          return false;
-        if (progress - steps_at_connect >= unroll_length_) reconnects = 0;
-        if (reconnects < max_reconnects_) {
-          ++reconnects;
-          reconnect_count_.fetch_add(1);
-          return true;
+      // Grant a budgeted retry (false during shutdown or once the
+      // budget is burned). Sleeps the jittered backoff before the
+      // caller retries the stream; a shutdown landing MID-SLEEP also
+      // denies the grant — the retry would otherwise re-dial a reaped
+      // env server for up to connect_timeout_s.
+      auto grant_retry = [&]() -> bool {
+        if (shutting_down()) return false;
+        if (progress - steps_at_connect >= unroll_length_) {
+          failures = 0;
+          backoff.reset();
         }
-        record_first_error();
-        return false;
+        if (failures >= max_reconnects_) return false;
+        ++failures;
+        backoff.sleep(abort_sleep);
+        return !shutting_down();
       };
       try {
-        loop(index, address, &progress);
+        loop(index, address, &progress, &reconnect_pending);
         return;
       } catch (const ClosedBatchingQueue&) {
         return;  // clean shutdown
       } catch (const QueueStopped&) {
         return;  // clean shutdown
-      } catch (const AsyncError&) {
-        // Clean ONLY when the pipeline is shutting down; a broken promise
-        // mid-training (inference failure) is a real error.
-        if (!inference_batcher_->is_closed() &&
-            !learner_queue_->is_closed()) {
-          record_first_error();
+      } catch (const AsyncError& e) {
+        // A broken inference promise mid-training — or, via the
+        // StateTableError subclass, a DIRECT slot-hook call
+        // (connect-time reset, unroll-boundary read) landing inside
+        // the poison-to-rebuild window. Either may come from a
+        // RECOVERING serving thread (state-table rebuild) — discard
+        // the partial rollout and retry the stream under the same
+        // budget/backoff as a reconnect (the PR 6 Python contract),
+        // instead of retiring the actor for good.
+        if (grant_retry()) {
+          batch_retries_.fetch_add(1);
+          continue;
         }
+        // Re-checked AFTER the failed grant: shutdown landing during
+        // the backoff sleep must exit cleanly, not record an error.
+        if (shutting_down()) return;
+        record_error(e.what());
         return;
-      } catch (const SocketError&) {
-        if (transport_failure()) continue;
+      } catch (const SocketError& e) {
+        // Transport failure (env-server death / stream cut): reconnect
+        // with a fresh env + reset agent state. The reconnect is
+        // COUNTED only once the new stream delivers (loop() clears
+        // reconnect_pending after the initial step) — attempts that
+        // fail before streaming are budget, not recoveries.
+        if (grant_retry()) {
+          reconnect_pending = true;
+          continue;
+        }
+        if (shutting_down()) return;
+        record_error(e.what());
         return;
-      } catch (const wire::WireError&) {
+      } catch (const wire::WireError& e) {
         // A corrupt frame (bit-flipped tcp stream, stomped shm ring) is
         // a per-connection failure, not a pool failure — same
         // reconnect contract as the Python pool.
-        if (transport_failure()) continue;
+        if (grant_retry()) {
+          reconnect_pending = true;
+          continue;
+        }
+        if (shutting_down()) return;
+        record_error(e.what());
+        return;
+      } catch (const std::exception& e) {
+        record_error(e.what());
         return;
       } catch (...) {
-        record_first_error();
+        record_error("unknown error");
         return;
       }
     }
@@ -243,9 +332,17 @@ class ActorPool {
     return env_outputs_from(msg);
   }
 
-  void loop(int64_t index, const std::string& address, int64_t* progress) {
+  void loop(int64_t index, const std::string& address, int64_t* progress,
+            bool* reconnect_pending) {
     std::unique_ptr<Transport> sock =
         shm::connect_transport(address, connect_timeout_s_, max_frame_bytes_);
+    if (fault_hooks_) {
+      // Chaos interposition: every (re)connection gets wrapped, so
+      // injected faults see post-reconnect streams too (the Python
+      // pool's transport_wrap contract).
+      sock = std::make_unique<ChaosTransport>(std::move(sock), index,
+                                              fault_hooks_.get());
+    }
     connects_.fetch_add(1);
     // shm connections: sweep the ring segments on EVERY teardown — a
     // SIGKILL'd env server can't clean up its own, and for a live
@@ -264,6 +361,14 @@ class ActorPool {
         use_slots_ ? slot_reset_(index) : initial_agent_state_;
 
     ArrayNest env_outputs = recv_step(sock.get());
+    // The stream is re-established AND delivering: a granted reconnect
+    // retry counts as a completed recovery now — not at grant time, so
+    // attempts that die before streaming (a stale socket file, a
+    // mid-respawn handshake) never inflate the count past the faults.
+    if (*reconnect_pending) {
+      *reconnect_pending = false;
+      reconnect_count_.fetch_add(1);
+    }
     ArrayNest agent_state = initial_agent_state;
 
     auto compute = [this, index](const ArrayNest& env, ArrayNest* state,
@@ -376,11 +481,15 @@ class ActorPool {
 
   std::atomic<int64_t> count_{0};
   std::atomic<int64_t> reconnect_count_{0};
+  std::atomic<int64_t> batch_retries_{0};
   std::atomic<int64_t> connects_{0};
+  std::atomic<int64_t> dead_{0};  // retired actor loops (live_actors())
   std::atomic<int64_t> bytes_up_{0};
   std::atomic<int64_t> bytes_down_{0};
+  std::unique_ptr<FaultHooks> fault_hooks_;  // non-null only when armed
   mutable std::mutex error_mu_;
-  std::exception_ptr first_error_;
+  std::exception_ptr first_error_;  // guarded-by: error_mu_
+  std::vector<std::string> error_messages_;  // guarded-by: error_mu_
 };
 
 }  // namespace tbt

@@ -45,6 +45,15 @@ class Transport {
   wire::ValueNest recv() { return recv_sized().first; }
   // shm crash sweep; no-op for socket transports.
   virtual void unlink_segments() {}
+  // Chaos hooks (csrc/chaos.h / ISSUE 12): sever the stream from
+  // another thread — shutdown(SHUT_RDWR) so a parked recv wakes with
+  // the same EOF a real cable cut produces; corrupt the shm recv ring's
+  // queued frame (1 = observably landed, 0 = momentarily empty, retry;
+  // -1 = not an shm transport). No-ops on transports without the
+  // underlying surface; the FaultingTransport parity contract lives in
+  // resilience/chaos.py.
+  virtual void shutdown_stream() {}
+  virtual int corrupt_recv_ring(bool /*header*/) { return -1; }
   virtual void close() = 0;
 };
 
@@ -103,6 +112,13 @@ class FramedSocket : public Transport {
       ::close(fd_);
       fd_ = -1;
     }
+  }
+
+  // Chaos sever: called from the injector thread while the owning actor
+  // may be blocked in recv — shutdown (not close) keeps the fd valid
+  // until the owner tears down, so there is no fd-reuse race.
+  void shutdown_stream() override {
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
   }
 
   size_t send(const wire::ValueNest& value) override {

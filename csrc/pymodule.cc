@@ -799,6 +799,34 @@ PyObject* batcher_telemetry(PyDynamicBatcher* self, PyObject*) {
       wait_py, "request_rtt_s", rtt_py, "batch_size", sizes_py);
 }
 
+// Drain the sampled (enqueued, batched, replied) stamp triples (ISSUE
+// 12): {"now": <steady-clock seconds>, "spans": [(e, b, r), ...]}.
+// "now" lets the Python fold rebase the steady-clock stamps onto its
+// perf_counter timebase before emitting tracer spans.
+PyObject* batcher_trace_spans(PyDynamicBatcher* self, PyObject*) {
+  auto telemetry = self->batcher->telemetry();
+  std::vector<std::array<double, 3>> spans;
+  {
+    std::lock_guard<std::mutex> lock(telemetry->trace_mu);
+    spans.swap(telemetry->trace_spans);
+  }
+  double now = std::chrono::duration<double>(
+                   std::chrono::steady_clock::now().time_since_epoch())
+                   .count();
+  PyObject* list = PyList_New(static_cast<Py_ssize_t>(spans.size()));
+  if (!list) return nullptr;
+  for (size_t i = 0; i < spans.size(); ++i) {
+    PyObject* t =
+        Py_BuildValue("(ddd)", spans[i][0], spans[i][1], spans[i][2]);
+    if (!t) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, static_cast<Py_ssize_t>(i), t);
+  }
+  return Py_BuildValue("{s:d,s:N}", "now", now, "spans", list);
+}
+
 void batcher_dealloc(PyDynamicBatcher* self) {
   self->batcher.~shared_ptr();
   Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
@@ -815,6 +843,8 @@ PyMethodDef batcher_methods[] = {
     {"compute", reinterpret_cast<PyCFunction>(batcher_compute), METH_O,
      nullptr},
     {"telemetry", reinterpret_cast<PyCFunction>(batcher_telemetry),
+     METH_NOARGS, nullptr},
+    {"trace_spans", reinterpret_cast<PyCFunction>(batcher_trace_spans),
      METH_NOARGS, nullptr},
     {"close", reinterpret_cast<PyCFunction>(batcher_close), METH_NOARGS,
      nullptr},
@@ -834,8 +864,12 @@ PyTypeObject PyDynamicBatcherType = {
 // Python DeviceStateTable the Python pool uses, taking the GIL only at
 // stream (re)connect (reset) and once per unroll boundary (read_slot) —
 // never per step. Conversion borrows the returned numpy buffers
-// refcounted (py_owner), so no copy is paid either.
+// refcounted (py_owner), so no copy is paid either. Errors cross the
+// boundary TYPED (throw_py_error_typed): a StateTablePoisonedError
+// becomes tbt::StateTableError so the actor rides its budgeted retry
+// path while the supervisor rebuilds, instead of retiring (ISSUE 12).
 [[noreturn]] void throw_py_error();
+[[noreturn]] void throw_py_error_typed();
 
 tbt::ActorPool::SlotHook make_slot_reset(std::shared_ptr<void> table_ref) {
   return [table_ref](int64_t slot) -> ArrayNest {
@@ -844,18 +878,18 @@ tbt::ActorPool::SlotHook make_slot_reset(std::shared_ptr<void> table_ref) {
     try {
       PyObject* table = static_cast<PyObject*>(table_ref.get());
       PyObject* ids = Py_BuildValue("[L]", static_cast<long long>(slot));
-      if (!ids) throw_py_error();
+      if (!ids) throw_py_error_typed();
       PyObject* r = PyObject_CallMethod(table, "reset", "O", ids);
       Py_DECREF(ids);
-      if (!r) throw_py_error();
+      if (!r) throw_py_error_typed();
       Py_DECREF(r);
       PyObject* initial =
           PyObject_GetAttrString(table, "initial_state_host");
-      if (!initial) throw_py_error();
+      if (!initial) throw_py_error_typed();
       ArrayNest nest;
       bool ok = nest_from_py(initial, &nest);
       Py_DECREF(initial);
-      if (!ok) throw_py_error();
+      if (!ok) throw_py_error_typed();
       out = std::move(nest);
     } catch (...) {
       PyGILState_Release(gil);
@@ -874,11 +908,11 @@ tbt::ActorPool::SlotHook make_slot_read(std::shared_ptr<void> table_ref) {
       PyObject* table = static_cast<PyObject*>(table_ref.get());
       PyObject* piece = PyObject_CallMethod(
           table, "read_slot", "L", static_cast<long long>(slot));
-      if (!piece) throw_py_error();
+      if (!piece) throw_py_error_typed();
       ArrayNest nest;
       bool ok = nest_from_py(piece, &nest);
       Py_DECREF(piece);
-      if (!ok) throw_py_error();
+      if (!ok) throw_py_error_typed();
       out = std::move(nest);
     } catch (...) {
       PyGILState_Release(gil);
@@ -893,17 +927,20 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
   static const char* kwlist[] = {
       "unroll_length",     "learner_queue", "inference_batcher",
       "env_server_addresses", "initial_agent_state", "connect_timeout_s",
-      "max_reconnects", "state_table", "max_frame_bytes", nullptr};
+      "max_reconnects", "state_table", "max_frame_bytes", "fault_hooks",
+      nullptr};
   long long unroll_length = 0, max_reconnects = 0;
   PyObject *queue_obj, *batcher_obj, *addresses_obj, *state_obj;
   PyObject* table_obj = Py_None;
   PyObject* max_frame_obj = Py_None;
   double connect_timeout_s = 600;
+  int fault_hooks = 0;
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "LO!O!OO|dLOO", const_cast<char**>(kwlist),
+          args, kwargs, "LO!O!OO|dLOOp", const_cast<char**>(kwlist),
           &unroll_length, &PyBatchingQueueType, &queue_obj,
           &PyDynamicBatcherType, &batcher_obj, &addresses_obj, &state_obj,
-          &connect_timeout_s, &max_reconnects, &table_obj, &max_frame_obj))
+          &connect_timeout_s, &max_reconnects, &table_obj, &max_frame_obj,
+          &fault_hooks))
     return -1;
   std::vector<std::string> addresses;
   PyObject* seq = PySequence_Fast(addresses_obj, "addresses must be a sequence");
@@ -961,7 +998,7 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
         reinterpret_cast<PyDynamicBatcher*>(batcher_obj)->batcher,
         std::move(addresses), std::move(owned), connect_timeout_s,
         max_reconnects, use_slots, std::move(slot_reset),
-        std::move(slot_read), max_frame_bytes);
+        std::move(slot_read), max_frame_bytes, fault_hooks != 0);
     return 0;
   } catch (...) {
     set_py_error();
@@ -983,15 +1020,111 @@ PyObject* pool_reconnect_count(PyActorPool* self, PyObject*) {
   return PyLong_FromLongLong(self->pool->reconnect_count());
 }
 
+PyObject* pool_live_actors(PyActorPool* self, PyObject*) {
+  return PyLong_FromLongLong(self->pool->live_actors());
+}
+
+// Retired-actor error messages, oldest first — the same `.errors`
+// surface the Python pool exposes (strings here: the C++ exceptions
+// have no Python identity), read by the driver's health monitor.
+PyObject* pool_errors_getter(PyActorPool* self, void*) {
+  std::vector<std::string> msgs = self->pool->error_messages();
+  PyObject* list = PyList_New(static_cast<Py_ssize_t>(msgs.size()));
+  if (!list) return nullptr;
+  for (size_t i = 0; i < msgs.size(); ++i) {
+    PyObject* s =
+        PyUnicode_FromStringAndSize(msgs[i].data(), msgs[i].size());
+    if (!s) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, static_cast<Py_ssize_t>(i), s);
+  }
+  return list;
+}
+
+// --- chaos entry points (resilience/chaos.py ChaosController, native
+// path): each returns True when the fault observably landed, False when
+// the target is momentarily un-injectable (the controller retries on a
+// later tick, keeping injected counts exact). ValueError when the pool
+// was built without fault_hooks=True — a miswired driver should fail
+// loudly, not silently abandon every fault.
+tbt::FaultHooks* pool_hooks_or_raise(PyActorPool* self) {
+  tbt::FaultHooks* hooks = self->pool->fault_hooks();
+  if (!hooks)
+    PyErr_SetString(PyExc_ValueError,
+                    "ActorPool was built without fault_hooks=True");
+  return hooks;
+}
+
+PyObject* pool_chaos_sever(PyActorPool* self, PyObject* arg) {
+  long long actor = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  tbt::FaultHooks* hooks = pool_hooks_or_raise(self);
+  if (!hooks) return nullptr;
+  bool ok = false;
+  if (!call_nogil([&] { ok = hooks->sever(actor); })) return nullptr;
+  return PyBool_FromLong(ok);
+}
+
+PyObject* pool_chaos_window(PyActorPool* self, PyObject* args,
+                            PyObject* kwargs) {
+  static const char* kwlist[] = {"actor", "kind", "duration_s", "delay_s",
+                                 nullptr};
+  long long actor = 0;
+  const char* kind = nullptr;
+  double duration_s = 1.0, delay_s = 0.05;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "Ls|dd",
+                                   const_cast<char**>(kwlist), &actor,
+                                   &kind, &duration_s, &delay_s))
+    return nullptr;
+  bool is_delay;
+  if (std::strcmp(kind, "transport_delay") == 0) {
+    is_delay = true;
+  } else if (std::strcmp(kind, "transport_blackhole") == 0) {
+    is_delay = false;
+  } else {
+    PyErr_Format(PyExc_ValueError, "unknown window kind %s", kind);
+    return nullptr;
+  }
+  tbt::FaultHooks* hooks = pool_hooks_or_raise(self);
+  if (!hooks) return nullptr;
+  bool ok = false;
+  if (!call_nogil(
+          [&] { ok = hooks->arm_window(actor, is_delay, duration_s,
+                                       delay_s); }))
+    return nullptr;
+  return PyBool_FromLong(ok);
+}
+
+PyObject* pool_chaos_corrupt_ring(PyActorPool* self, PyObject* args,
+                                  PyObject* kwargs) {
+  static const char* kwlist[] = {"actor", "header", nullptr};
+  long long actor = 0;
+  int header = 1;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "L|p",
+                                   const_cast<char**>(kwlist), &actor,
+                                   &header))
+    return nullptr;
+  tbt::FaultHooks* hooks = pool_hooks_or_raise(self);
+  if (!hooks) return nullptr;
+  bool ok = false;
+  if (!call_nogil(
+          [&] { ok = hooks->corrupt_recv_ring(actor, header != 0); }))
+    return nullptr;
+  return PyBool_FromLong(ok);
+}
+
 // Cumulative wire/step counters — the driver folds tick deltas into the
 // telemetry registry (runtime/native.py NativeTelemetryFolder).
 PyObject* pool_telemetry(PyActorPool* self, PyObject*) {
   tbt::ActorPool::Telemetry t = self->pool->telemetry();
   return Py_BuildValue(
-      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L}", "env_steps",
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L}", "env_steps",
       static_cast<long long>(t.env_steps), "connects",
       static_cast<long long>(t.connects), "reconnects",
-      static_cast<long long>(t.reconnects), "bytes_up",
+      static_cast<long long>(t.reconnects), "batch_retries",
+      static_cast<long long>(t.batch_retries), "bytes_up",
       static_cast<long long>(t.bytes_up), "bytes_down",
       static_cast<long long>(t.bytes_down), "ring_doorbell_waits",
       static_cast<long long>(t.ring_doorbell_waits), "ring_recheck_wakeups",
@@ -1024,9 +1157,26 @@ PyMethodDef pool_methods[] = {
      nullptr},
     {"reconnect_count", reinterpret_cast<PyCFunction>(pool_reconnect_count),
      METH_NOARGS, nullptr},
+    {"live_actors", reinterpret_cast<PyCFunction>(pool_live_actors),
+     METH_NOARGS, nullptr},
+    {"chaos_sever", reinterpret_cast<PyCFunction>(pool_chaos_sever),
+     METH_O, nullptr},
+    {"chaos_window",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)()>(pool_chaos_window)),
+     METH_VARARGS | METH_KEYWORDS, nullptr},
+    {"chaos_corrupt_ring",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)()>(pool_chaos_corrupt_ring)),
+     METH_VARARGS | METH_KEYWORDS, nullptr},
     {"telemetry", reinterpret_cast<PyCFunction>(pool_telemetry),
      METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr}};
+
+PyGetSetDef pool_getset[] = {
+    {"errors", reinterpret_cast<getter>(pool_errors_getter), nullptr,
+     nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr}};
 
 PyTypeObject PyActorPoolType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
@@ -1060,9 +1210,9 @@ struct PyRef {
   explicit operator bool() const { return p != nullptr; }
 };
 
-// Fetch + clear the pending Python error and raise it as a C++ exception
-// (the server reports it to the client as an error frame).
-[[noreturn]] void throw_py_error() {
+// Fetch + clear the pending Python error; returns "Type: message" and
+// reports the exception type's name through *type_name.
+std::string fetch_py_error(std::string* type_name) {
   PyObject *ptype = nullptr, *pvalue = nullptr, *ptraceback = nullptr;
   PyErr_Fetch(&ptype, &pvalue, &ptraceback);
   std::string msg = "python error";
@@ -1070,6 +1220,7 @@ struct PyRef {
     PyObject* name = PyObject_GetAttrString(ptype, "__name__");
     if (name && PyUnicode_Check(name)) {
       msg = PyUnicode_AsUTF8(name);
+      *type_name = msg;
     }
     Py_XDECREF(name);
   }
@@ -1085,6 +1236,25 @@ struct PyRef {
   Py_XDECREF(pvalue);
   Py_XDECREF(ptraceback);
   PyErr_Clear();
+  return msg;
+}
+
+// Raise the pending Python error as a C++ exception (the server reports
+// it to the client as an error frame).
+[[noreturn]] void throw_py_error() {
+  std::string type_name;
+  throw std::runtime_error(fetch_py_error(&type_name));
+}
+
+// Slot-hook variant (ISSUE 12): the DeviceStateTable's typed poison
+// error crosses the GIL boundary as tbt::StateTableError so the C++
+// actor loop distinguishes "the table is mid-rebuild, retry under
+// budget" from a real actor bug (csrc/actor_pool.h guarded_loop).
+[[noreturn]] void throw_py_error_typed() {
+  std::string type_name;
+  std::string msg = fetch_py_error(&type_name);
+  if (type_name == "StateTablePoisonedError")
+    throw tbt::StateTableError(msg);
   throw std::runtime_error(msg);
 }
 
@@ -1394,11 +1564,48 @@ PyObject* py_bench_client_rtt(PyObject*, PyObject* args, PyObject* kwargs) {
   return Py_BuildValue("(Ld)", iters, elapsed);
 }
 
+// Adaptive-recheck policy simulator (tests/test_native.py): drive the
+// C++ AdaptiveRecheck with a sequence of wait outcomes (truthy = ended
+// by the recheck timeout) and return the bound (ms) after each record —
+// pins the tighten/relax behavior without standing up a live ring.
+PyObject* py_adaptive_recheck_sim(PyObject*, PyObject* arg) {
+  PyObject* seq = PySequence_Fast(arg, "expected a sequence of outcomes");
+  if (!seq) return nullptr;
+  tbt::shm::AdaptiveRecheck policy;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject* out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    int truth = PyObject_IsTrue(PySequence_Fast_GET_ITEM(seq, i));
+    if (truth < 0) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    policy.record(truth == 1);
+    PyObject* bound = PyLong_FromLong(policy.bound_ms());
+    if (!bound) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, bound);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
 // ---------------------------------------------------------------- module
 PyMethodDef module_functions[] = {
     {"wire_encode", reinterpret_cast<PyCFunction>(py_wire_encode), METH_O,
      nullptr},
     {"wire_decode", reinterpret_cast<PyCFunction>(py_wire_decode), METH_O,
+     nullptr},
+    {"adaptive_recheck_sim",
+     reinterpret_cast<PyCFunction>(py_adaptive_recheck_sim), METH_O,
      nullptr},
     {"bench_client_rtt",
      reinterpret_cast<PyCFunction>(
@@ -1452,6 +1659,7 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
             pool_new, reinterpret_cast<initproc>(pool_init),
             reinterpret_cast<destructor>(pool_dealloc), pool_methods, nullptr,
             nullptr, nullptr);
+  PyActorPoolType.tp_getset = pool_getset;
   init_type(&PyEnvServerType, "_tbt_core.EnvServer", sizeof(PyEnvServer),
             env_server_new, reinterpret_cast<initproc>(env_server_init),
             reinterpret_cast<destructor>(env_server_dealloc),

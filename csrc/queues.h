@@ -6,6 +6,7 @@
 
 #pragma once
 
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -83,6 +84,13 @@ class HistAccum {
   std::map<int, int64_t> buckets_;
 };
 
+// Sampled request->trace cadence, matching the Python pool's
+// actor_pool.py _TRACE_EVERY so native and Python runs trace at the
+// same density; the span buffer is bounded so an idle driver (nobody
+// draining trace_spans) never grows memory.
+constexpr int64_t kTraceEvery = 256;
+constexpr size_t kTraceSpanCap = 1024;
+
 // Per-request pipeline stamps (ISSUE 2 parity): enqueue -> batch ->
 // reply. Shared by the batcher and its in-flight Batches.
 struct BatcherTelemetry {
@@ -91,6 +99,14 @@ struct BatcherTelemetry {
   HistAccum batch_size;
   HistAccum request_wait_s;  // enqueue -> picked into a batch
   HistAccum request_rtt_s;   // enqueue -> outputs distributed
+  // Sampled per-request spans (ISSUE 12): 1-in-kTraceEvery computes
+  // records its (enqueued, batched, replied) steady-clock stamps here;
+  // the driver drains them each monitor tick and folds them into
+  // tracer StageTraces under the same actor.request.* names the
+  // Python pool emits (runtime/native.py NativeTelemetryFolder).
+  std::atomic<int64_t> trace_tick{0};
+  std::mutex trace_mu;
+  std::vector<std::array<double, 3>> trace_spans;  // guarded-by: trace_mu
 };
 
 class ClosedBatchingQueue : public std::runtime_error {
@@ -321,6 +337,9 @@ class DynamicBatcher {
     // Stage stamps (enqueue -> batch -> reply): set at compute(), read
     // when the batch forms and when outputs are distributed.
     std::chrono::steady_clock::time_point enqueued_at;
+    // Trace sampling (ISSUE 12): this request records a full span.
+    bool traced = false;
+    std::chrono::steady_clock::time_point batched_at;
   };
 
   class Batch {
@@ -372,6 +391,16 @@ class DynamicBatcher {
         if (telemetry_) {
           telemetry_->request_rtt_s.observe(
               std::chrono::duration<double>(now - r.enqueued_at).count());
+          if (r.traced) {
+            auto to_s = [](std::chrono::steady_clock::time_point tp) {
+              return std::chrono::duration<double>(tp.time_since_epoch())
+                  .count();
+            };
+            std::lock_guard<std::mutex> lock(telemetry_->trace_mu);
+            if (telemetry_->trace_spans.size() < kTraceSpanCap)
+              telemetry_->trace_spans.push_back(
+                  {to_s(r.enqueued_at), to_s(r.batched_at), to_s(now)});
+          }
         }
         r.promise->set_value(std::move(mine));
         offset += count;
@@ -415,6 +444,11 @@ class DynamicBatcher {
       throw std::invalid_argument("compute() exceeds maximum_batch_size");
     Request req{std::make_shared<std::promise<ArrayNest>>(), rows,
                 std::chrono::steady_clock::now()};
+    // Sampled tracing (1-in-kTraceEvery, like the Python pool): N
+    // racing actors may interleave ticks, which only shifts WHICH
+    // request gets traced.
+    req.traced =
+        (telemetry_->trace_tick.fetch_add(1) + 1) % kTraceEvery == 0;
     auto future = req.promise->get_future();
     queue_.enqueue(std::move(inputs), std::move(req));
     if (future.wait_for(std::chrono::seconds(timeout_s)) ==
@@ -429,8 +463,9 @@ class DynamicBatcher {
     auto [inputs, requests] = queue_.dequeue_many();
     auto now = std::chrono::steady_clock::now();
     int64_t rows = 0;
-    for (const Request& r : requests) {
+    for (Request& r : requests) {
       rows += r.rows;
+      r.batched_at = now;
       telemetry_->request_wait_s.observe(
           std::chrono::duration<double>(now - r.enqueued_at).count());
     }

@@ -66,12 +66,55 @@ constexpr size_t kDefaultActRingBytes = 256 * 1024;
 
 // Reader-side wait tuning (matches transport.py's rationale; the exact
 // values are latency knobs, not wire format).
-// Bounds the (fence-less) lost-wakeup stall. 20ms like transport.py's
-// _WAKE_RECHECK_S: under scheduler pressure a doorbell hop can be late
-// or lost, and a tight recheck caps that stall at one scheduling
-// quantum; an idle connection pays only 50 wakeups/s for it.
+// The INITIAL bound on the (fence-less) lost-wakeup stall. 20ms like
+// transport.py's _WAKE_RECHECK_S: under scheduler pressure a doorbell
+// hop can be late or lost, and a tight recheck caps that stall at one
+// scheduling quantum; an idle connection pays only 50 wakeups/s for it.
 constexpr int kWakeRecheckMs = 20;
+// Adaptive recheck policy (ISSUE 12): per-connection, the bound walks
+// within [kRecheckMinMs, kRecheckMaxMs] driven by the
+// ring.doorbell_waits / ring.recheck_wakeups counters' local window —
+// a recheck-heavy window (>= kRecheckTighten of kRecheckWindow waits
+// ended by the timeout: doorbells are being lost/late, the ROADMAP
+// metastability signature) HALVES the bound so each stall costs less;
+// a quiescent window (<= kRecheckRelax) DOUBLES it back toward idle
+// cheapness. All five constants are pinned cross-language against
+// analysis/protocol.py (ATOMIC-ORDER _check_recheck); the model
+// checker's timeout transition covers any bound in the range (it only
+// needs the recheck to stay FINITE — kRecheckMinMs > 0).
+constexpr int kRecheckMinMs = 5;
+constexpr int kRecheckMaxMs = 100;
+constexpr int kRecheckWindow = 32;
+constexpr int kRecheckTighten = 16;
+constexpr int kRecheckRelax = 4;
 constexpr double kEmptySpinS = 100e-6;  // rate-matched pairs stay syscall-free
+
+// Per-connection adaptive recheck state (single-threaded like the
+// transport that owns it). record(true) = a wait ended by the bounded
+// poll timeout instead of a doorbell byte.
+class AdaptiveRecheck {
+ public:
+  int bound_ms() const { return bound_ms_; }
+
+  void record(bool recheck) {
+    ++waits_;
+    if (recheck) ++rechecks_;
+    if (waits_ < kRecheckWindow) return;
+    if (rechecks_ >= kRecheckTighten) {
+      bound_ms_ = bound_ms_ / 2 < kRecheckMinMs ? kRecheckMinMs
+                                                : bound_ms_ / 2;
+    } else if (rechecks_ <= kRecheckRelax) {
+      bound_ms_ = bound_ms_ * 2 > kRecheckMaxMs ? kRecheckMaxMs
+                                                : bound_ms_ * 2;
+    }
+    waits_ = rechecks_ = 0;
+  }
+
+ private:
+  int bound_ms_ = kWakeRecheckMs;
+  int waits_ = 0;
+  int rechecks_ = 0;
+};
 
 inline uint32_t load_u32le(const uint8_t* p) {
   uint32_t x = 0;
@@ -285,6 +328,47 @@ class ShmRing {
     word(kRingTailWord)->store(tail + advance, std::memory_order_release);
   }
 
+  // -- chaos hook -------------------------------------------------------
+  // Stomp the frame queued at tail — poke parity with the Python
+  // ShmRing.poke path in resilience/chaos._corrupt_ring, byte for byte:
+  // header mode writes an impossible length (0xDEADBEEF) the reader's
+  // next read_frame deterministically rejects as WireError; payload
+  // mode flips <= 4 bytes clamped to the payload AND the data region.
+  // Returns 1 when the stomp observably landed (tail stable: the frame
+  // was not consumed mid-stomp), 0 when the ring is momentarily empty /
+  // the frame is a marker / the reader raced us — the injector retries.
+  // Never called on a healthy path.
+  int corrupt_tail_frame(bool header) {
+    uint64_t tail = word(kRingTailWord)->load(std::memory_order_acquire);
+    uint64_t head = word(kRingHeadWord)->load(std::memory_order_acquire);
+    if (head - tail < 8) return 0;  // need a real frame, not just a marker
+    size_t pos = tail % capacity_;
+    if (capacity_ - pos < 4) pos = 0;  // implicit wrap: frame starts at base
+    if (header) {
+      // Not WRAP/INLINE, way past any sane length. (Stomping a WRAP
+      // marker is equally observable: the reader decodes the bogus
+      // length and rejects it.)
+      uint32_t poison = 0xDEADBEEF;
+      std::memcpy(data() + pos, &poison, 4);
+    } else {
+      uint32_t length = load_u32le(data() + pos);
+      if (length >= kRingInlineMarker) return 0;  // marker: no payload here
+      size_t n = 4;
+      if (static_cast<size_t>(length) < n) n = length;
+      if (capacity_ - pos - 4 < n) n = capacity_ - pos - 4;
+      if (n == 0) return 0;
+      static const uint8_t pat[4] = {0xa5, 0x5a, 0xa5, 0x5a};
+      std::memcpy(data() + pos + 4, pat, n);
+    }
+    // If the reader consumed the frame while we were stomping, the
+    // bytes landed in free space the producer will overwrite — the
+    // fault did NOT observably fire; report failure so the caller
+    // retries (same tail-stability contract as the Python injector).
+    return word(kRingTailWord)->load(std::memory_order_seq_cst) == tail
+               ? 1
+               : 0;
+  }
+
   // -- teardown --------------------------------------------------------
   // Best-effort unlink regardless of ownership — the crash sweep for a
   // dead owner (mirrors ShmRing.unlink in transport.py; existing
@@ -420,6 +504,17 @@ class ShmTransport : public Transport {
     recv_ring_.unlink();
   }
 
+  // Chaos hooks (csrc/chaos.h): sever the doorbell — peer-death
+  // semantics for both sides (a blocked reader's poll wakes to EOF, a
+  // blocked writer's peer probe fails) — and the ring-poke injector.
+  void shutdown_stream() override {
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+  }
+
+  int corrupt_recv_ring(bool header) override {
+    return recv_ring_.corrupt_tail_frame(header);
+  }
+
   void close() override {
     if (fd_ >= 0) {
       ::close(fd_);
@@ -491,11 +586,14 @@ class ShmTransport : public Transport {
       ring_wait_counters().doorbell_waits.fetch_add(
           1, std::memory_order_relaxed);
       struct pollfd p {fd_, POLLIN, 0};
-      int pr = ::poll(&p, 1, kWakeRecheckMs);
+      // Adaptive bound (ISSUE 12): recheck-heavy windows tighten it,
+      // quiescent ones relax it — see AdaptiveRecheck above.
+      int pr = ::poll(&p, 1, recheck_.bound_ms());
       if (pr == 0) {
         recv_ring_.set_waiting(false);
         ring_wait_counters().recheck_wakeups.fetch_add(
             1, std::memory_order_relaxed);
+        recheck_.record(true);
         continue;  // re-check the ring (lost-wakeup guard)
       }
       if (pr < 0) {
@@ -506,6 +604,7 @@ class ShmTransport : public Transport {
       uint8_t b = 0;
       ssize_t r = ::recv(fd_, &b, 1, 0);
       recv_ring_.set_waiting(false);
+      if (r > 0) recheck_.record(false);  // a byte ended this wait
       if (r == 0) {
         // Peer closed. Frames already in the ring stay deliverable;
         // EOF surfaces once it drains.
@@ -580,6 +679,7 @@ class ShmTransport : public Transport {
   size_t max_frame_bytes_;
   size_t pending_release_ = 0;
   bool inline_consumed_ = false;
+  AdaptiveRecheck recheck_;
 };
 
 // -- handshake (both roles) -------------------------------------------

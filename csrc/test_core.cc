@@ -463,6 +463,41 @@ static void test_shm_ring_roundtrip() {
   std::printf("shm ring roundtrip ok\n");
 }
 
+// Adaptive recheck policy (ISSUE 12): a recheck-heavy window tightens
+// the bound toward the floor, quiet windows relax it to the cap, and a
+// mixed window inside the hysteresis band holds it.
+static void test_shm_ring_adaptive_recheck() {
+  shm::AdaptiveRecheck policy;
+  CHECK(policy.bound_ms() == shm::kWakeRecheckMs);
+  for (int i = 0; i < shm::kRecheckWindow; ++i) policy.record(true);
+  CHECK(policy.bound_ms() == shm::kWakeRecheckMs / 2);
+  for (int i = 0; i < 8 * shm::kRecheckWindow; ++i) policy.record(true);
+  CHECK(policy.bound_ms() == shm::kRecheckMinMs);
+  for (int i = 0; i < 12 * shm::kRecheckWindow; ++i) policy.record(false);
+  CHECK(policy.bound_ms() == shm::kRecheckMaxMs);
+  shm::AdaptiveRecheck held;
+  for (int i = 0; i < shm::kRecheckWindow; ++i)
+    held.record(i < shm::kRecheckTighten - 1);
+  CHECK(held.bound_ms() == shm::kWakeRecheckMs);
+  std::printf("shm ring adaptive recheck ok\n");
+}
+
+// Chaos ring-poke hook (ISSUE 12): header corruption observably lands
+// in the queued frame (tail-stability contract) and the reader's next
+// read_frame deterministically rejects it; an empty ring reports retry.
+static void test_shm_ring_corrupt() {
+  shm::ShmRing ring = shm::ShmRing::create(256);
+  shm::ShmRing peer = shm::ShmRing::attach(ring.name());
+  CHECK(peer.corrupt_tail_frame(/*header=*/true) == 0);  // empty: retry
+  std::vector<uint8_t> payload(24, 0x42);
+  ring.write_frame(payload.data(), payload.size(), nullptr);
+  CHECK(peer.corrupt_tail_frame(/*header=*/true) == 1);
+  CHECK_THROWS(peer.read_frame(), wire::WireError);
+  peer.close();
+  ring.close();
+  std::printf("shm ring corrupt ok\n");
+}
+
 static wire::ValueNest step_like_message(int64_t tag, int64_t frame_cells) {
   wire::ValueNest::Dict d;
   d.emplace("type", wire::ValueNest(wire::Value::of_string("step")));
@@ -644,6 +679,8 @@ int main(int argc, char** argv) {
   if (want("dynamic_batcher")) { test_dynamic_batcher(); ++ran; }
   if (want("batcher_telemetry")) { test_batcher_telemetry(); ++ran; }
   if (want("shm_ring_roundtrip")) { test_shm_ring_roundtrip(); ++ran; }
+  if (want("shm_ring_adaptive_recheck")) { test_shm_ring_adaptive_recheck(); ++ran; }
+  if (want("shm_ring_corrupt")) { test_shm_ring_corrupt(); ++ran; }
   if (want("shm_ring_transport")) { test_shm_ring_transport(); ++ran; }
   if (want("shm_ring_stress")) { test_shm_ring_stress(); ++ran; }
   if (want("env_server")) { test_env_server(); ++ran; }

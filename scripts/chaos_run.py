@@ -51,6 +51,48 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--selftest", action="store_true",
                    help="Short structural run on Mock (the CI gate).")
+    p.add_argument("--native", action="store_true",
+                   help="Run both legs with --native_runtime (the C++ "
+                        "pool; needs the _tbt_core extension, "
+                        "scripts/build_native.sh). Transport faults "
+                        "then ride the pool's C++ FaultHooks instead "
+                        "of the Python FaultingTransport wrap — the "
+                        "same plan, the same exact accounting "
+                        "(ISSUE 12).")
+    # Resilience knobs forwarded to BOTH legs: re-declared here (same
+    # type/default as polybeast) so beastlint FLAG-PARITY keeps the
+    # chaos harness from drifting away from the driver's resilience
+    # surface.
+    p.add_argument("--min_live_actors", type=int, default=1,
+                   help="Graceful degradation floor: the run "
+                        "continues DEGRADED while at least this "
+                        "many actor loops are alive, and "
+                        "checkpoints-then-exits cleanly (health "
+                        "HALTED) below it — instead of hanging on "
+                        "a starved learner queue.")
+    p.add_argument("--inference_restart_budget", type=int, default=3,
+                   help="How many times the inference supervisor "
+                        "may rebuild a poisoned DeviceStateTable "
+                        "and restart the serving threads before "
+                        "the pipeline goes HALTED "
+                        "(checkpoint-and-exit).")
+    p.add_argument("--max_actor_reconnects", type=int, default=3,
+                   help="Elastic actors: reconnect (with jittered "
+                        "exponential backoff) up to N times per "
+                        "actor on env-server transport failure or "
+                        "a failed inference batch; the budget "
+                        "refills after a full recovered unroll. "
+                        "Nonzero by default — a single env-server "
+                        "blip must not permanently retire an actor "
+                        "(with external unsupervised servers the "
+                        "backoff bounds what a truly dead address "
+                        "costs). 0 = fail fast, like the "
+                        "reference. App-level env errors are never "
+                        "absorbed either way.")
+    # beastlint: disable=FLAG-PARITY  a wedged chaos run should fail THIS harness in a minute, not after the driver's 5-minute stall deadline
+    p.add_argument("--learner_stall_timeout_s", type=float, default=60.0,
+                   help="Learner stall watchdog deadline forwarded to "
+                        "both legs (shortened vs the driver default).")
     # beastlint: disable=FLAG-PARITY  Catch solves in minutes on CPU; the chaos harness needs a LEARNABLE short run, not Pong
     p.add_argument("--env", default="Catch")
     # beastlint: disable=FLAG-PARITY  two full runs per invocation: 60k steps keeps the acceptance pass under a CI budget
@@ -123,10 +165,13 @@ def make_flags(args, savedir, xpid, chaos_plan_path=None):
         "--num_inference_threads", "1",
         "--max_inference_batch_size", "4",
         "--checkpoint_interval_s", "100000",
-        # A wedged chaos run should fail THIS harness quickly, not
-        # after the default 5-minute stall deadline.
-        "--learner_stall_timeout_s", "60",
+        "--min_live_actors", str(args.min_live_actors),
+        "--inference_restart_budget", str(args.inference_restart_budget),
+        "--max_actor_reconnects", str(args.max_actor_reconnects),
+        "--learner_stall_timeout_s", str(args.learner_stall_timeout_s),
     ]
+    if getattr(args, "native", False):
+        argv += ["--native_runtime"]
     if chaos_plan_path is not None:
         argv += ["--chaos_plan", chaos_plan_path]
     return polybeast.make_parser().parse_args(argv)
@@ -199,6 +244,17 @@ def main(argv=None) -> int:
         args.num_servers = args.num_actors = 2
         args.batch_size = 2
         args.return_tol = 1e-6
+
+    if args.native:
+        from torchbeast_tpu.runtime.native import available
+
+        if not available():
+            print(
+                "chaos_run --native needs the _tbt_core extension "
+                "(bash scripts/build_native.sh)",
+                file=sys.stderr,
+            )
+            return 2
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -301,6 +357,7 @@ def main(argv=None) -> int:
     verdict = {
         "bench": "chaos_run",
         "selftest": bool(args.selftest),
+        "native": bool(args.native),
         "ok": not failures,
         "failures": failures,
         "env": args.env,

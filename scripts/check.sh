@@ -31,7 +31,16 @@ if [[ "$FAST" -eq 0 ]]; then
 fi
 
 echo "== check: beastlint --ci (repo-wide, C++ frontend active)"
+ci_start=$(date +%s)
 python -m torchbeast_tpu.analysis --ci
+ci_elapsed=$(( $(date +%s) - ci_start ))
+# The CI-budget pin (ISSUE 10, re-anchored ISSUE 12): the full
+# static-analysis lane must stay under 20s or it stops being a
+# pre-commit-speed gate.
+if [[ "$ci_elapsed" -gt 20 ]]; then
+    echo "beastlint --ci took ${ci_elapsed}s (> 20s CI budget)" >&2
+    exit 1
+fi
 
 echo "== check: beastlint --selftest (rule fixtures)"
 python -m torchbeast_tpu.analysis --selftest

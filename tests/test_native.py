@@ -629,7 +629,7 @@ def _start_counting_server_shm(path):
     return server
 
 
-def _run_native_pool(address, max_reconnects=0):
+def _run_native_pool(address, max_reconnects=0, **pool_kwargs):
     learner_queue = core.BatchingQueue(
         batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
     )
@@ -663,6 +663,7 @@ def _run_native_pool(address, max_reconnects=0):
         env_server_addresses=[address],
         initial_agent_state=np.zeros((1, 1), np.int64),
         max_reconnects=max_reconnects,
+        **pool_kwargs,
     )
     pool_thread = threading.Thread(target=pool.run, daemon=True)
     pool_thread.start()
@@ -840,3 +841,467 @@ def test_native_telemetry_fold():
     assert registry.counter("ring.recheck_wakeups").value() == 2
     queue.close()
     batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Adaptive doorbell recheck (ISSUE 12): the C++ policy pinned through the
+# sim binding, and pinned BEHAVIORALLY against the Python policy (beastlint
+# ATOMIC-ORDER pins the constants; this pins the walk).
+
+
+def test_adaptive_recheck_cpp_tighten_and_relax():
+    """A forced recheck-heavy window tightens the bound toward the
+    floor; quiet windows relax it back to the cap; a mixed window
+    inside the hysteresis band holds it."""
+    from torchbeast_tpu.runtime import transport as transport_lib
+
+    w = transport_lib._RECHECK_WINDOW
+    init = int(transport_lib._WAKE_RECHECK_S * 1000)
+    # Every wait ends on the timeout: halve per window down to the floor.
+    bounds = core.adaptive_recheck_sim([True] * (4 * w))
+    assert bounds[w - 1] == init // 2
+    assert bounds[-1] == transport_lib._RECHECK_MIN_MS
+    # Quiescent windows double back up to the cap.
+    bounds = core.adaptive_recheck_sim([True] * (2 * w) + [False] * (8 * w))
+    assert bounds[2 * w - 1] == transport_lib._RECHECK_MIN_MS
+    assert bounds[-1] == transport_lib._RECHECK_MAX_MS
+    # Inside the hysteresis band (between relax and tighten): hold.
+    mixed = [True] * (transport_lib._RECHECK_TIGHTEN - 1)
+    mixed += [False] * (w - len(mixed))
+    assert core.adaptive_recheck_sim(mixed)[-1] == init
+
+
+def test_adaptive_recheck_matches_python_policy():
+    """Both languages walk IDENTICALLY on the same outcome sequence."""
+    from torchbeast_tpu.runtime.transport import AdaptiveRecheck
+
+    rng = np.random.default_rng(3)
+    outcomes = [bool(b) for b in rng.integers(0, 2, 512)]
+    policy = AdaptiveRecheck()
+    py_bounds = []
+    for outcome in outcomes:
+        policy.record(outcome)
+        py_bounds.append(policy.bound_ms)
+    assert core.adaptive_recheck_sim(outcomes) == py_bounds
+
+
+# ---------------------------------------------------------------------------
+# Reconnect accounting (ISSUE 12 satellite): reconnect_count() reports
+# COMPLETED recoveries, not granted retry attempts — one fault needing
+# several dials counts once, on BOTH pools.
+
+
+def _flaky_step_message(i):
+    return {
+        "type": "step",
+        "frame": np.asarray([i % 250], np.uint8),
+        "reward": np.asarray(0.0, np.float32),
+        "done": np.asarray(False),
+        "episode_step": np.asarray(i, np.int32),
+        "episode_return": np.asarray(0.0, np.float32),
+        "last_action": np.asarray(0, np.int32),
+    }
+
+
+class _FlakyServer:
+    """Unix-socket env stream that (1) serves `serve_steps` steps then
+    cuts the stream (the FAULT), (2) closes the next `fail_next`
+    accepted connections BEFORE the initial step (failed recovery
+    attempts), then (3) serves indefinitely (the completed recovery)."""
+
+    def __init__(self, path, serve_steps=12, fail_next=2):
+        import socket
+
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(8)
+        self._serve_steps = serve_steps
+        self._fail_next = fail_next
+        self._phase = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed: test teardown
+            try:
+                if self._phase == 0:
+                    self._phase = 1
+                    self._serve(conn, self._serve_steps)
+                elif self._phase == 1 and self._fail_next > 0:
+                    self._fail_next -= 1
+                else:
+                    self._phase = 2
+                    self._serve(conn, None)
+            except Exception:
+                pass  # actor-side teardown cut the stream: expected
+            finally:
+                conn.close()
+
+    def _serve(self, conn, limit):
+        from torchbeast_tpu.runtime import wire
+
+        i = 0
+        wire.send_message(conn, _flaky_step_message(i))
+        while limit is None or i < limit:
+            if wire.recv_message(conn) is None:
+                return
+            i += 1
+            wire.send_message(conn, _flaky_step_message(i))
+
+    def close(self):
+        self._sock.close()
+
+
+def _run_python_pool(address, max_reconnects=0):
+    from torchbeast_tpu.runtime.actor_pool import ActorPool
+    from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
+
+    learner_queue = BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = DynamicBatcher(batch_dim=1, timeout_ms=20)
+
+    def inference():
+        it = iter(batcher)
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            inputs = batch.get_inputs()
+            done = inputs["env"]["done"]
+            state = np.where(done, 0, inputs["agent_state"]) + 1
+            batch.set_outputs({
+                "outputs": {
+                    "action": np.zeros_like(done, np.int32),
+                    "policy_logits": state[..., None].astype(np.float32),
+                    "baseline": state.astype(np.float32),
+                },
+                "agent_state": state.astype(np.int64),
+            })
+
+    threading.Thread(target=inference, daemon=True).start()
+    pool = ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+        max_reconnects=max_reconnects,
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+    return learner_queue, batcher, pool, pool_thread
+
+
+@pytest.mark.parametrize("kind", ["native", "python"])
+def test_reconnect_counts_completed_recoveries(kind):
+    """One stream cut + two failed recovery dials + one successful one
+    is ONE fault and ONE recovery: reconnect_count() == 1 on both
+    pools (grant-counting would report 3, breaking chaos_run's
+    reconnects == injections equality on a flaky re-dial)."""
+    path = os.path.join(tempfile.mkdtemp(), f"flaky_{kind}")
+    server = _FlakyServer(path, serve_steps=4 * T, fail_next=2)
+    runner = _run_native_pool if kind == "native" else _run_python_pool
+    learner_queue, batcher, pool, pool_thread = runner(
+        f"unix:{path}", max_reconnects=3
+    )
+    try:
+        items = 0
+        it = iter(learner_queue)
+        # 4 rollouts stream before the cut; needing 7 forces the pool
+        # through the flaky recovery (2 dead dials, then success).
+        while items < 7:
+            next(it)
+            items += 1
+        assert pool.reconnect_count() == 1
+        assert list(pool.errors) == []
+        if kind == "native":
+            assert pool.telemetry()["reconnects"] == 1
+    finally:
+        batcher.close()
+        learner_queue.close()
+        pool_thread.join(10)
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Native chaos hooks (ISSUE 12 tentpole b): the C++ FaultHooks entry
+# points drive the same fault classes the Python FaultingTransport wrap
+# does, with the same injected-exact contract.
+
+
+def test_native_chaos_sever_forces_one_recovery():
+    from torchbeast_tpu.envs import CountingEnv
+    from torchbeast_tpu.runtime.env_server import EnvServer
+
+    path = os.path.join(tempfile.mkdtemp(), "chaos_sever")
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), f"unix:{path}"
+    )
+    server.start()
+    deadline = time.monotonic() + 10
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError("server did not bind")
+        time.sleep(0.01)
+    learner_queue, batcher, pool, pool_thread = _run_native_pool(
+        f"unix:{path}", max_reconnects=3, fault_hooks=True
+    )
+    try:
+        it = iter(learner_queue)
+        next(it)  # the stream is live
+        assert pool.chaos_sever(0) is True
+        for _ in range(3):  # the pool recovers and keeps streaming
+            next(it)
+        assert pool.reconnect_count() == 1
+        assert list(pool.errors) == []
+        # A delay window on the live stream arms; bogus kinds are loud.
+        assert pool.chaos_window(0, "transport_delay", 0.2, 0.001) is True
+        with pytest.raises(ValueError):
+            pool.chaos_window(0, "transport_teleport")
+        # Ring corruption needs an shm transport: False here (retry),
+        # exactly like the Python injector's None-ring path.
+        assert pool.chaos_corrupt_ring(0, header=True) is False
+    finally:
+        batcher.close()
+        learner_queue.close()
+        pool_thread.join(10)
+        server.stop()
+
+
+def test_native_chaos_requires_armed_pool():
+    """chaos_* on a pool built without fault_hooks=True fails loudly —
+    a miswired driver must not silently abandon every fault."""
+    queue = core.BatchingQueue(batch_dim=1, minimum_batch_size=1)
+    batcher = core.DynamicBatcher(batch_dim=1)
+    pool = core.ActorPool(
+        unroll_length=T,
+        learner_queue=queue,
+        inference_batcher=batcher,
+        env_server_addresses=[],
+        initial_agent_state={},
+    )
+    with pytest.raises(ValueError, match="fault_hooks"):
+        pool.chaos_sever(0)
+    # And an armed pool with no live transport reports "retry".
+    armed = core.ActorPool(
+        unroll_length=T,
+        learner_queue=queue,
+        inference_batcher=batcher,
+        env_server_addresses=[],
+        initial_agent_state={},
+        fault_hooks=True,
+    )
+    assert armed.chaos_sever(0) is False
+    assert armed.chaos_window(0, "transport_blackhole", 0.1) is False
+    assert armed.chaos_corrupt_ring(0) is False
+    queue.close()
+    batcher.close()
+
+
+def test_native_chaos_corrupt_shm_ring_lands():
+    """shm ring corruption through the hooks: the stomp observably
+    lands (tail-stability contract) and the stream survives — either
+    via the WireError -> reconnect path or, in the documented narrow
+    window, a reader that already latched the clean header (corruption
+    is injected-exact, recovery-probable)."""
+    path = os.path.join(tempfile.mkdtemp(), "chaos_ring")
+    server = _start_counting_server_shm(path)
+    learner_queue, batcher, pool, pool_thread = _run_native_pool(
+        f"shm:{path}", max_reconnects=3, fault_hooks=True
+    )
+    try:
+        it = iter(learner_queue)
+        next(it)
+        injected = False
+        deadline = time.monotonic() + 10
+        while not injected and time.monotonic() < deadline:
+            injected = pool.chaos_corrupt_ring(0, header=True)
+            if not injected:
+                time.sleep(0.0005)  # ring momentarily empty: retry
+        assert injected
+        for _ in range(3):  # still streaming (reconnected or unharmed)
+            next(it)
+        assert list(pool.errors) == []
+        assert pool.reconnect_count() in (0, 1)
+    finally:
+        batcher.close()
+        learner_queue.close()
+        pool_thread.join(10)
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Native graceful degradation, driver-level (ISSUE 12 tentpole a): the
+# polybeast HEALTHY/DEGRADED/HALTED machine drives the C++ pool exactly
+# like the Python one.
+
+
+def _poly_flags(tmp_path, **overrides):
+    from torchbeast_tpu import polybeast
+
+    argv = [
+        "--env", "Mock",
+        "--num_servers", "2",
+        "--batch_size", "2",
+        "--unroll_length", "5",
+        "--total_steps", "2000",
+        "--savedir", str(tmp_path),
+        "--xpid", "native-degrade",
+        "--model", "mlp",
+        "--pipes_basename", f"unix:{tmp_path}/pipes",
+        "--num_inference_threads", "1",
+        "--max_inference_batch_size", "4",
+        "--checkpoint_interval_s", "100000",
+        "--native_runtime",
+    ]
+    for k, v in overrides.items():
+        argv += [f"--{k}"] if v is True else [f"--{k}", str(v)]
+    return polybeast.make_parser().parse_args(argv)
+
+
+@pytest.mark.slow
+def test_native_sigkill_above_floor_recovers(tmp_path):
+    """A supervised env-server SIGKILL (via a native chaos plan) while
+    live actors stay at/above the floor: the server respawns, the
+    actor reconnects, the run completes every step, and the recovery
+    counters record EXACTLY one respawn + one completed reconnect."""
+    import json as json_lib
+
+    from torchbeast_tpu import polybeast
+
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json_lib.dumps({
+        "seed": 7,
+        "faults": [
+            {"kind": "env_server_sigkill", "at_step": 400, "target": 0}
+        ],
+    }))
+    flags = _poly_flags(
+        tmp_path, xpid="native-above-floor", total_steps="3000",
+        min_live_actors="1", chaos_plan=str(plan_path),
+    )
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 3000
+    assert stats["health"] in ("HEALTHY", "DEGRADED")
+    assert stats["chaos"]["injected"] == {"env_server_sigkill": 1}
+    assert stats["server_restarts"] == 1
+    assert stats["actor_reconnects"] == 1
+
+
+@pytest.mark.slow
+def test_native_attrition_degrades_above_floor(tmp_path):
+    """Kill one of two servers PERMANENTLY (respawn disabled): its
+    actor burns the reconnect budget and retires, the run goes (and
+    stays — attrition is sticky) DEGRADED, and still completes on the
+    surviving actor because live >= --min_live_actors."""
+    import json as json_lib
+
+    from torchbeast_tpu import polybeast
+
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json_lib.dumps({
+        "seed": 7,
+        "faults": [
+            {"kind": "env_server_sigkill", "at_step": 300, "target": 0}
+        ],
+    }))
+    flags = _poly_flags(
+        tmp_path, xpid="native-degraded", total_steps="4000",
+        min_live_actors="1", max_server_restarts="0",
+        max_actor_reconnects="1", actor_connect_timeout_s="2",
+        chaos_plan=str(plan_path),
+    )
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 4000
+    assert stats["health"] == "DEGRADED"
+    assert any(
+        "retired" in reason for _, reason in stats["health_reasons"]
+    )
+
+
+@pytest.mark.slow
+def test_native_floor_crossing_halts_cleanly(tmp_path):
+    """Kill BOTH servers permanently: both actors retire, live crosses
+    the --min_live_actors floor, and the run checkpoints and exits
+    CLEANLY with health HALTED (no exception, total_steps unreachable)
+    — the native half of the PR 6 floor contract."""
+    import json as json_lib
+
+    from torchbeast_tpu import polybeast
+
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json_lib.dumps({
+        "seed": 7,
+        "faults": [
+            {"kind": "env_server_sigkill", "at_step": 300, "target": 0},
+            {"kind": "env_server_sigkill", "at_step": 300, "target": 1},
+        ],
+    }))
+    flags = _poly_flags(
+        tmp_path, xpid="native-halted", total_steps="100000000",
+        min_live_actors="1", max_server_restarts="0",
+        max_actor_reconnects="1", actor_connect_timeout_s="2",
+        chaos_plan=str(plan_path),
+    )
+    stats = polybeast.train(flags)  # returns instead of raising/hanging
+    assert stats["health"] == "HALTED"
+    assert any(
+        "below --min_live_actors" in reason
+        for _, reason in stats["health_reasons"]
+    )
+    assert (tmp_path / "native-halted" / "model.ckpt").exists()
+
+
+# ---------------------------------------------------------------------------
+# Native request spans (ISSUE 12 tentpole c): sampled C++ stage stamps
+# fold into the tracer as the same actor.request.* spans the Python pool
+# emits.
+
+
+def test_native_trace_spans_fold():
+    from torchbeast_tpu.runtime.native import NativeTelemetryFolder
+    from torchbeast_tpu.telemetry.metrics import MetricsRegistry
+    from torchbeast_tpu.telemetry.trace import Tracer
+
+    batcher = core.DynamicBatcher(batch_dim=0, timeout_ms=5)
+
+    def serve():
+        it = iter(batcher)
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            batch.set_outputs(batch.get_inputs())
+
+    serve_thread = threading.Thread(target=serve, daemon=True)
+    serve_thread.start()
+    # 1-in-256 sampling: 512 computes guarantee >= 2 recorded spans.
+    for _ in range(512):
+        batcher.compute(np.zeros((1, 1), np.float32))
+
+    tracer = Tracer()
+    folder = NativeTelemetryFolder(
+        MetricsRegistry(), batcher=batcher, tracer=tracer
+    )
+    folder.tick()
+    events = [e for e in tracer.events() if e["cat"] == "actor.request"]
+    names = {e["name"] for e in events}
+    assert {"actor.request",
+            "actor.request.batch",
+            "actor.request.reply"} <= names
+    assert len([e for e in events if e["name"] == "actor.request"]) >= 2
+    for e in events:
+        assert e["dur"] >= 0
+    # Drained: a second tick folds nothing new.
+    before = len(tracer.events())
+    folder.tick()
+    assert len(tracer.events()) == before
+    batcher.close()
+    serve_thread.join(5)

@@ -605,11 +605,14 @@ class TestActorPoolBackoff:
         )
         with pytest.raises(TimeoutError):
             pool.run()
-        # 1 initial + 2 budgeted reconnects, each retried through ONE
-        # backoff step; afterwards the actor retires.
+        # 1 initial + 2 budgeted retries, each gated through ONE
+        # backoff step; afterwards the actor retires. None of the
+        # retries ever re-established a stream, so the reconnect count
+        # stays 0 (completed recoveries, not granted attempts —
+        # ISSUE 12 contract, shared with the native pool).
         assert len(calls) == 2
         assert all(0.01 <= d <= 0.02 for d in calls)
-        assert pool.reconnects == 2
+        assert pool.reconnects == 0
         assert pool.live_actors() == 0
         assert len(pool.errors) == 1
 
@@ -640,7 +643,8 @@ class TestActorPoolBackoff:
             backoff_factory=lambda: _RecordingBackoff([]),
         )
 
-        def fake_loop(index, address, progress=None):
+        def fake_loop(index, address, progress=None,
+                      reconnect_pending=None):
             calls.append(1)
             if len(calls) < 3:
                 raise StateTablePoisonedError("mid-rebuild window")
@@ -747,3 +751,112 @@ def test_poly_budget_exhaustion_checkpoints_and_exits(tmp_path):
         p.pid for p in mp.active_children() if p.is_alive()
     } - before
     assert leftover == set()
+
+
+# ---------------------------------------------------------------------------
+# Native chaos routing (ISSUE 12): with a native pool attached, the
+# controller's transport-fault injectors drive the pool's C++ FaultHooks
+# entry points instead of the Python FaultingTransport wrap. A fake pool
+# keeps this covered without the extension; the real C++ surface is
+# exercised in tests/test_native.py and chaos_run --native.
+
+
+class _FakeNativePool:
+    def __init__(self, live=True):
+        self.live = live
+        self.calls = []
+
+    def chaos_sever(self, actor):
+        self.calls.append(("sever", actor))
+        return self.live
+
+    def chaos_window(self, actor, kind, duration_s, delay_s):
+        self.calls.append(("window", actor, kind, duration_s, delay_s))
+        return self.live
+
+    def chaos_corrupt_ring(self, actor, header):
+        self.calls.append(("corrupt", actor, header))
+        return self.live
+
+
+class TestNativeChaosRouting:
+    def _controller(self, pool):
+        from torchbeast_tpu.resilience.chaos import (
+            ChaosController,
+            FaultPlan,
+            FaultSpec,
+        )
+        from torchbeast_tpu.telemetry.metrics import MetricsRegistry
+
+        plan = FaultPlan([FaultSpec("transport_sever", at_step=1)])
+        controller = ChaosController(plan, registry=MetricsRegistry())
+        controller.attach_native_pool(pool)
+        return controller
+
+    def test_transport_faults_route_to_the_pool(self):
+        from torchbeast_tpu.resilience.chaos import FaultSpec
+
+        pool = _FakeNativePool()
+        controller = self._controller(pool)
+        assert controller._inject(
+            FaultSpec("transport_sever", at_step=1, target=2)
+        )
+        assert controller._inject(FaultSpec(
+            "transport_delay", at_step=1, target=1,
+            duration_s=0.5, delay_s=0.01,
+        ))
+        assert controller._inject(
+            FaultSpec("shm_corrupt_header", at_step=1, target=0)
+        )
+        assert controller._inject(
+            FaultSpec("shm_corrupt_payload", at_step=1, target=3)
+        )
+        assert pool.calls == [
+            ("sever", 2),
+            ("window", 1, "transport_delay", 0.5, 0.01),
+            ("corrupt", 0, True),
+            ("corrupt", 3, False),
+        ]
+
+    def test_uninjectable_reports_retry(self):
+        """False from the pool (actor between connections) keeps the
+        fault pending — the injected-exact retry contract."""
+        from torchbeast_tpu.resilience.chaos import FaultSpec
+
+        pool = _FakeNativePool(live=False)
+        controller = self._controller(pool)
+        assert not controller._inject(
+            FaultSpec("transport_sever", at_step=1)
+        )
+        assert not controller._inject(
+            FaultSpec("shm_corrupt_header", at_step=1)
+        )
+
+
+def test_resilience_flag_parity_poly_chaos_run():
+    """The resilience flags chaos_run re-declares must track polybeast's
+    type/default exactly (beastlint FLAG-PARITY enforces this statically;
+    this exercises the live parsers). --learner_stall_timeout_s is the
+    one documented intentional divergence."""
+    import importlib.util
+    import os as os_lib
+
+    from torchbeast_tpu import polybeast
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_run",
+        os_lib.path.join(
+            os_lib.path.dirname(os_lib.path.dirname(
+                os_lib.path.abspath(__file__)
+            )),
+            "scripts", "chaos_run.py",
+        ),
+    )
+    chaos_run = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_run)
+    poly = polybeast.make_parser()
+    harness = chaos_run.parse_args([])
+    for flag in ("min_live_actors", "inference_restart_budget",
+                 "max_actor_reconnects"):
+        assert getattr(harness, flag) == poly.get_default(flag), flag
+    assert harness.learner_stall_timeout_s == 60.0  # documented divergence

@@ -740,3 +740,60 @@ def test_shm_handshake_garbage_raises():
         transport.connect_transport(f"shm:{path}", timeout_s=5)
     t.join()
     sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Adaptive doorbell recheck (ISSUE 12): the Python policy unit — the
+# cross-language behavioral pin lives in tests/test_native.py (needs the
+# extension); the constants are pinned by beastlint ATOMIC-ORDER.
+
+
+class TestAdaptiveRecheck:
+    def test_tighten_relax_and_bounds(self):
+        from torchbeast_tpu.runtime import transport as transport_lib
+
+        policy = transport_lib.AdaptiveRecheck()
+        init = policy.bound_ms
+        assert init == int(transport_lib._WAKE_RECHECK_S * 1000)
+        # A forced recheck-heavy window HALVES the bound...
+        for _ in range(transport_lib._RECHECK_WINDOW):
+            policy.record(True)
+        assert policy.bound_ms == init // 2
+        # ...down to (and never past) the floor.
+        for _ in range(8 * transport_lib._RECHECK_WINDOW):
+            policy.record(True)
+        assert policy.bound_ms == transport_lib._RECHECK_MIN_MS
+        # Quiet windows relax it back up to (and never past) the cap.
+        for _ in range(12 * transport_lib._RECHECK_WINDOW):
+            policy.record(False)
+        assert policy.bound_ms == transport_lib._RECHECK_MAX_MS
+        assert policy.timeout_s() == transport_lib._RECHECK_MAX_MS / 1000.0
+
+    def test_hysteresis_band_holds_the_bound(self):
+        from torchbeast_tpu.runtime import transport as transport_lib
+
+        policy = transport_lib.AdaptiveRecheck()
+        init = policy.bound_ms
+        # Between relax and tighten thresholds: neither direction moves.
+        rechecks = transport_lib._RECHECK_TIGHTEN - 1
+        for i in range(transport_lib._RECHECK_WINDOW):
+            policy.record(i < rechecks)
+        assert policy.bound_ms == init
+
+    def test_transport_owns_a_policy(self):
+        """Every ShmTransport carries per-connection adaptive state and
+        starts at the verified initial bound."""
+        from torchbeast_tpu.runtime import transport as transport_lib
+
+        server, client = transport_lib.shm_pipe()
+        try:
+            for end in (server, client):
+                assert isinstance(
+                    end._recheck, transport_lib.AdaptiveRecheck
+                )
+                assert end._recheck.timeout_s() == (
+                    transport_lib._WAKE_RECHECK_S
+                )
+        finally:
+            server.close()
+            client.close()

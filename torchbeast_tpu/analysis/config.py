@@ -142,10 +142,13 @@ THREAD_ROOT_FUNCTIONS = ("main", "train", "cli")
 # dequeues). pymodule.cc is the binding layer; actor_pool.h hosts the
 # slot hooks' call sites (its threads run GIL-free by design, so a
 # CPython call appearing there without an acquire is a bug by
-# construction).
+# construction); chaos.h hosts the FaultHooks entry points the Python
+# chaos thread drives through pymodule (ISSUE 12) — same contract: any
+# CPython call landing there without an acquire is a bug.
 GIL_FILES = (
     "csrc/pymodule.cc",
     "csrc/actor_pool.h",
+    "csrc/chaos.h",
 )
 
 # CXX-LOCK-DISCIPLINE / cross-root conflict scope: every C++ source the

@@ -676,39 +676,73 @@ class AtomicOrderRule:
             ))
         return findings
 
+    # The adaptive-recheck policy constants (ISSUE 12), pinned in both
+    # implementations against the spec values in analysis/protocol.py:
+    # (python module constant, C++ constexpr, protocol attribute).
+    _ADAPTIVE_PINS = (
+        ("_RECHECK_MIN_MS", "kRecheckMinMs", "RECHECK_MIN_MS"),
+        ("_RECHECK_MAX_MS", "kRecheckMaxMs", "RECHECK_MAX_MS"),
+        ("_RECHECK_WINDOW", "kRecheckWindow", "RECHECK_WINDOW"),
+        ("_RECHECK_TIGHTEN", "kRecheckTighten", "RECHECK_TIGHTEN"),
+        ("_RECHECK_RELAX", "kRecheckRelax", "RECHECK_RELAX"),
+    )
+
     def _check_recheck(self, transport_ctx, shm_ctx) -> List[Finding]:
         findings: List[Finding] = []
-        py_ms: Optional[float] = None
+        py_consts: Dict[str, float] = {}
         for node in ast.walk(transport_ctx.tree):
             if isinstance(node, ast.Assign) and len(node.targets) == 1:
                 target = node.targets[0]
-                if isinstance(target, ast.Name) and (
-                    target.id == "_WAKE_RECHECK_S"
-                ) and isinstance(node.value, ast.Constant):
-                    py_ms = float(node.value.value) * 1000.0
-        m = re.search(
-            r"constexpr\s+int\s+kWakeRecheckMs\s*=\s*(\d+)",
-            shm_ctx.source,
-        )
-        cpp_ms = float(m.group(1)) if m else None
-        for label, value, path in (
-            ("_WAKE_RECHECK_S", py_ms, transport_ctx.path),
-            ("kWakeRecheckMs", cpp_ms, shm_ctx.path),
-        ):
-            if value is None:
-                findings.append(Finding(
-                    self.name, path, 1,
-                    f"could not parse {label} — the bounded-recheck "
-                    "pin against the protocol spec is broken",
-                ))
-            elif abs(value - protocol.RECHECK_MS) > 1e-9:
-                findings.append(Finding(
-                    self.name, path, 1,
-                    f"{label} is {value:g} ms, the verified protocol "
-                    f"spec says {protocol.RECHECK_MS} ms "
-                    "(analysis/protocol.py RECHECK_MS) — change both "
-                    "together or re-verify",
-                ))
+                if isinstance(target, ast.Name) and isinstance(
+                    node.value, ast.Constant
+                ) and isinstance(node.value.value, (int, float)):
+                    py_consts[target.id] = float(node.value.value)
+        cpp_consts: Dict[str, float] = {
+            m.group(1): float(m.group(2))
+            for m in re.finditer(
+                r"constexpr\s+int\s+(k\w+)\s*=\s*(\d+)", shm_ctx.source
+            )
+        }
+        py_ms = py_consts.get("_WAKE_RECHECK_S")
+        if py_ms is not None:
+            py_ms *= 1000.0
+        pins = (
+            ("_WAKE_RECHECK_S", "kWakeRecheckMs", "RECHECK_MS"),
+        ) + self._ADAPTIVE_PINS
+        for py_name, cpp_name, spec_attr in pins:
+            spec_value = getattr(protocol, spec_attr)
+            py_value = (
+                py_ms if py_name == "_WAKE_RECHECK_S"
+                else py_consts.get(py_name)
+            )
+            for label, value, path in (
+                (py_name, py_value, transport_ctx.path),
+                (cpp_name, cpp_consts.get(cpp_name), shm_ctx.path),
+            ):
+                if value is None:
+                    findings.append(Finding(
+                        self.name, path, 1,
+                        f"could not parse {label} — the recheck-policy "
+                        "pin against the protocol spec is broken",
+                    ))
+                elif abs(value - spec_value) > 1e-9:
+                    findings.append(Finding(
+                        self.name, path, 1,
+                        f"{label} is {value:g}, the verified protocol "
+                        f"spec says {spec_value:g} "
+                        f"(analysis/protocol.py {spec_attr}) — change "
+                        "both together or re-verify",
+                    ))
+        # The adaptive walk must stay inside what the no-wedge proof
+        # covers (the timeout transition needs a finite positive bound).
+        if not protocol.adaptive_recheck_covered():
+            findings.append(Finding(
+                self.name, shm_ctx.path, 1,
+                "adaptive recheck range is not covered by the model "
+                "checker's timeout transition (protocol."
+                "adaptive_recheck_covered): the bound must stay finite "
+                "and positive",
+            ))
         return findings
 
 

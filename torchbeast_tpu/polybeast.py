@@ -251,6 +251,15 @@ def make_parser():
                              "C++ queue has the same raw-item intake).")
     parser.add_argument("--max_learner_queue_size", type=int, default=None,
                         help="Backpressure bound (default: batch_size).")
+    parser.add_argument("--actor_connect_timeout_s", type=float,
+                        default=600.0,
+                        help="Per-attempt actor connect deadline (the "
+                             "reference's 10-minute WaitForConnected "
+                             "semantics). Lower it when a permanently "
+                             "dead env-server address should burn the "
+                             "actor's reconnect budget in seconds, not "
+                             "hours — what drives the --min_live_actors "
+                             "floor promptly under real attrition.")
     parser.add_argument("--max_actor_reconnects", type=int, default=3,
                         help="Elastic actors: reconnect (with jittered "
                              "exponential backoff) up to N times per "
@@ -330,20 +339,6 @@ def train(flags):
         raise ValueError(
             f"--superstep_k must be >= 1, got {superstep_k}"
         )
-    if getattr(flags, "chaos_plan", None) and flags.native_runtime:
-        # The ONLY capability still gated off native (ISSUE 9 closed
-        # slot framing, shm, bf16, supersteps, telemetry): the chaos
-        # fault injectors interpose on the Python transport objects
-        # (FaultingTransport wrap via ActorPool's transport_wrap, shm
-        # ring poke through the Python ShmRing) — the C++ pool owns its
-        # connections in C++ threads, so there is nothing to wrap.
-        raise RuntimeError(
-            "--chaos_plan is not supported with --native_runtime: the "
-            "fault injectors wrap the Python transport objects, which "
-            "the C++ pool does not use; run chaos plans on the Python "
-            "runtime"
-        )
-
     # No-ops (with a log line) when no coordinator is configured by flag
     # or TORCHBEAST_COORDINATOR env.
     initialize_distributed(flags.coordinator_address)
@@ -980,8 +975,15 @@ def train(flags):
         pool_kwargs = {"max_frame_bytes": flags.max_frame_bytes}
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
-        if not flags.native_runtime and chaos is not None:
-            pool_kwargs["transport_wrap"] = chaos.wrap_transport
+        # Chaos interposition (ISSUE 6/12) on EITHER runtime: the Python
+        # pool wraps each fresh transport in a FaultingTransport; the
+        # C++ pool builds its FaultHooks (csrc/chaos.h) and the
+        # controller drives them through the pool's chaos_* methods.
+        if chaos is not None:
+            if flags.native_runtime:
+                pool_kwargs["fault_hooks"] = True
+            else:
+                pool_kwargs["transport_wrap"] = chaos.wrap_transport
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
@@ -989,8 +991,11 @@ def train(flags):
             env_server_addresses=addresses,
             initial_agent_state=model.initial_state(1),
             max_reconnects=flags.max_actor_reconnects,
+            connect_timeout_s=flags.actor_connect_timeout_s,
             **pool_kwargs,
         )
+        if chaos is not None and flags.native_runtime:
+            chaos.attach_native_pool(actors)
         if flags.native_runtime and telemetry_on:
             # The C++ core has no registry access; fold its per-request
             # stage stamps + wire/step counters into the same series the
@@ -1221,11 +1226,13 @@ def train(flags):
             health.halted.wait(timeout=5)
             if state["done"]:
                 break
-            # Graceful degradation (ISSUE 6): individual actor deaths
-            # DEGRADE the run instead of ending it; crossing the
-            # --min_live_actors floor halts it cleanly. The native pool
-            # has no liveness tracking — its first error stays fatal,
-            # as before.
+            # Graceful degradation (ISSUE 6, native since ISSUE 12):
+            # individual actor deaths DEGRADE the run instead of ending
+            # it; crossing the --min_live_actors floor halts it
+            # cleanly. BOTH pools expose live_actors()/errors now, so
+            # the same health machine drives either runtime; the
+            # fallback branch below covers only a _tbt_core build that
+            # predates liveness tracking.
             live_fn = getattr(actors, "live_actors", None)
             if live_fn is not None:
                 live = live_fn()
@@ -1259,11 +1266,12 @@ def train(flags):
                     # spurious failure.)
                     raise RuntimeError("Actor pool exited unexpectedly")
             else:
-                # Native pool: errors are recorded C++-side while
-                # surviving loops keep running; poll them so one dead
-                # actor surfaces within 5s. done-guarded like the code
-                # this replaced: actors erroring against reaped servers
-                # during a clean finish are expected, not failures.
+                # Stale _tbt_core build (predates live_actors): errors
+                # are recorded C++-side while surviving loops keep
+                # running; poll them so one dead actor surfaces within
+                # 5s. done-guarded like the code this replaced: actors
+                # erroring against reaped servers during a clean finish
+                # are expected, not failures.
                 first_error = getattr(actors, "first_error_message", None)
                 if first_error is not None and not state["done"]:
                     msg = first_error()

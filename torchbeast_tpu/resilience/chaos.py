@@ -7,6 +7,13 @@ run. Every injected fault increments a `chaos.<kind>.injected` counter,
 which is what lets scripts/chaos_run.py assert that recovery telemetry
 EXACTLY matches what was injected (not merely "the run survived").
 
+Both runtimes are injectable (ISSUE 12): on the Python runtime the
+transport faults ride a FaultingTransport wrap threaded into the
+ActorPool; with `--native_runtime` they route through the C++ pool's
+FaultHooks entry points (`attach_native_pool`, csrc/chaos.h) — the
+process-level classes (server SIGKILL, state-table poison, SIGTERM)
+are runtime-agnostic either way.
+
 Fault classes (FAULT_KINDS):
 
     env_server_sigkill   SIGKILL env-server process `target` (uncleanest
@@ -244,9 +251,10 @@ class ChaosController:
         }
         # Attached by the driver thread while the poll thread may
         # already be reading (re-attachment after a rebuild is legal):
-        # all three ride the controller lock (RACE burn-down, ISSUE 7).
+        # all of these ride the controller lock (RACE burn-down, ISSUE 7).
         self._server_supervisor = None  # guarded-by: self._lock
         self._state_table = None  # guarded-by: self._lock
+        self._native_pool = None  # guarded-by: self._lock
         self._step_fn: Callable[[], int] = lambda: 0  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._transports: Dict[int, FaultingTransport] = {}  # guarded-by: self._lock
@@ -266,6 +274,17 @@ class ChaosController:
     def attach_state_table(self, table) -> None:
         with self._lock:
             self._state_table = table
+
+    def attach_native_pool(self, pool) -> None:
+        """A native (_tbt_core) ActorPool built with fault_hooks=True:
+        its connections live in C++ actor threads where the Python
+        FaultingTransport wrap cannot reach, so transport faults route
+        through the pool's C++ FaultHooks entry points instead
+        (chaos_sever / chaos_window / chaos_corrupt_ring, csrc/chaos.h)
+        — same fault classes, same injected-exact accounting
+        (ISSUE 12)."""
+        with self._lock:
+            self._native_pool = pool
 
     def set_step_fn(self, fn: Callable[[], int]) -> None:
         with self._lock:
@@ -382,6 +401,10 @@ class ChaosController:
                 return
 
     # -- injectors --------------------------------------------------------
+    def _native_pool_handle(self):
+        with self._lock:
+            return self._native_pool
+
     def _live_transport(self, target: int) -> Optional[FaultingTransport]:
         with self._lock:
             if not self._transports:
@@ -403,12 +426,23 @@ class ChaosController:
             os.kill(proc.pid, signal.SIGKILL)
             return True
         if kind == "transport_sever":
+            native = self._native_pool_handle()
+            if native is not None:
+                # C++ FaultHooks: shutdown(SHUT_RDWR) on the actor's
+                # live transport; False while it is between connections
+                # (retry next tick), same as the Python wrap path.
+                return bool(native.chaos_sever(fault.target))
             t = self._live_transport(fault.target)
             if t is None:
                 return False
             t.sever()
             return True
         if kind in ("transport_blackhole", "transport_delay"):
+            native = self._native_pool_handle()
+            if native is not None:
+                return bool(native.chaos_window(
+                    fault.target, kind, fault.duration_s, fault.delay_s
+                ))
             if self._live_transport(fault.target) is None:
                 return False
             with self._lock:
@@ -419,11 +453,19 @@ class ChaosController:
                 )
             return True
         if kind in ("shm_corrupt_header", "shm_corrupt_payload"):
+            header = kind == "shm_corrupt_header"
+            native = self._native_pool_handle()
+            if native is not None:
+                # ShmRing::corrupt_tail_frame — poke parity with the
+                # Python path below, tail-stability checked C++-side.
+                return bool(native.chaos_corrupt_ring(
+                    fault.target, header
+                ))
             t = self._live_transport(fault.target)
             ring = t.recv_ring() if t is not None else None
             if ring is None:
                 return False
-            return _corrupt_ring(ring, header=kind == "shm_corrupt_header")
+            return _corrupt_ring(ring, header=header)
         if kind == "state_table_poison":
             with self._lock:
                 table = self._state_table

@@ -147,6 +147,12 @@ class ActorPool:
 
     @property
     def reconnects(self) -> int:
+        """COMPLETED recoveries (the stream re-established AND
+        delivering again), not granted retry attempts — a recovery
+        that needs several dials (a stale socket file, a mid-respawn
+        handshake) counts ONCE, which is what lets chaos_run assert
+        reconnects == injected faults exactly on both runtimes
+        (ISSUE 12; the C++ pool shares this contract)."""
         with self._count_lock:
             return self._reconnects
 
@@ -199,10 +205,15 @@ class ActorPool:
         failures = 0  # transport failures + batch retries, refillable
         backoff = self._backoff_factory()
         progress = [0]  # this actor's env steps (across reconnects)
+        # A granted transport retry is COUNTED only once the new stream
+        # delivers (the initial step lands, _loop clears the flag) —
+        # attempts that die before streaming are budget, not
+        # recoveries (see the `reconnects` property contract).
+        reconnect_pending = [False]
         while True:
             steps_at_connect = progress[0]
             try:
-                self._loop(index, address, progress)
+                self._loop(index, address, progress, reconnect_pending)
                 return
             except ClosedBatchingQueue:
                 return  # clean shutdown (reference actorpool.cc:452-459)
@@ -252,9 +263,7 @@ class ActorPool:
                     backoff.reset()
                 if failures < self._max_reconnects:
                     failures += 1
-                    with self._count_lock:
-                        self._reconnects += 1
-                    self._tm_reconnects.inc()
+                    reconnect_pending[0] = True
                     delay = backoff.sleep()
                     log.warning(
                         "Actor %d (%s): transport failure (%s); "
@@ -310,8 +319,12 @@ class ActorPool:
         self._tm_bytes_up.inc(nbytes)
         return self._env_outputs(msg)
 
-    def _loop(self, index: int, address: str, progress=None):
+    def _loop(self, index: int, address: str, progress=None,
+              reconnect_pending=None):
         progress = progress if progress is not None else [0]
+        reconnect_pending = (
+            reconnect_pending if reconnect_pending is not None else [False]
+        )
         table = self._state_table
         sock = self._connect(address, index)
         self._tm_connects.inc()
@@ -325,6 +338,13 @@ class ActorPool:
             else:
                 initial_agent_state = self._initial_agent_state
             env_outputs = self._recv_step(sock)
+            if reconnect_pending[0]:
+                # The stream is re-established AND delivering: the
+                # granted retry counts as a completed recovery now.
+                reconnect_pending[0] = False
+                with self._count_lock:
+                    self._reconnects += 1
+                self._tm_reconnects.inc()
             agent_state = self._initial_agent_state
             agent_outputs, agent_state = self._compute(
                 index, env_outputs, agent_state, advance=False

@@ -12,17 +12,22 @@ core stamps enqueue->batch->reply per request and counts wire bytes /
 env steps / queue intake in-process; each driver monitor tick folds that
 interval's aggregates into the process-wide telemetry registry under the
 SAME series names the Python runtime writes (wire.bytes_up/down,
-actor.env_steps/connects/request_rtt_s, recovery.actor_reconnects,
-inference.request_wait_s, learner_queue.items_in/dequeue_wait_s/
-batch_size) — so native runs emit a telemetry.jsonl indistinguishable in
-schema from Python-runtime runs. Histogram folds are exact: the C++ side
-accumulates into the same log-bucket geometry as telemetry/metrics.py
-(csrc/queues.h telemetry_bucket_index) and snapshots reset per interval.
+actor.env_steps/connects/request_rtt_s, recovery.actor_reconnects/
+batch_retries, inference.request_wait_s, learner_queue.items_in/
+dequeue_wait_s/batch_size) — so native runs emit a telemetry.jsonl
+indistinguishable in schema from Python-runtime runs. Histogram folds
+are exact: the C++ side accumulates into the same log-bucket geometry as
+telemetry/metrics.py (csrc/queues.h telemetry_bucket_index) and
+snapshots reset per interval. Sampled per-request spans (ISSUE 12)
+fold the same way: 1-in-256 native computes record their stage stamps
+C++-side and land in the tracer as actor.request.* spans, closing the
+trace-schema gap for degraded-mode diagnosis.
 
 Build: bash scripts/build_native.sh   (setup.py build_ext --inplace)
 """
 
 import threading
+import time
 from typing import Optional
 
 
@@ -60,10 +65,20 @@ class NativeTelemetryFolder:
     safe against a monitor tick still in flight.
     """
 
-    def __init__(self, registry, pool=None, batcher=None, queue=None):
+    def __init__(self, registry, pool=None, batcher=None, queue=None,
+                 tracer=None):
         self._pool = pool
         self._batcher = batcher
         self._queue = queue
+        # Sampled C++ request spans (ISSUE 12) land in the process
+        # tracer as the same actor.request.* stage spans the Python
+        # pool's StageTraces emit, so a native run's trace export is
+        # schema-identical.
+        if tracer is None:
+            from torchbeast_tpu import telemetry
+
+            tracer = telemetry.get_tracer()
+        self._tracer = tracer
         self._lock = threading.Lock()
         self._prev = {}  # counter name -> last cumulative value  # guarded-by: self._lock
         # Same series names the Python runtime's instruments use.
@@ -72,6 +87,7 @@ class NativeTelemetryFolder:
         self._c_steps = registry.counter("actor.env_steps")
         self._c_connects = registry.counter("actor.connects")
         self._c_reconnects = registry.counter("recovery.actor_reconnects")
+        self._c_retries = registry.counter("recovery.batch_retries")
         # shm doorbell-wait counters (ISSUE 10): same series names the
         # Python transport increments directly (transport.py
         # _ring_instruments), so mixed-runtime runs aggregate.
@@ -99,6 +115,36 @@ class NativeTelemetryFolder:
             snap["min"], snap["max"],
         )
 
+    # beastlint: holds self._lock
+    def _fold_traces(self) -> None:
+        """Drain the batcher's sampled (enqueued, batched, replied)
+        stamp triples (csrc/queues.h, 1-in-256 computes like the Python
+        pool) into tracer spans. Stamps are steady-clock; the payload's
+        "now" rebases them onto the tracer's perf_counter timebase
+        (both CLOCK_MONOTONIC on Linux — the offset absorbs any epoch
+        difference). Always drained, even with tracing disabled, so
+        the C++ buffer never sits full."""
+        spans_fn = getattr(self._batcher, "trace_spans", None)
+        if spans_fn is None:  # extension built before ISSUE 12
+            return
+        payload = spans_fn()
+        if not payload["spans"] or not self._tracer.enabled():
+            return
+        offset = time.perf_counter() - payload["now"]
+        for enqueued, batched, replied in payload["spans"]:
+            self._tracer.add_complete(
+                "actor.request.batch", "actor.request",
+                enqueued + offset, batched - enqueued,
+            )
+            self._tracer.add_complete(
+                "actor.request.reply", "actor.request",
+                batched + offset, replied - batched,
+            )
+            self._tracer.add_complete(
+                "actor.request", "actor.request",
+                enqueued + offset, replied - enqueued,
+            )
+
     def tick(self) -> None:
         with self._lock:
             if self._pool is not None:
@@ -112,8 +158,13 @@ class NativeTelemetryFolder:
                 self._inc_delta(
                     self._c_reconnects, "reconnects", p["reconnects"]
                 )
-                # .get: an extension built before ISSUE 10 reports no
-                # ring counters; the fold must not KeyError on it.
+                # .get from here down: an extension built before ISSUE
+                # 10/12 reports no ring counters / batch retries; the
+                # fold must not KeyError on it.
+                self._inc_delta(
+                    self._c_retries, "batch_retries",
+                    p.get("batch_retries", 0),
+                )
                 self._inc_delta(
                     self._c_ring_waits, "ring_doorbell_waits",
                     p.get("ring_doorbell_waits", 0),
@@ -130,6 +181,7 @@ class NativeTelemetryFolder:
                 # them here would double-count.
                 self._fold_hist(self._h_request_wait, b["request_wait_s"])
                 self._fold_hist(self._h_rtt, b["request_rtt_s"])
+                self._fold_traces()
             if self._queue is not None:
                 q = self._queue.telemetry()
                 self._inc_delta(self._c_queue_in, "queue_items_in",

@@ -71,7 +71,61 @@ _DOORBELL_INLINE = b"\x02"  # one framed message follows on the socket
 # visible fraction of waits ride the recheck — a tight bound caps each
 # such stall at one scheduling quantum instead of half a second, while
 # an idle connection still costs only 50 wakeups/s.
+# This is the INITIAL bound: per connection, AdaptiveRecheck walks it
+# within [_RECHECK_MIN_MS, _RECHECK_MAX_MS] below (ISSUE 12).
 _WAKE_RECHECK_S = 0.02
+
+# Adaptive recheck policy (ISSUE 12): the fixed bound trades idle
+# wakeup cost against lost-wakeup stall cost at ONE operating point,
+# but the ring.doorbell_waits / ring.recheck_wakeups counters (PR 10)
+# measure which regime a connection is actually in. Per window of
+# _RECHECK_WINDOW armed waits: >= _RECHECK_TIGHTEN ended by the
+# timeout (doorbells being lost/late — the ROADMAP metastability
+# signature) HALVES the bound, floor _RECHECK_MIN_MS, so each stall
+# costs less exactly when stalls are frequent; <= _RECHECK_RELAX
+# (healthy byte-woken pair) DOUBLES it, cap _RECHECK_MAX_MS, back
+# toward idle cheapness. All five constants are pinned cross-language
+# against csrc/shm.h AND analysis/protocol.py by the ATOMIC-ORDER
+# recheck check; the model checker's timeout transition covers any
+# bound in the range (no-wedge only needs the recheck to stay FINITE,
+# i.e. _RECHECK_MIN_MS > 0).
+_RECHECK_MIN_MS = 5
+_RECHECK_MAX_MS = 100
+_RECHECK_WINDOW = 32
+_RECHECK_TIGHTEN = 16
+_RECHECK_RELAX = 4
+
+
+class AdaptiveRecheck:
+    """Per-connection adaptive recheck bound (single-threaded, like the
+    transport that owns it). `record(True)` = a wait ended by the
+    bounded timeout instead of a doorbell byte."""
+
+    __slots__ = ("_bound_ms", "_waits", "_rechecks")
+
+    def __init__(self):
+        self._bound_ms = int(_WAKE_RECHECK_S * 1000)
+        self._waits = 0
+        self._rechecks = 0
+
+    @property
+    def bound_ms(self) -> int:
+        return self._bound_ms
+
+    def timeout_s(self) -> float:
+        return self._bound_ms / 1000.0
+
+    def record(self, recheck: bool) -> None:
+        self._waits += 1
+        if recheck:
+            self._rechecks += 1
+        if self._waits < _RECHECK_WINDOW:
+            return
+        if self._rechecks >= _RECHECK_TIGHTEN:
+            self._bound_ms = max(_RECHECK_MIN_MS, self._bound_ms // 2)
+        elif self._rechecks <= _RECHECK_RELAX:
+            self._bound_ms = min(_RECHECK_MAX_MS, self._bound_ms * 2)
+        self._waits = self._rechecks = 0
 
 # Doorbell-wait observability (ISSUE 10 satellite; same lazy-resolve
 # idiom as wire._instruments so --no_telemetry runs get no-ops):
@@ -491,6 +545,7 @@ class ShmTransport:
         self._inline_consumed = False
         self._doorbell = bytearray(1)
         self._doorbell_mv = memoryview(self._doorbell)
+        self._recheck = AdaptiveRecheck()
 
     def send(self, value: Any) -> int:
         views, total = wire._timed_encode_into(value, self._send_buf)
@@ -572,11 +627,14 @@ class ShmTransport:
                 if ring.has_frame():
                     continue
                 waits.inc()
-                sock.settimeout(_WAKE_RECHECK_S)
+                # Adaptive bound (ISSUE 12): recheck-heavy windows
+                # tighten it, quiescent ones relax it (AdaptiveRecheck).
+                sock.settimeout(self._recheck.timeout_s())
                 try:
                     n = sock.recv_into(mv, 1)
                 except socket.timeout:
                     rechecks.inc()
+                    self._recheck.record(True)
                     continue  # re-check the ring (lost-wakeup guard)
                 finally:
                     sock.settimeout(None)
@@ -584,6 +642,7 @@ class ShmTransport:
                     # Peer closed. Frames already in the ring are still
                     # deliverable; EOF surfaces once it drains.
                     return ring.has_frame()
+                self._recheck.record(False)  # a byte ended this wait
                 kind = bytes(mv)
                 if kind == _DOORBELL_INLINE:
                     # Normally the inline marker is consumed from the
