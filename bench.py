@@ -551,20 +551,30 @@ def run_bench(child_deadline: float):
             f"bench: skipping anakin phase ({remaining():.0f}s left)\n"
         )
 
+    # benchmarks/learner_bench.py is loaded by path (the benchmarks dir
+    # is not a package) and memoized: three measurement phases below
+    # share ONE module execution.
+    _lb_cache = []
+
+    def _load_learner_bench():
+        if not _lb_cache:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "learner_bench",
+                os.path.join(_REPO, "benchmarks", "learner_bench.py"),
+            )
+            lb = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(lb)
+            _lb_cache.append(lb)
+        return _lb_cache[0]
+
     # Learner superstep throughput (ISSUE 4): the small-MLP K=8 fused
     # dispatch — the dispatch-amortization metric the superstep work
     # moves. ONE measurement implementation, shared with the committed
-    # artifact: benchmarks/learner_bench.py is loaded by path (the
-    # benchmarks dir is not a package).
+    # artifact.
     def measure_learner_superstep(k=8, n_updates=32):
-        import importlib.util
-
-        spec = importlib.util.spec_from_file_location(
-            "learner_bench",
-            os.path.join(_REPO, "benchmarks", "learner_bench.py"),
-        )
-        lb = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(lb)
+        lb = _load_learner_bench()
         hp, model, optimizer, params, lrng = lb.build_config(
             use_lstm=False
         )
@@ -595,14 +605,7 @@ def run_bench(child_deadline: float):
     # any host; methodology in benchmarks/learner_bench.py). ONE
     # measurement implementation, shared with the committed artifact.
     def measure_learner_bytes():
-        import importlib.util
-
-        spec = importlib.util.spec_from_file_location(
-            "learner_bench",
-            os.path.join(_REPO, "benchmarks", "learner_bench.py"),
-        )
-        lb = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(lb)
+        lb = _load_learner_bench()
         rows, _ = lb.measure_bytes(
             "mlp", ks=[1], t=lb.BYTES_T, b=lb.BYTES_B
         )
@@ -627,6 +630,36 @@ def run_bench(child_deadline: float):
     else:
         sys.stderr.write(
             f"bench: skipping learner bytes phase "
+            f"({remaining():.0f}s left)\n"
+        )
+
+    # Fused optimizer tail (ISSUE 13): xla-vs-pallas full-update bytes
+    # on the flagship LSTM under bf16_train (the shape whose tail is
+    # large enough to carry the 1.15x acceptance), same lowered-HLO
+    # accounting and same _prev/_delta convention as the hbm keys. ONE
+    # measurement implementation, shared with the committed artifact.
+    def measure_opt_tail_reduction():
+        lb = _load_learner_bench()
+        rows = lb.measure_opt_tail("lstm", lb.BYTES_T, lb.BYTES_B)
+        by_impl = {
+            r["opt_impl"]: r["bytes_accessed"]
+            for r in rows
+            if r["precision"] == "bf16_train" and r["bytes_accessed"]
+        }
+        x, p = by_impl.get("xla"), by_impl.get("pallas")
+        return x / p if x and p else None
+
+    opt_tail_reduction = None
+    if remaining() > 30:
+        try:
+            opt_tail_reduction = measure_opt_tail_reduction()
+        except Exception as e:  # diagnostic only — never sink the bench
+            sys.stderr.write(
+                f"bench: opt-tail bytes measurement failed: {e}\n"
+            )
+    else:
+        sys.stderr.write(
+            f"bench: skipping opt-tail bytes phase "
             f"({remaining():.0f}s left)\n"
         )
 
@@ -736,6 +769,29 @@ def run_bench(child_deadline: float):
     result["learner_hbm_bytes_reduction_delta_pct"] = (
         round(100.0 * (hbm_reduction - prev_hbm) / prev_hbm, 1)
         if hbm_reduction and prev_hbm
+        else None
+    )
+    # Fused-tail regression visibility (ISSUE 13), platform-neutral
+    # like the hbm reduction: flagship-LSTM bf16_train xla/pallas
+    # full-update bytes vs the committed learner_bench artifact.
+    result["learner_opt_tail_bytes_reduction"] = (
+        round(opt_tail_reduction, 3) if opt_tail_reduction else None
+    )
+    prev_tail = None
+    try:
+        prev_tail = lb_art.get("acceptance", {}).get(
+            "opt_tail", {}
+        ).get("lstm_update_reduction_bf16")
+    except Exception:
+        pass
+    result["learner_opt_tail_bytes_reduction_prev"] = (
+        round(prev_tail, 3) if prev_tail else None
+    )
+    result["learner_opt_tail_bytes_reduction_delta_pct"] = (
+        round(
+            100.0 * (opt_tail_reduction - prev_tail) / prev_tail, 1
+        )
+        if opt_tail_reduction and prev_tail
         else None
     )
     if not on_accel:

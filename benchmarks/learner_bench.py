@@ -49,6 +49,28 @@ documented:
 - Under supersteps the lowered scan body is counted ONCE, so a K-row's
   figure is directly per-update (plus the K-stack staging operands).
 
+ISSUE 13 adds two sections on the same accounting:
+
+- OPT-TAIL (`results.opt_tail`): full-update bytes for the optax
+  optimizer tail vs the fused Pallas tail (--opt_impl pallas,
+  ops/pallas_opt.py), per (config, precision) at K=1. The pallas rows
+  lower the COMPILED kernel for the TPU target (the interpreter would
+  be counted as real HLO traffic); the acceptance carries the
+  xla/pallas reductions. The tail is ~8% of the tiny MLP's update and
+  ~34% of the LSTM's, so the full-update reduction is bounded by that
+  fraction — the lstm and combined rows carry the >=1.15x ISSUE gate,
+  the mlp row is gated at its measured fusion ceiling
+  (tests/test_pallas_opt.py pins all three against the committed
+  artifact).
+- REMAT (`results.remat`): the remat-plan x precision matrix for the
+  lstm config (the one timing family with a remat lever — the LSTM
+  scan): remat in {none, all, auto} x precision x K in {1, ktop}, each
+  row carrying updates/s AND lowered bytes-accessed. `auto` runs the
+  real planner (runtime/remat_plan.py) against the default budget and
+  records the chosen assignment; rematerialized ops appear as real
+  reads in the pre-opt HLO, so the all-vs-none byte gap IS the
+  recompute the planner trades away.
+
 Writes benchmarks/artifacts/learner_bench.json with the standard
 telemetry block (learner.update_dispatch_s / updates_per_dispatch /
 host_syncs series populated), same schema family as wire_bench.
@@ -57,6 +79,7 @@ Run:  python benchmarks/learner_bench.py [--updates 64] [--selftest]
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -108,7 +131,8 @@ def make_batch(rng, t=T, b=B):
     }
 
 
-def build_config(use_lstm, seed=0, precision="f32", t=T, b=B):
+def build_config(use_lstm, seed=0, precision="f32", t=T, b=B,
+                 core_remat=False, opt_impl="xla"):
     """(model, params, opt_state template pieces) for one config."""
     import jax
 
@@ -121,10 +145,12 @@ def build_config(use_lstm, seed=0, precision="f32", t=T, b=B):
         unroll_length=t, batch_size=b, total_steps=10_000_000,
         opt_state_dtype=pol.opt_state_dtype,
         param_dtype=pol.param_dtype,
+        opt_impl=opt_impl,
     )
     model = create_model(
         "mlp", num_actions=NUM_ACTIONS, use_lstm=use_lstm,
         dtype=pol.compute_dtype, head_dtype=pol.head_dtype,
+        core_remat=core_remat,
     )
     rng = np.random.default_rng(seed)
     dummy = make_batch(rng, t=0, b=b)
@@ -432,6 +458,280 @@ def bytes_failures(section, ks):
     return failures
 
 
+@contextlib.contextmanager
+def _pallas_compile_env():
+    """Cross-lowering a pallas-tail update for the TPU target must
+    embed the COMPILED kernel: the ambient CPU backend would otherwise
+    select interpret mode (ops/pallas_opt._interpret_default) and the
+    interpreter's while-loop would be counted as real pre-opt HLO
+    traffic — re-inflating exactly the bytes the kernel removes."""
+    os.environ["TORCHBEAST_OPT_PALLAS_COMPILE"] = "1"
+    try:
+        yield
+    finally:
+        os.environ.pop("TORCHBEAST_OPT_PALLAS_COMPILE", None)
+
+
+def measure_opt_tail(name, t, b):
+    """Full-update bytes rows, optax vs fused-Pallas tail, per
+    precision at K=1 (the tail runs identically inside a superstep's
+    scan body, which the lowered accounting counts once anyway)."""
+    import jax
+
+    from torchbeast_tpu import learner as learner_lib
+    from torchbeast_tpu import precision as precision_lib
+
+    rows = []
+    for precision in BYTES_PRECISIONS:
+        pol = precision_lib.get(precision)
+        for impl in ("xla", "pallas"):
+            hp, model, optimizer, params, rng = build_config(
+                CONFIGS[name]["use_lstm"], precision=precision,
+                t=t, b=b, opt_impl=impl,
+            )
+            batch = precision_lib.cast_batch(
+                make_batch(rng, t=t, b=b), pol.batch_dtype
+            )
+            state = precision_lib.cast_batch(
+                jax.tree_util.tree_map(
+                    np.asarray, model.initial_state(b)
+                ),
+                pol.batch_dtype,
+            )
+            opt_state = optimizer.init(params)
+            upd = learner_lib.make_update_step(
+                model, optimizer, hp, donate=False
+            )
+            with _pallas_compile_env():
+                value = _bytes_of(_lower_for_tpu(
+                    upd, params, opt_state, batch, state
+                ))
+            rows.append({
+                "config": name,
+                "precision": precision,
+                "opt_impl": impl,
+                "bytes_accessed": value,
+            })
+    return rows
+
+
+def opt_tail_section(selftest):
+    """The fused-tail bytes block + per-config reductions (None-safe
+    like bytes_section)."""
+    t, b = (T, B) if selftest else (BYTES_T, BYTES_B)
+    section = {"shape": {"T": t, "B": b}, "update": []}
+    for name in CONFIGS:
+        section["update"].extend(measure_opt_tail(name, t, b))
+
+    def val(name, precision, impl):
+        row = next(
+            (r for r in section["update"]
+             if r["config"] == name and r["precision"] == precision
+             and r["opt_impl"] == impl),
+            None,
+        )
+        return row["bytes_accessed"] if row else None
+
+    reductions = {}
+    for precision in BYTES_PRECISIONS:
+        tag = "bf16" if precision == "bf16_train" else precision
+        total_x = total_p = 0.0
+        complete = True
+        for name in CONFIGS:
+            x, p = val(name, precision, "xla"), val(
+                name, precision, "pallas"
+            )
+            if x and p:
+                reductions[f"{name}_update_reduction_{tag}"] = x / p
+                total_x += x
+                total_p += p
+            else:
+                complete = False
+        if complete and total_p:
+            # The aggregate form of the ISSUE's >=1.15x claim: total
+            # flagship update bytes across both timing configs.
+            reductions[f"combined_update_reduction_{tag}"] = (
+                total_x / total_p
+            )
+    section["reductions"] = reductions
+    return section
+
+
+def opt_tail_failures(section):
+    """Gates, calibrated to each config's measured tail fraction (the
+    module docstring has the arithmetic): the LSTM's tail is ~34% of
+    its update, so the fused kernel must clear the ISSUE's 1.15x there
+    and on the combined figure; the tiny MLP's tail is ~8%, bounding
+    its full-update ceiling at ~1.08x — gated at 1.03x so a fusion
+    regression still fails while the physical ceiling does not."""
+    red = section["reductions"]
+    failures = []
+    floors = {
+        "lstm_update_reduction_bf16": 1.15,
+        "combined_update_reduction_bf16": 1.15,
+        "mlp_update_reduction_bf16": 1.03,
+    }
+    for key, floor in floors.items():
+        got = red.get(key)
+        if got is not None and got < floor:
+            failures.append(f"opt_tail {key} {got:.3f}x < {floor}x")
+    return failures
+
+
+REMAT_PLANS = ("none", "all", "auto")
+
+
+def _remat_auto_assignment(hp, precision):
+    """Run the real planner for the lstm config (exhaustive — the LSTM
+    lattice has two candidates) and return (assignment, plan)."""
+    from torchbeast_tpu import precision as precision_lib
+    from torchbeast_tpu.models import create_model
+    from torchbeast_tpu.runtime import remat_plan as remat_plan_lib
+
+    pol = precision_lib.get(precision)
+    stages = remat_plan_lib.stages_for("mlp", use_lstm=True)
+
+    def build_model(kwargs):
+        return create_model(
+            "mlp", num_actions=NUM_ACTIONS, use_lstm=True,
+            dtype=pol.compute_dtype, head_dtype=pol.head_dtype,
+            **kwargs,
+        )
+
+    cost_fn = remat_plan_lib.superstep_cost_fn(
+        build_model, hp, 1,
+        remat_plan_lib.learner_batch_structs(
+            hp, NUM_ACTIONS, FRAME, np.uint8, pol.batch_dtype
+        ),
+        hp.batch_size, "mlp",
+    )
+    plan = remat_plan_lib.plan_remat(
+        stages, cost_fn, remat_plan_lib.default_budget_bytes()
+    )
+    return plan
+
+
+def remat_section(ks, n_updates, selftest, registry):
+    """The remat-plan x precision matrix for the lstm config: per
+    (remat, precision, K) one row with updates/s AND the lowered
+    bytes-accessed figure. `auto` rows record the planner's chosen
+    assignment and source."""
+    import jax
+
+    from torchbeast_tpu import learner as learner_lib
+    from torchbeast_tpu import precision as precision_lib
+
+    del selftest  # both modes use the timing shape (module docstring)
+    t, b = T, B
+    rows = []
+    for precision in BYTES_PRECISIONS:
+        pol = precision_lib.get(precision)
+        for plan_name in REMAT_PLANS:
+            hp0, _, _, _, _ = build_config(
+                True, precision=precision, t=t, b=b
+            )
+            plan_info = None
+            if plan_name == "auto":
+                plan = _remat_auto_assignment(hp0, precision)
+                core_remat = bool(plan.assignment.get("core", False))
+                plan_info = {
+                    "assignment": {
+                        k: ("all" if v is True else
+                            "none" if v is False else v)
+                        for k, v in plan.assignment.items()
+                    },
+                    "source": plan.source,
+                }
+            else:
+                core_remat = plan_name == "all"
+            hp, model, optimizer, params, rng = build_config(
+                True, precision=precision, t=t, b=b,
+                core_remat=core_remat,
+            )
+            batch = precision_lib.cast_batch(
+                make_batch(rng, t=t, b=b), pol.batch_dtype
+            )
+            state = precision_lib.cast_batch(
+                jax.tree_util.tree_map(
+                    np.asarray, model.initial_state(b)
+                ),
+                pol.batch_dtype,
+            )
+            for k in ks:
+                timing = measure_updates_per_sec(
+                    hp, model, optimizer, params, rng, k, n_updates,
+                    registry=registry,
+                )
+                if k == 1:
+                    upd = learner_lib.make_update_step(
+                        model, optimizer, hp, donate=False
+                    )
+                    bk, sk = batch, state
+                else:
+                    upd = learner_lib.make_update_superstep(
+                        model, optimizer, hp, k, donate=False
+                    )
+                    bk = {
+                        key: np.stack([v] * k)
+                        for key, v in batch.items()
+                    }
+                    sk = jax.tree_util.tree_map(
+                        lambda s: np.stack([s] * k), state
+                    )
+                rows.append({
+                    "config": "lstm",
+                    "remat": plan_name,
+                    "precision": precision,
+                    "k": k,
+                    "core_remat": core_remat,
+                    "plan": plan_info,
+                    "updates_per_sec": timing["updates_per_sec"],
+                    "bytes_accessed": _bytes_of(_lower_for_tpu(
+                        upd, params, optimizer.init(params), bk, sk
+                    )),
+                })
+    return {"rows": rows}
+
+
+def remat_failures(section):
+    """Gates: rematerialized ops must be VISIBLE in the lowered
+    accounting (all-remat reads strictly more bytes than none), and
+    `auto` under the huge default budget must pick the no-recompute
+    plan — i.e. strictly fewer recompute bytes than all-remat whenever
+    the budget allows it (the planner-level matrix lives in
+    tests/test_remat_plan.py)."""
+    failures = []
+
+    def row(remat, precision, k):
+        return next(
+            (r for r in section["rows"]
+             if r["remat"] == remat and r["precision"] == precision
+             and r["k"] == k),
+            None,
+        )
+
+    for precision in BYTES_PRECISIONS:
+        r_all = row("all", precision, 1)
+        r_none = row("none", precision, 1)
+        r_auto = row("auto", precision, 1)
+        if not (r_all and r_none and r_auto):
+            failures.append(f"remat rows missing for {precision}")
+            continue
+        b_all, b_none = r_all["bytes_accessed"], r_none["bytes_accessed"]
+        b_auto = r_auto["bytes_accessed"]
+        if b_all and b_none and not b_all > b_none:
+            failures.append(
+                f"remat {precision}: all-remat bytes {b_all:.3e} not > "
+                f"none {b_none:.3e} (recompute invisible?)"
+            )
+        if b_all and b_auto and not b_auto < b_all:
+            failures.append(
+                f"remat {precision}: auto bytes {b_auto:.3e} not < "
+                f"all-remat {b_all:.3e} though the budget allows none"
+            )
+    return failures
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--updates", type=int, default=64,
@@ -479,6 +779,14 @@ def main(argv=None):
     # precision, at the flagship shape (selftest: the timing shape).
     bytes_ks = sorted({1, max(ks)})
     results["bytes"] = bytes_section(bytes_ks, flags.selftest)
+    # Fused optimizer tail (ISSUE 13): optax vs Pallas full-update
+    # bytes per (config, precision).
+    results["opt_tail"] = opt_tail_section(flags.selftest)
+    # Remat-plan matrix (ISSUE 13): {none, all, auto} x precision x K
+    # for the lstm config, updates/s + bytes per row.
+    results["remat"] = remat_section(
+        bytes_ks, n_updates, flags.selftest, registry
+    )
 
     def row(config, k):
         return next(
@@ -504,6 +812,34 @@ def main(argv=None):
         # is a conservative lower bound: module docstring).
         "bytes": results["bytes"]["reductions"],
         "bytes_issue_target_update_reduction": 1.8,
+        # Fused-tail reductions (ISSUE 13; floors in
+        # opt_tail_failures — lstm/combined carry the 1.15x gate).
+        "opt_tail": results["opt_tail"]["reductions"],
+        # Remat summary: the auto rows' chosen plan + the all-vs-none
+        # recompute gap the planner trades away.
+        "remat": {
+            "auto_plans": {
+                r["precision"]: r["plan"]
+                for r in results["remat"]["rows"]
+                if r["remat"] == "auto" and r["k"] == 1
+            },
+            "recompute_bytes_all_over_none": {
+                p: (
+                    _r["bytes_accessed"] / _n["bytes_accessed"]
+                    if _r and _n and _r["bytes_accessed"]
+                    and _n["bytes_accessed"] else None
+                )
+                for p in BYTES_PRECISIONS
+                for _r in [next(
+                    (r for r in results["remat"]["rows"]
+                     if r["remat"] == "all" and r["precision"] == p
+                     and r["k"] == 1), None)]
+                for _n in [next(
+                    (r for r in results["remat"]["rows"]
+                     if r["remat"] == "none" and r["precision"] == p
+                     and r["k"] == 1), None)]
+            },
+        },
     }
     failures = []
     for name in CONFIGS:
@@ -514,6 +850,7 @@ def main(argv=None):
                     f"{name} K={k}: {r['host_syncs']} host syncs for "
                     f"{r['updates']} updates (expected exactly 1/K)"
                 )
+    failures.extend(remat_failures(results["remat"]))
     if not flags.selftest:
         if acceptance["mlp_speedup_ktop_vs_k1"] < 1.3:
             failures.append(
@@ -521,6 +858,7 @@ def main(argv=None):
                 f"{acceptance['mlp_speedup_ktop_vs_k1']:.2f}x < 1.3x"
             )
         failures.extend(bytes_failures(results["bytes"], bytes_ks))
+        failures.extend(opt_tail_failures(results["opt_tail"]))
 
     out = {
         "bench": "learner_bench",

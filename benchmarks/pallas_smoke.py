@@ -137,6 +137,82 @@ def vtrace_case(t, b, seed=0, interpret=False):
     }
 
 
+def opt_case(shapes, seed=0, interpret=False, precision="bf16_train"):
+    """The fused optimizer tail (ops/pallas_opt.py) vs the optax chain
+    learner.make_optimizer composes — one update over a synthetic leaf
+    tree (odd/1-D shapes included: the kernel runs leaves natively),
+    momentum + clip active, bf16-resident master write exercised."""
+    import jax.numpy as jnp
+
+    from torchbeast_tpu import learner as learner_lib
+
+    rng = np.random.default_rng(seed)
+    bf16 = precision == "bf16_train"
+    dt = jnp.bfloat16 if bf16 else jnp.float32
+    params = {
+        f"leaf{i}": jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32)
+        ).astype(dt)
+        for i, shape in enumerate(shapes)
+    }
+    grads = {
+        k: jnp.asarray(
+            rng.standard_normal(v.shape).astype(np.float32)
+        ).astype(dt)
+        for k, v in params.items()
+    }
+    hp = learner_lib.HParams(
+        grad_norm_clipping=0.5,  # small: the clip branch fires
+        rmsprop_momentum=0.9,
+        opt_state_dtype="bf16" if bf16 else "f32",
+        param_dtype="bf16" if bf16 else "f32",
+    )
+
+    def run(opt):
+        state = opt.init(params)
+        step = jax.jit(opt.update)
+        updates, state = step(grads, state, params)
+        return learner_lib.apply_updates(params, updates, state)
+
+    ref = run(learner_lib.make_optimizer(hp._replace(opt_impl="xla")))
+    os.environ.pop("TORCHBEAST_OPT_PALLAS_COMPILE", None)
+    if not interpret:
+        # Force the compiled kernel even off-TPU so a CPU run fails
+        # cleanly per-case, exactly as the other cases do.
+        os.environ["TORCHBEAST_OPT_PALLAS_COMPILE"] = "1"
+    try:
+        t0 = time.perf_counter()
+        ours = run(
+            learner_lib.make_optimizer(hp._replace(opt_impl="pallas"))
+        )
+        jax.block_until_ready(ours)
+        compile_s = time.perf_counter() - t0
+    finally:
+        os.environ.pop("TORCHBEAST_OPT_PALLAS_COMPILE", None)
+    err = max(
+        float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)
+        )))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ref),
+            jax.tree_util.tree_leaves(ours),
+        )
+    )
+    scale = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32))))
+        for a in jax.tree_util.tree_leaves(ref)
+    ) or 1.0
+    return {
+        "kernel": "fused_opt_tail",
+        "shape": "+".join("x".join(map(str, s)) for s in shapes),
+        "precision": precision,
+        "max_abs_err": err,
+        "rel_err": err / scale,
+        "compile_s": round(compile_s, 2),
+        "ok": bool(err / scale < 5e-4),
+    }
+
+
 def pool_case(shape, seed=0, interpret=False):
     from torchbeast_tpu.ops.pallas_pool import pool_bwd
 
@@ -201,6 +277,12 @@ def main() -> None:
             ("vtrace-test",
              lambda: vtrace_case(13, 8, interpret=itp))
         )
+        cases.append(
+            ("opt-test",
+             lambda: opt_case(
+                 [(7,), (16, 128), (13, 37)], interpret=itp
+             ))
+        )
     if "chip" in sizes:
         # Flagship shapes: the transformer's RL-unroll attention
         # (models/transformer.py defaults) and the deep trunk's stage-1
@@ -217,6 +299,15 @@ def main() -> None:
         cases.append(
             ("vtrace-chip",
              lambda: vtrace_case(80, 32, interpret=itp))
+        )
+        # The LSTM timing config's real leaf shapes (ih/hh kernels,
+        # gate bias, head projections) — the fused-tail production set.
+        cases.append(
+            ("opt-chip",
+             lambda: opt_case(
+                 [(133, 532), (133, 532), (532,), (133, 4), (3872, 256)],
+                 interpret=itp,
+             ))
         )
 
     results, failures = [], []

@@ -57,6 +57,96 @@ def namedsharding_accepts_str_specs() -> bool:
     return True
 
 
+def _dense_tp_grad_repro(use_shardy: bool) -> bool:
+    """Run the minimal dense-TP grad-path program (the
+    RecurrentPolicyHead pattern: two hidden layers, the second's kernel
+    sharded on its input dim, trunk features concatenated with
+    reward/one-hot columns, jax.grad over the lot) under the requested
+    partitioner and compare against the unsharded reference. Returns
+    True when loss AND grads match — i.e. the partitioner is SOUND for
+    parallel/tp.dense_kernel_shardings programs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return False
+    knob = "jax_use_shardy_partitioner"
+    if use_shardy and not hasattr(jax.config, knob):
+        return False
+    old = getattr(jax.config, knob, None)
+    try:
+        if old is not None:
+            jax.config.update(knob, bool(use_shardy))
+        mesh = Mesh(np.asarray(devices[:2]).reshape(1, 2),
+                    ("data", "model"))
+        rng = np.random.default_rng(0)
+        t, b, d, h, a = 5, 4, 16, 128, 4
+        frame = rng.standard_normal((t, b, d)).astype(np.float32)
+        reward = rng.standard_normal((t, b)).astype(np.float32)
+        act = rng.integers(0, a, (t, b)).astype(np.int32)
+        w1 = rng.standard_normal((d, h)).astype(np.float32) * 0.1
+        w2 = rng.standard_normal((h, h)).astype(np.float32) * 0.1
+        wp = rng.standard_normal((h + 1 + a, a)).astype(np.float32) * 0.1
+
+        def f(frame, reward, act, w1, w2, wp):
+            x = jax.nn.relu(frame.reshape(t * b, d) @ w1)
+            x = jax.nn.relu(x @ w2)
+            z = jnp.concatenate(
+                [
+                    x,
+                    jnp.clip(reward, -1, 1).reshape(t * b, 1),
+                    jax.nn.one_hot(act.reshape(t * b), a),
+                ],
+                axis=-1,
+            )
+            return ((z @ wp) ** 2).sum()
+
+        args = (frame, reward, act, w1, w2, wp)
+        ref_l = f(*args)
+        ref_g = jax.grad(f, argnums=4)(*args)
+        bsh = NamedSharding(mesh, P(None, "data"))
+        row = NamedSharding(mesh, P("model", None))
+        repl = NamedSharding(mesh, P())
+        shardings = (bsh, bsh, bsh, repl, row, repl)
+        run = jax.jit(
+            lambda *a: jax.value_and_grad(f, argnums=4)(*a),
+            in_shardings=shardings,
+        )
+        loss, grad = run(
+            *[jax.device_put(x, s) for x, s in zip(args, shardings)]
+        )
+        return bool(
+            np.allclose(float(ref_l), float(loss), rtol=1e-4)
+            and np.allclose(np.asarray(ref_g), np.asarray(grad),
+                            rtol=1e-3, atol=1e-5)
+        )
+    except Exception:  # pragma: no cover - partitioner API churn
+        return False
+    finally:
+        if old is not None:
+            jax.config.update(knob, old)
+
+
+def legacy_spmd_dense_tp_grad_sound() -> bool:
+    """Whether the default (legacy GSPMD) partitioner correctly
+    compiles dense-TP grad programs. On this container it silently
+    computes ~40%-wrong losses/grads (the five-PR test_dp_plus_tp
+    failure; parallel/tp.py module docstring has the full story) — so
+    dense-TP consumers compile under tp.shardy_partitioner(). When this
+    probe turns True the workaround is droppable."""
+    return _dense_tp_grad_repro(use_shardy=False)
+
+
+def shardy_spmd_dense_tp_grad_sound() -> bool:
+    """Whether the Shardy partitioner exists and correctly compiles
+    dense-TP grad programs — the workaround path test_dp_plus_tp and
+    dryrun_multichip rely on."""
+    return _dense_tp_grad_repro(use_shardy=True)
+
+
 def mosaic_lowers_stop_gradient() -> bool:
     """Client-side Mosaic (Pallas->TPU) lowering of a kernel containing
     stop_gradient — the construct ops/pallas_attention.py uses; some

@@ -766,6 +766,45 @@ class TestFlagParity:
         )
         assert len(check_flag_parity(a, b)) == 1
 
+    def test_issue13_flags_present_and_drift_caught(self):
+        """The three ISSUE 13 shared flags (--remat, --opt_impl,
+        --hbm_budget_gb) exist in BOTH drivers, agree right now (the
+        in-anger test below), and an injected default drift on each is
+        CAUGHT by the rule — the parity net actually covers them."""
+        with open(os.path.join(
+            REPO, "torchbeast_tpu", "monobeast.py"
+        )) as f:
+            mono_src = f.read()
+        with open(os.path.join(
+            REPO, "torchbeast_tpu", "polybeast.py"
+        )) as f:
+            poly_src = f.read()
+        drifts = {
+            "--remat": (
+                '"--remat", default=None',
+                '"--remat", default="all"',
+            ),
+            "--opt_impl": (
+                '"--opt_impl", default="xla"',
+                '"--opt_impl", default="pallas"',
+            ),
+            "--hbm_budget_gb": (
+                '"--hbm_budget_gb", type=float, default=0.0',
+                '"--hbm_budget_gb", type=float, default=15.75',
+            ),
+        }
+        mono = FileContext("torchbeast_tpu/monobeast.py", mono_src)
+        for flag, (orig, drifted_frag) in drifts.items():
+            assert orig in mono_src and orig in poly_src, flag
+            drifted = FileContext(
+                "torchbeast_tpu/polybeast.py",
+                poly_src.replace(orig, drifted_frag),
+            )
+            found = check_flag_parity(mono, drifted)
+            assert any(flag in f.message for f in found), (
+                flag, [f.message for f in found],
+            )
+
     def test_real_drivers_in_anger(self):
         """Shared monobeast/polybeast flags agree on type+default; the
         two known-intentional divergences (--model, --num_actors) are

@@ -41,7 +41,7 @@ def test_pallas_smoke_interpret_rehearsal(tmp_path):
     # A CPU interpreter pass must NOT claim the Mosaic box is checked.
     assert out["mosaic"] is False
     assert {c["case"] for c in out["cases"]} == {
-        "attn-test", "pool-test", "vtrace-test",
+        "attn-test", "pool-test", "vtrace-test", "opt-test",
     }
 
 
@@ -54,7 +54,7 @@ def test_pallas_smoke_compiled_cpu_fails_cleanly():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["ok"] is False
     assert set(out["failures"]) == {
-        "attn-test", "pool-test", "vtrace-test",
+        "attn-test", "pool-test", "vtrace-test", "opt-test",
     }
     for case in out["cases"]:
         assert "error" in case and "traceback" in case
@@ -267,6 +267,50 @@ def test_learner_bench_selftest(tmp_path):
         # floors apply to the full run's flagship shape).
         assert red[f"{config}_fwd_bwd_reduction"] > 1.0
     assert out["acceptance"]["bytes"] == red
+
+    # Fused optimizer tail (ISSUE 13): xla-vs-pallas rows per (config,
+    # precision) with the pallas side fusing bytes away even at the
+    # selftest shape (the flagship 1.15x floors gate the full run).
+    tail = out["results"]["opt_tail"]
+    tail_rows = {
+        (r["config"], r["precision"], r["opt_impl"])
+        for r in tail["update"]
+    }
+    assert tail_rows == {
+        (c, p, i)
+        for c in ("mlp", "lstm")
+        for p in ("f32", "bf16_train")
+        for i in ("xla", "pallas")
+    }
+    for key in (
+        "mlp_update_reduction_bf16", "lstm_update_reduction_bf16",
+        "combined_update_reduction_bf16",
+    ):
+        assert tail["reductions"][key] > 1.0
+    assert out["acceptance"]["opt_tail"] == tail["reductions"]
+
+    # Remat-plan matrix (ISSUE 13): {none, all, auto} x precision x K
+    # for the lstm config, each row carrying updates/s AND bytes; the
+    # auto rows record the planner's chosen assignment, and the main
+    # gates (all > none bytes; auto < all) are active even in selftest
+    # (remat_failures ran — ok:true above proves they passed).
+    remat_rows = out["results"]["remat"]["rows"]
+    combos = {
+        (r["remat"], r["precision"], r["k"]) for r in remat_rows
+    }
+    assert combos == {
+        (plan, p, k)
+        for plan in ("none", "all", "auto")
+        for p in ("f32", "bf16_train")
+        for k in (1, 2)
+    }
+    for r in remat_rows:
+        assert r["updates_per_sec"] > 0
+        assert r["bytes_accessed"] is None or r["bytes_accessed"] > 0
+        if r["remat"] == "auto":
+            assert r["plan"]["source"] in ("auto", "fallback")
+            assert "core" in r["plan"]["assignment"]
+    assert out["acceptance"]["remat"]["auto_plans"]
 
     # Telemetry block embedded like the other benches, with the
     # superstep instrumentation populated.

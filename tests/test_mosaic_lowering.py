@@ -97,6 +97,33 @@ def test_pool_bwd_lowers_for_tpu(shape):
     )(x, y, g)
 
 
+@pytest.mark.parametrize("param_dtype", ["f32", "bf16"])
+def test_opt_tail_lowers_for_tpu(param_dtype):
+    """The fused optimizer tail (ops/pallas_opt.py) lowers to Mosaic at
+    the real leaf-shape zoo — odd 1-D biases, non-128 last dims, a
+    trunk-fc-sized matrix that takes the chunked-grid path — in both
+    resident dtypes, momentum on (the widest kernel arity)."""
+    from torchbeast_tpu.ops.pallas_opt import fused_rmsprop_tail
+
+    dt = jnp.bfloat16 if param_dtype == "bf16" else jnp.float32
+    shapes = [(532,), (133, 532), (16, 128), (1,), (3872, 256)]
+    params = {
+        f"leaf{i}": jax.ShapeDtypeStruct(s, dt)
+        for i, s in enumerate(shapes)
+    }
+    grads = params
+    opt = fused_rmsprop_tail(
+        4.8e-4, decay=0.99, eps=0.01, momentum=0.9, max_norm=40.0,
+        param_dtype=param_dtype,
+        state_dtype=jnp.bfloat16 if param_dtype == "bf16" else None,
+        interpret=False,
+    )
+    state = jax.eval_shape(opt.init, params)
+    jax.export.export(
+        jax.jit(opt.update), platforms=["tpu"]
+    )(grads, state, params)
+
+
 def test_auto_block_n_respects_vmem_budget():
     # Trunk stage-1: one batch row's buffers are ~3.7 MB against the
     # 5 MB budget, so the auto choice must be 1; the tiny test shape

@@ -433,3 +433,64 @@ class TestBytesAccounting:
         # K=1 figure, and far above the /K-bug's halved value.
         assert gauge.value() == pytest.approx(k1, rel=0.15)
         assert gauge.value() > 0.75 * k1
+
+
+class TestTransformerBF16Head:
+    """ISSUE 13: the transformer families thread head_dtype — bf16_train
+    no longer silently falls back to bf16-trunk-only (the PR 8 logged
+    exception is gone)."""
+
+    def _tiny_transformer_batch(self, rng, t=4, b=2):
+        return {
+            "frame": rng.integers(0, 256, (t, b) + FRAME, dtype=np.uint8),
+            "reward": rng.standard_normal((t, b)).astype(np.float32),
+            "done": rng.random((t, b)) < 0.2,
+            "last_action": rng.integers(0, A, (t, b)).astype(np.int32),
+        }
+
+    @pytest.mark.parametrize(
+        "family", ["transformer", "pipelined_transformer"]
+    )
+    def test_bf16_head_outputs_stay_f32(self, family):
+        pol = precision_lib.get("bf16_train")
+        model = create_model(
+            family, num_actions=A, dtype=pol.compute_dtype,
+            head_dtype=pol.head_dtype, num_layers=1, d_model=16,
+            num_heads=2, memory_len=4,
+        )
+        assert model.head_dtype == jnp.bfloat16
+        rng = np.random.default_rng(0)
+        batch = self._tiny_transformer_batch(rng)
+        state = model.initial_state(2)
+        params = model.init(
+            {
+                "params": jax.random.PRNGKey(0),
+                "action": jax.random.PRNGKey(1),
+            },
+            batch,
+            state,
+        )
+        (out, _), _ = model.apply(
+            params, batch, state, sample_action=False,
+            mutable=["losses"],
+        )
+        # The head boundary contract: compute bf16, outputs f32 (the
+        # loss side, wire schema, and sampling never see bf16).
+        assert out.policy_logits.dtype == jnp.float32
+        assert out.baseline.dtype == jnp.float32
+
+    def test_driver_threads_transformer_head_dtype(self):
+        """_init_model_and_params under --precision bf16_train builds
+        the transformer with a bf16 head (no fallback branch left)."""
+        from torchbeast_tpu import monobeast
+
+        flags = monobeast.make_parser().parse_args([
+            "--model", "transformer", "--precision", "bf16_train",
+            "--unroll_length", "4", "--batch_size", "2",
+            "--num_actors", "2",
+        ])
+        model, _ = monobeast._init_model_and_params(
+            flags, A, 2, FRAME, init_params=False
+        )
+        assert model.head_dtype == jnp.bfloat16
+        assert model.dtype == jnp.bfloat16

@@ -31,6 +31,7 @@ from torchbeast_tpu.ops import (
     compute_entropy_loss,
     vtrace_policy_losses,
 )
+from torchbeast_tpu.ops.pallas_opt import FusedTailState
 
 
 class HParams(NamedTuple):
@@ -78,6 +79,14 @@ class HParams(NamedTuple):
     # with the torch denominator form): the aggressive optimizer-state
     # compression lever beyond bf16 storage.
     opt_factored: bool = False
+    # Optimizer-tail implementation (--opt_impl): "xla" composes the
+    # optax chain (clip -> torch-RMSprop -> momentum -> LR [-> master
+    # rebase]) and lets XLA fuse it; "pallas" runs the whole tail as
+    # ONE VMEM-resident kernel per leaf chunk (ops/pallas_opt.py —
+    # global-norm finalize, clip, RMSprop/momentum, f32 master write,
+    # bf16 narrowing cast in a single pass; TPU-compiled, interpreted
+    # elsewhere). Identical semantics, pinned by tests/test_pallas_opt.
+    opt_impl: str = "xla"
 
 
 def updates_horizon(hp: HParams) -> int:
@@ -310,7 +319,12 @@ def apply_updates(params, updates, opt_state):
     """optax.apply_updates, resident-aware: when the optimizer is the
     bf16-resident wrapper (its state is a MasterParamsState), `updates`
     IS the new f32 master and the resident params are one narrowing
-    cast per leaf; otherwise the stock optax apply."""
+    cast per leaf; when it is the fused Pallas tail (FusedTailState),
+    `updates` already IS the new resident params — the kernel performed
+    the master write and the narrowing cast in-pass; otherwise the
+    stock optax apply."""
+    if isinstance(opt_state, FusedTailState):
+        return updates
     if isinstance(opt_state, MasterParamsState):
         return jax.tree_util.tree_map(
             lambda nm, p: nm.astype(p.dtype), updates, params
@@ -373,11 +387,39 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
             f"param_dtype must be 'f32' or 'bf16', got "
             f"{hp.param_dtype!r}"
         )
+    if hp.opt_impl not in ("xla", "pallas"):
+        raise ValueError(
+            f"opt_impl must be 'xla' or 'pallas', got {hp.opt_impl!r}"
+        )
     schedule = optax.linear_schedule(
         init_value=hp.learning_rate,
         end_value=0.0,
         transition_steps=updates_horizon(hp),
     )
+    if hp.opt_impl == "pallas":
+        if hp.opt_factored:
+            # The factored row/col estimator needs per-leaf reductions
+            # along matrix axes — a different kernel family, and an
+            # approximation besides; the fused tail keeps exact
+            # torch-RMSprop semantics only.
+            raise ValueError(
+                "--opt_impl pallas does not compose with "
+                "--factored_opt_state (the fused tail implements the "
+                "exact elementwise torch-RMSprop only)"
+            )
+        from torchbeast_tpu.ops.pallas_opt import fused_rmsprop_tail
+
+        return fused_rmsprop_tail(
+            schedule,
+            decay=hp.rmsprop_alpha,
+            eps=hp.rmsprop_eps,
+            momentum=hp.rmsprop_momentum,
+            max_norm=hp.grad_norm_clipping,
+            param_dtype=hp.param_dtype,
+            state_dtype=(
+                jnp.bfloat16 if hp.opt_state_dtype == "bf16" else None
+            ),
+        )
     clip = (
         _clip_by_global_norm_f32(hp.grad_norm_clipping)
         if hp.param_dtype == "bf16"

@@ -30,6 +30,9 @@ class AtariNet(nn.Module):
     # Recurrent-core + policy-head compute dtype (--precision
     # bf16_train sets bfloat16; outputs upcast at the head boundary).
     head_dtype: Any = jnp.float32
+    # Rematerialize the LSTM scan's backward (the `core` stage of the
+    # remat planner, runtime/remat_plan.py; no-op without --use_lstm).
+    core_remat: bool = False
 
     @property
     def core_output_size(self) -> int:
@@ -73,6 +76,7 @@ class AtariNet(nn.Module):
             hidden_size=self.core_output_size,
             num_layers=2,
             dtype=self.head_dtype,
+            remat=self.core_remat,
             name="head",
         )(core_input, inputs["done"], core_state, T, B, sample_action)
 

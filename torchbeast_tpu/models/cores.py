@@ -59,21 +59,39 @@ class LSTMCore(nn.Module):
     casts at use) and the returned core_state is upcast back to f32 at
     the module boundary, so the slot-table/wire/checkpoint state schema
     never changes.
+
+    `remat` rematerializes each scanned step in the backward (nn.remat
+    around the step module, inside nn.scan): only the T carried states
+    are saved and the gate activations recompute — the LSTM-scan lever
+    of the remat planner (runtime/remat_plan.py; `--remat` on the
+    drivers). Forward math is identical either way.
     """
 
     hidden_size: int
     num_layers: int = 1
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, core_input, notdone, core_state):
+        step_cls = (
+            nn.remat(_StackedLSTMStep) if self.remat
+            else _StackedLSTMStep
+        )
         scan = nn.scan(
-            _StackedLSTMStep,
+            step_cls,
             variable_broadcast="params",
             split_rngs={"params": False},
             in_axes=0,
             out_axes=0,
-        )(self.hidden_size, self.num_layers, self.dtype)
+        )(
+            self.hidden_size, self.num_layers, self.dtype,
+            # Pinned to the historical auto-generated scope so the
+            # param tree (and every existing checkpoint) is identical
+            # whether or not the step remats — remat is a backward-pass
+            # schedule, never a parameter change.
+            name="Scan_StackedLSTMStep_0",
+        )
         # Cast the whole carry to the compute dtype so the scanned
         # carry's input/output types agree (a mixed-dtype carry is a
         # lax.scan type error, not a silent promotion).
@@ -121,6 +139,9 @@ class RecurrentPolicyHead(nn.Module):
     logits and baseline upcast before sampling/return, so the loss side
     (f32-accumulate, torchbeast_tpu/precision.py), the wire schema, and
     action sampling see identical dtypes under every policy.
+
+    `remat` threads to the LSTM core's scan (see LSTMCore.remat) — the
+    `core` stage of the remat planner's per-family lattice.
     """
 
     num_actions: int
@@ -128,6 +149,7 @@ class RecurrentPolicyHead(nn.Module):
     hidden_size: int
     num_layers: int
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, core_input, done, core_state, T, B, sample_action):
@@ -139,6 +161,7 @@ class RecurrentPolicyHead(nn.Module):
                 hidden_size=self.hidden_size,
                 num_layers=self.num_layers,
                 dtype=self.dtype,
+                remat=self.remat,
                 name="core",
             )(core_input, notdone, core_state)
             core_output = core_output.reshape(T * B, -1)

@@ -21,6 +21,9 @@ class MLPNet(nn.Module):
     # Recurrent-core + policy-head compute dtype (--precision
     # bf16_train sets bfloat16; outputs upcast at the head boundary).
     head_dtype: Any = jnp.float32
+    # Rematerialize the LSTM scan's backward (the `core` stage of the
+    # remat planner, runtime/remat_plan.py; no-op without --use_lstm).
+    core_remat: bool = False
 
     @property
     def core_size(self) -> int:
@@ -56,6 +59,7 @@ class MLPNet(nn.Module):
             hidden_size=self.core_size,
             num_layers=1,
             dtype=self.head_dtype,
+            remat=self.core_remat,
             name="head",
         )(core_input, inputs["done"], core_state, T, B, sample_action)
 

@@ -60,6 +60,11 @@ class PipelinedMLPNet(nn.Module):
     batch_axis: Optional[str] = None  # composite (data x pipe) mesh: the
     # axis each microbatch's rows shard over (one GPipe per data group)
     dtype: Any = jnp.float32
+    # Recurrent-core + policy-head compute dtype (--precision
+    # bf16_train sets bfloat16; outputs upcast at the head boundary)
+    # and the LSTM-scan remat lever (runtime/remat_plan.py).
+    head_dtype: Any = jnp.float32
+    core_remat: bool = False
 
     @nn.compact
     def __call__(self, inputs, core_state=(), *, sample_action: bool = True):
@@ -138,6 +143,8 @@ class PipelinedMLPNet(nn.Module):
             use_lstm=self.use_lstm,
             hidden_size=d,
             num_layers=1,
+            dtype=self.head_dtype,
+            remat=self.core_remat,
             name="head",
         )(x, inputs["done"], core_state, T, B, sample_action)
 

@@ -119,6 +119,9 @@ class ResNet(nn.Module):
     # trunk; logits/baseline/state upcast at the head boundary).
     head_dtype: Any = jnp.float32
     remat: Any = True  # bool or per-stage tuple, see ResNetBase.remat
+    # Rematerialize the LSTM scan's backward (the `core` stage of the
+    # remat planner, runtime/remat_plan.py; no-op without --use_lstm).
+    core_remat: bool = False
 
     hidden_size: int = 256
     # Opt-in trunk widths. The reference's 16/32/32 (polybeast_learner.py
@@ -151,6 +154,7 @@ class ResNet(nn.Module):
             hidden_size=self.hidden_size,
             num_layers=1,
             dtype=self.head_dtype,
+            remat=self.core_remat,
             name="head",
         )(core_input, inputs["done"], core_state, T, B, sample_action)
 

@@ -220,6 +220,12 @@ class TransformerNet(nn.Module):
     # block input only — trades recompute for activation memory, the
     # lever that fits deep towers / long unrolls in HBM; same policy as
     # models/resnet.py's per-stage remat)
+    # Policy-head compute dtype (--precision bf16_train sets bfloat16:
+    # the final-LayerNorm output and the policy/baseline projections
+    # stay half-width; logits/baseline upcast at the head boundary,
+    # models/cores.RecurrentPolicyHead). Closes the "transformer
+    # families stay bf16-trunk-only" gap PR 8 logged.
+    head_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -312,6 +318,7 @@ class TransformerNet(nn.Module):
             use_lstm=False,
             hidden_size=self.d_model,
             num_layers=1,
+            dtype=self.head_dtype,
             name="head",
         )(core_output, done, (), T, B, sample_action)
         return out, tuple(new_state)

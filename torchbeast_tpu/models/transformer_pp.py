@@ -134,6 +134,9 @@ class PipelinedTransformerNet(nn.Module):
     # (saves the stage input only — the standard memory lever for deep
     # towers; applies to both the pipelined and the sequential path so
     # the parity oracle stays exact)
+    # Policy-head compute dtype (--precision bf16_train sets bfloat16;
+    # same boundary contract as TransformerNet.head_dtype).
+    head_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, inputs, core_state, *, sample_action: bool = True):
@@ -305,6 +308,7 @@ class PipelinedTransformerNet(nn.Module):
             use_lstm=False,
             hidden_size=d,
             num_layers=1,
+            dtype=self.head_dtype,
             name="head",
         )(core_output, done, (), T, B, sample_action)
         return out, new_state

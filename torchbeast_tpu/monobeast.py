@@ -186,12 +186,42 @@ def make_parser():
                              "by N). Composing DP with SP/EP/TP/PP "
                              "lives in the async driver (polybeast).")
     parser.add_argument("--transformer_remat", action="store_true",
-                        help="Rematerialize each transformer block's "
-                             "backward (save block inputs only) — fits "
-                             "deeper towers / longer unrolls in HBM at "
-                             "the cost of recompute (the conv trunk "
-                             "already remats by default, "
-                             "models/resnet.py).")
+                        help="DEPRECATED spelling of --remat with the "
+                             "transformer blocks stage at 'all' "
+                             "(conflicts with an explicit --remat).")
+    parser.add_argument("--remat", default=None,
+                        help="Rematerialization plan over the model's "
+                             "remat-able stages (runtime/remat_plan.py: "
+                             "the ResNet trunk's per-stage none/front/"
+                             "all, the transformer families' block "
+                             "remat, the LSTM scan): 'auto' picks the "
+                             "minimum-recompute plan whose XLA-measured "
+                             "peak fits --hbm_budget_gb; 'all'/'none' "
+                             "force every stage; 'stage0=front,"
+                             "stage1=all,core=none' pins per stage. "
+                             "Default: the static pre-planner defaults "
+                             "(trunk all-remat, transformer per "
+                             "--transformer_remat, LSTM scan saved). "
+                             "The chosen plan is logged and exported "
+                             "as the learner.remat_plan telemetry "
+                             "static.")
+    parser.add_argument("--hbm_budget_gb", type=float, default=0.0,
+                        help="HBM envelope for --remat auto, in GiB "
+                             "covering one live update dispatch "
+                             "(params + optimizer state + staged "
+                             "[K, T+1, B] stack + XLA temps). 0 = the "
+                             "device's reported limit, else the "
+                             "15.75 GiB v5e default.")
+    parser.add_argument("--opt_impl", default="xla",
+                        choices=["xla", "pallas"],
+                        help="Optimizer-tail implementation: 'xla' "
+                             "composes the optax chain; 'pallas' runs "
+                             "grad-clip finalize -> torch-RMSprop/"
+                             "momentum -> f32 master write -> bf16 "
+                             "narrowing cast as ONE VMEM-resident "
+                             "kernel per leaf (ops/pallas_opt.py; "
+                             "TPU-compiled, interpreted elsewhere; "
+                             "identical numerics, pinned by test).")
     parser.add_argument("--overlap_collect", action="store_true",
                         help="Act on params that are one dispatched "
                              "unroll-batch behind the learner head, so "
@@ -299,6 +329,7 @@ def hparams_from_flags(flags) -> learner_lib.HParams:
         opt_state_dtype=policy.opt_state_dtype,
         param_dtype=policy.param_dtype,
         opt_factored=getattr(flags, "factored_opt_state", False),
+        opt_impl=getattr(flags, "opt_impl", "xla"),
     )
 
 
@@ -386,20 +417,12 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
     policy = precision_lib.resolve_flags(flags)
     dtype = policy.compute_dtype
     extra = {}
-    # Families whose recurrent-core/policy-head threads a compute dtype
-    # (models/cores.RecurrentPolicyHead). bf16_train on the others
-    # (transformer/pipelined) still gets bf16 trunk compute + batch/
-    # optimizer compaction; the head simply stays f32.
-    _HEAD_DTYPE_MODELS = ("shallow", "atari", "deep", "resnet", "mlp")
+    # EVERY family threads head_dtype now (ISSUE 13 closed the
+    # transformer gap: models/transformer.py, transformer_pp.py, and
+    # pipelined.py grew the kwarg) — bf16_train no longer silently
+    # falls back to bf16-trunk-only anywhere.
     if policy.head_dtype != jnp.float32:
-        if flags.model in _HEAD_DTYPE_MODELS:
-            extra["head_dtype"] = policy.head_dtype
-        else:
-            logging.getLogger(__name__).info(
-                "--precision %s: model %s has no bf16 head path; the "
-                "recurrent core / policy head stays f32",
-                policy.name, flags.model,
-            )
+        extra["head_dtype"] = policy.head_dtype
     attention_impl = getattr(flags, "attention_impl", "dense")
     if attention_impl != "dense":
         if flags.model != "transformer":
@@ -626,7 +649,8 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
                 "only (the conv trunk already remats by default, "
                 "models/resnet.py `remat`)"
             )
-        extra["remat"] = True
+        # The actual remat kwarg comes from the plan below (the flag is
+        # the deprecated spelling of `--remat` blocks=all).
     trunk_channels = getattr(flags, "trunk_channels", "")
     if trunk_channels:
         if flags.model != "deep":
@@ -647,6 +671,30 @@ def _init_model_and_params(flags, num_actions, batch_size, frame_shape,
     if unmeshed:
         for key in ("mesh", "moe_mesh", "batch_axis"):
             extra.pop(key, None)
+    # Rematerialization plan (--remat, runtime/remat_plan.py): resolves
+    # the per-stage remat kwargs — the static pre-planner defaults when
+    # the flag is unset, or the cost-model auto-tuner against
+    # --hbm_budget_gb. Candidate models for `auto` build UNMESHED (the
+    # mesh only adds sharding constraints; the per-chip envelope is the
+    # conservative planning target) with the same family kwargs.
+    from torchbeast_tpu.runtime import remat_plan as remat_plan_lib
+
+    plan_extra = {
+        k: v for k, v in extra.items()
+        if k not in ("mesh", "moe_mesh", "batch_axis")
+    }
+    plan = remat_plan_lib.resolve_from_flags(
+        flags, hparams_from_flags(flags), num_actions, frame_shape,
+        frame_dtype, policy,
+        build_model=lambda kw: create_model(
+            flags.model, num_actions=num_actions,
+            use_lstm=flags.use_lstm, dtype=dtype,
+            **{**plan_extra, **kw},
+        ),
+    )
+    extra.update(
+        remat_plan_lib.model_kwargs(flags.model, plan.assignment)
+    )
     model = create_model(
         flags.model, num_actions=num_actions, use_lstm=flags.use_lstm,
         dtype=dtype, **extra,
@@ -727,6 +775,13 @@ def train(flags):
                 f"batch_size {flags.batch_size} not divisible by "
                 f"num_learner_devices {n_dev}"
             )
+        if getattr(flags, "opt_impl", "xla") == "pallas":
+            raise ValueError(
+                "--opt_impl pallas does not compose with "
+                "--num_learner_devices > 1 yet (the fused tail is a "
+                "per-chip kernel; its sharded-update story is the "
+                "Sebulba item's)"
+            )
     if flags.xpid is None:
         flags.xpid = "torchbeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
     plogger = FileWriter(
@@ -766,6 +821,13 @@ def train(flags):
     model, params = _init_model_and_params(
         flags, num_actions, B, frame_shape, frame_dtype
     )
+    # The resolved remat plan rides every telemetry line as a static
+    # (same convention as polybeast's acting_path block).
+    from torchbeast_tpu.runtime import remat_plan as remat_plan_lib
+
+    remat_plan = remat_plan_lib.last_plan()
+    if remat_plan is not None:
+        tele.set_static("learner.remat_plan", remat_plan.summary())
     optimizer = learner_lib.make_optimizer(hp)
     opt_state = optimizer.init(params)
 

@@ -182,10 +182,42 @@ def make_parser():
                              "(an `expert` mesh axis; dispatch/combine "
                              "become XLA all-to-alls).")
     parser.add_argument("--transformer_remat", action="store_true",
-                        help="Rematerialize each transformer block's "
-                             "backward (save block inputs only) — the "
-                             "HBM-fit lever for deep towers / long "
-                             "unrolls.")
+                        help="DEPRECATED spelling of --remat with the "
+                             "transformer blocks stage at 'all' "
+                             "(conflicts with an explicit --remat).")
+    parser.add_argument("--remat", default=None,
+                        help="Rematerialization plan over the model's "
+                             "remat-able stages (runtime/remat_plan.py: "
+                             "the ResNet trunk's per-stage none/front/"
+                             "all, the transformer families' block "
+                             "remat, the LSTM scan): 'auto' picks the "
+                             "minimum-recompute plan whose XLA-measured "
+                             "peak fits --hbm_budget_gb; 'all'/'none' "
+                             "force every stage; 'stage0=front,"
+                             "stage1=all,core=none' pins per stage. "
+                             "Default: the static pre-planner defaults "
+                             "(trunk all-remat, transformer per "
+                             "--transformer_remat, LSTM scan saved). "
+                             "The chosen plan is logged and exported "
+                             "as the learner.remat_plan telemetry "
+                             "static.")
+    parser.add_argument("--hbm_budget_gb", type=float, default=0.0,
+                        help="HBM envelope for --remat auto, in GiB "
+                             "covering one live update dispatch "
+                             "(params + optimizer state + staged "
+                             "[K, T+1, B] stack + XLA temps). 0 = the "
+                             "device's reported limit, else the "
+                             "15.75 GiB v5e default.")
+    parser.add_argument("--opt_impl", default="xla",
+                        choices=["xla", "pallas"],
+                        help="Optimizer-tail implementation: 'xla' "
+                             "composes the optax chain; 'pallas' runs "
+                             "grad-clip finalize -> torch-RMSprop/"
+                             "momentum -> f32 master write -> bf16 "
+                             "narrowing cast as ONE VMEM-resident "
+                             "kernel per leaf (ops/pallas_opt.py; "
+                             "TPU-compiled, interpreted elsewhere; "
+                             "identical numerics, pinned by test).")
     parser.add_argument("--tensor_parallel", type=int, default=0,
                         help="Megatron column/row-paired tensor "
                              "parallelism for the transformer over a "
@@ -518,6 +550,23 @@ def train(flags):
                 else None
             ),
         )
+        # The resolved remat plan rides every telemetry line as a
+        # static (same convention as the acting_path block).
+        from torchbeast_tpu.runtime import remat_plan as remat_plan_lib
+
+        remat_plan = remat_plan_lib.last_plan()
+        if remat_plan is not None:
+            tele.set_static("learner.remat_plan", remat_plan.summary())
+        if (
+            getattr(flags, "opt_impl", "xla") == "pallas"
+            and learner_mesh is not None
+        ):
+            raise ValueError(
+                "--opt_impl pallas does not compose with the sharded "
+                "learner meshes yet (the fused tail is a per-chip "
+                "kernel; its sharded-update story is the Sebulba "
+                "item's)"
+            )
         optimizer = learner_lib.make_optimizer(hp)
         opt_state = optimizer.init(params)
 

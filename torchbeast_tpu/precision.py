@@ -179,6 +179,66 @@ def bytes_accessed(jittable, *args) -> Optional[float]:
         return None
 
 
+class MemoryStats(NamedTuple):
+    """XLA-reported memory figures for one jittable signature.
+
+    `bytes_accessed` is the lowered (pre-optimization) cost-analysis
+    traffic figure — dtype-faithful, platform-neutral, CONSERVATIVE for
+    bf16 (module docstring). `argument_bytes`/`output_bytes`/
+    `temp_bytes` come from the compiled module's memory analysis when a
+    compile is possible (None otherwise): `temp_bytes` is XLA's own
+    peak temp-buffer allocation — the activation/workspace footprint the
+    remat planner trades against recompute. `peak_bytes` is the
+    arguments + outputs + temps sum: the HBM envelope one live dispatch
+    of this program needs (params/opt-state/batch are arguments here;
+    callers add anything they keep resident OUTSIDE the dispatch).
+
+    Compiled on the ambient backend: on this chipless container that is
+    XLA:CPU, whose buffer assignment widens bf16 dots to f32 emulation —
+    the reported peak is an UPPER bound for the bf16 policies (the safe
+    direction for a fits-in-budget decision)."""
+
+    bytes_accessed: Optional[float]
+    argument_bytes: Optional[float]
+    output_bytes: Optional[float]
+    temp_bytes: Optional[float]
+    peak_bytes: Optional[float]
+
+
+def memory_stats(jittable, *args, compiled: bool = True) -> MemoryStats:
+    """The `bytes_accessed` machinery extended to temp/peak allocation
+    (the remat planner's budget oracle). `args` may be real arrays or
+    ShapeDtypeStructs. `compiled=False` skips the compile and reports
+    traffic only (cheap: lowering never compiles).
+
+    Never raises: a platform where lowering or compilation is
+    unavailable reports None fields, and callers (the planner) degrade
+    to their documented fallback instead of sinking a run."""
+    accessed = bytes_accessed(jittable, *args)
+    arg_b = out_b = temp_b = peak = None
+    if compiled:
+        try:
+            lower = getattr(jittable, "lower", None)
+            mem = lower(*args).compile().memory_analysis()
+            arg_b = float(mem.argument_size_in_bytes)
+            out_b = float(mem.output_size_in_bytes)
+            temp_b = float(mem.temp_size_in_bytes)
+            # Donation (alias_size) re-uses argument buffers for
+            # outputs; counting both would double the aliased set.
+            peak = arg_b + out_b + temp_b - float(
+                mem.alias_size_in_bytes
+            )
+        except Exception:
+            log.debug("compiled memory analysis failed", exc_info=True)
+    return MemoryStats(
+        bytes_accessed=accessed,
+        argument_bytes=arg_b,
+        output_bytes=out_b,
+        temp_bytes=temp_b,
+        peak_bytes=peak,
+    )
+
+
 def shape_structs(tree):
     """Concrete arrays -> ShapeDtypeStructs (lowering fodder that holds
     no buffers)."""
