@@ -76,6 +76,11 @@ class ActorPool {
     int64_t connects = 0;
     int64_t reconnects = 0;
     int64_t batch_retries = 0;
+    // Sheds absorbed by the in-place retry (ISSUE 14): one per
+    // ShedError received, so the Python fold's serving.resubmitted ==
+    // serving.shed + serving.expired audit is exact on this runtime
+    // too.
+    int64_t shed_resubmits = 0;
     int64_t bytes_up = 0;    // env server -> this process
     int64_t bytes_down = 0;  // actions back out
     // shm doorbell-wait counters (process-wide, csrc/shm.h
@@ -139,6 +144,7 @@ class ActorPool {
     t.connects = connects_.load();
     t.reconnects = reconnect_count_.load();
     t.batch_retries = batch_retries_.load();
+    t.shed_resubmits = shed_resubmits_.load();
     t.bytes_up = bytes_up_.load();
     t.bytes_down = bytes_down_.load();
     t.ring_doorbell_waits =
@@ -371,8 +377,33 @@ class ActorPool {
     }
     ArrayNest agent_state = initial_agent_state;
 
-    auto compute = [this, index](const ArrayNest& env, ArrayNest* state,
-                                 bool advance) {
+    // Shed contract (ISSUE 14): a ShedError from compute() is FLOW
+    // CONTROL — re-submit the SAME request after a jittered backoff,
+    // outside the reconnect budget, so a shed can never retire this
+    // actor or lose the rollout. The backoff starts smaller than the
+    // reconnect one (overload drains in batches, not server-restart
+    // time) and resets after every served request. Counted at catch
+    // time, making the resubmitted == shed + expired audit exact.
+    Backoff shed_backoff(0.05, 1.0);
+    auto abort_shed = [this] { return shutting_down(); };
+    auto shed_compute = [&](ArrayNest inputs) {
+      while (true) {
+        try {
+          ArrayNest result = inference_batcher_->compute(inputs);
+          shed_backoff.reset();
+          return result;
+        } catch (const ShedError&) {
+          shed_resubmits_.fetch_add(1);
+          if (shutting_down())
+            throw QueueStopped("shutdown during shed retry");
+          shed_backoff.sleep(abort_shed);
+        }
+      }
+    };
+
+    auto compute = [this, index, &shed_compute](
+                       const ArrayNest& env, ArrayNest* state,
+                       bool advance) {
       ArrayNest::Dict inputs;
       inputs.emplace("env", env);
       if (use_slots_) {
@@ -380,11 +411,11 @@ class ActorPool {
                                    DType::kI32, static_cast<int32_t>(index))));
         inputs.emplace("advance", ArrayNest(scalar_array<uint8_t>(
                                       DType::kBool, advance ? 1 : 0)));
-        ArrayNest result = inference_batcher_->compute(ArrayNest(inputs));
+        ArrayNest result = shed_compute(ArrayNest(inputs));
         return result.dict().at("outputs");
       }
       inputs.emplace("agent_state", *state);
-      ArrayNest result = inference_batcher_->compute(ArrayNest(inputs));
+      ArrayNest result = shed_compute(ArrayNest(inputs));
       const auto& d = result.dict();
       if (advance) *state = d.at("agent_state");
       return d.at("outputs");
@@ -482,6 +513,7 @@ class ActorPool {
   std::atomic<int64_t> count_{0};
   std::atomic<int64_t> reconnect_count_{0};
   std::atomic<int64_t> batch_retries_{0};
+  std::atomic<int64_t> shed_resubmits_{0};
   std::atomic<int64_t> connects_{0};
   std::atomic<int64_t> dead_{0};  // retired actor loops (live_actors())
   std::atomic<int64_t> bytes_up_{0};

@@ -35,6 +35,7 @@ using tbt::DType;
 
 PyObject* ClosedBatchingQueueError;
 PyObject* AsyncErrorError;
+PyObject* ShedErrorError;
 
 // ---------------------------------------------------------------- dtypes
 // bfloat16 (wire code 12, csrc/array.h kBF16) is a numpy USER dtype
@@ -467,6 +468,10 @@ void set_py_error() {
     PyErr_SetString(ClosedBatchingQueueError, e.what());
   } catch (const tbt::QueueStopped&) {
     PyErr_SetNone(PyExc_StopIteration);
+  } catch (const tbt::ShedError& e) {
+    // Before AsyncError (its base): the typed shed reply must reach
+    // Python as the retryable ShedError, not a generic batch failure.
+    PyErr_SetString(ShedErrorError, e.what());
   } catch (const tbt::AsyncError& e) {
     PyErr_SetString(AsyncErrorError, e.what());
   } catch (const std::invalid_argument& e) {
@@ -712,12 +717,18 @@ PyTypeObject PyBatchType = {
 // --- DynamicBatcher
 int batcher_init(PyDynamicBatcher* self, PyObject* args, PyObject* kwargs) {
   static const char* kwlist[] = {"batch_dim", "minimum_batch_size",
-                                 "maximum_batch_size", "timeout_ms", nullptr};
+                                 "maximum_batch_size", "timeout_ms",
+                                 "shed_max_queue_depth",
+                                 "request_deadline_ms", "slo_target_ms",
+                                 nullptr};
   long long batch_dim = 1, min_bs = 1;
   PyObject *max_bs_obj = Py_None, *timeout_obj = Py_None;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|LLOO",
+  PyObject *shed_depth_obj = Py_None, *deadline_obj = Py_None,
+           *slo_obj = Py_None;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|LLOOOOO",
                                    const_cast<char**>(kwlist), &batch_dim,
-                                   &min_bs, &max_bs_obj, &timeout_obj))
+                                   &min_bs, &max_bs_obj, &timeout_obj,
+                                   &shed_depth_obj, &deadline_obj, &slo_obj))
     return -1;
   try {
     int64_t max_bs = max_bs_obj == Py_None
@@ -726,9 +737,26 @@ int batcher_init(PyDynamicBatcher* self, PyObject* args, PyObject* kwargs) {
     std::optional<int64_t> timeout_ms;
     if (timeout_obj != Py_None)
       timeout_ms = static_cast<int64_t>(PyFloat_AsDouble(timeout_obj));
+    // Admission-gate kwargs (ISSUE 14); None / <= 0 disarm each gate.
+    std::optional<int64_t> shed_depth;
+    if (shed_depth_obj != Py_None) {
+      long long depth = PyLong_AsLongLong(shed_depth_obj);
+      if (depth > 0) shed_depth = depth;
+    }
+    std::optional<double> deadline_ms;
+    if (deadline_obj != Py_None) {
+      double v = PyFloat_AsDouble(deadline_obj);
+      if (v > 0) deadline_ms = v;
+    }
+    std::optional<double> slo_ms;
+    if (slo_obj != Py_None) {
+      double v = PyFloat_AsDouble(slo_obj);
+      if (v > 0) slo_ms = v;
+    }
     if (PyErr_Occurred()) return -1;
     self->batcher = std::make_shared<tbt::DynamicBatcher>(
-        batch_dim, min_bs, max_bs, timeout_ms);
+        batch_dim, min_bs, max_bs, timeout_ms, shed_depth, deadline_ms,
+        slo_ms);
     return 0;
   } catch (...) {
     set_py_error();
@@ -784,19 +812,27 @@ PyObject* batcher_telemetry(PyDynamicBatcher* self, PyObject*) {
   tbt::HistSnapshot wait = telemetry->request_wait_s.snapshot(true);
   tbt::HistSnapshot rtt = telemetry->request_rtt_s.snapshot(true);
   tbt::HistSnapshot sizes = telemetry->batch_size.snapshot(true);
+  tbt::HistSnapshot delay = telemetry->queue_delay_s.snapshot(true);
   PyObject* wait_py = hist_to_py(wait);
   PyObject* rtt_py = wait_py ? hist_to_py(rtt) : nullptr;
   PyObject* sizes_py = rtt_py ? hist_to_py(sizes) : nullptr;
-  if (!sizes_py) {
+  PyObject* delay_py = sizes_py ? hist_to_py(delay) : nullptr;
+  if (!delay_py) {
     Py_XDECREF(wait_py);
     Py_XDECREF(rtt_py);
+    Py_XDECREF(sizes_py);
     return nullptr;
   }
   return Py_BuildValue(
-      "{s:L,s:L,s:N,s:N,s:N}", "batches",
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:N,s:N,s:N,s:N}", "batches",
       static_cast<long long>(telemetry->batches.load()), "rows",
-      static_cast<long long>(telemetry->rows.load()), "request_wait_s",
-      wait_py, "request_rtt_s", rtt_py, "batch_size", sizes_py);
+      static_cast<long long>(telemetry->rows.load()), "admitted",
+      static_cast<long long>(telemetry->admitted.load()), "shed",
+      static_cast<long long>(telemetry->shed.load()), "expired",
+      static_cast<long long>(telemetry->expired.load()), "slo_breaches",
+      static_cast<long long>(telemetry->slo_breaches.load()),
+      "request_wait_s", wait_py, "request_rtt_s", rtt_py, "batch_size",
+      sizes_py, "queue_delay_s", delay_py);
 }
 
 // Drain the sampled (enqueued, batched, replied) stamp triples (ISSUE
@@ -1120,11 +1156,12 @@ PyObject* pool_chaos_corrupt_ring(PyActorPool* self, PyObject* args,
 PyObject* pool_telemetry(PyActorPool* self, PyObject*) {
   tbt::ActorPool::Telemetry t = self->pool->telemetry();
   return Py_BuildValue(
-      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L}", "env_steps",
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L}", "env_steps",
       static_cast<long long>(t.env_steps), "connects",
       static_cast<long long>(t.connects), "reconnects",
       static_cast<long long>(t.reconnects), "batch_retries",
-      static_cast<long long>(t.batch_retries), "bytes_up",
+      static_cast<long long>(t.batch_retries), "shed_resubmits",
+      static_cast<long long>(t.shed_resubmits), "bytes_up",
       static_cast<long long>(t.bytes_up), "bytes_down",
       static_cast<long long>(t.bytes_down), "ring_doorbell_waits",
       static_cast<long long>(t.ring_doorbell_waits), "ring_recheck_wakeups",
@@ -1679,6 +1716,30 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
       "_tbt_core.ClosedBatchingQueue", PyExc_RuntimeError, nullptr);
   AsyncErrorError =
       PyErr_NewException("_tbt_core.AsyncError", PyExc_RuntimeError, nullptr);
+  // ShedError bases: the C++ AsyncError twin AND (when importable) the
+  // Python runtime's typed ShedError, so `except ShedError` in
+  // torchbeast_tpu code catches sheds from either runtime with one
+  // clause. The extension stays importable standalone (tests build it
+  // without the package on sys.path) — the extra base is best-effort.
+  {
+    PyObject* bases = nullptr;
+    PyObject* mod = PyImport_ImportModule("torchbeast_tpu.runtime.errors");
+    if (mod) {
+      PyObject* py_shed = PyObject_GetAttrString(mod, "ShedError");
+      Py_DECREF(mod);
+      if (py_shed) {
+        bases = PyTuple_Pack(2, AsyncErrorError, py_shed);
+        Py_DECREF(py_shed);
+      }
+    }
+    if (!bases) {
+      PyErr_Clear();
+      bases = PyTuple_Pack(1, AsyncErrorError);
+    }
+    ShedErrorError =
+        PyErr_NewException("_tbt_core.ShedError", bases, nullptr);
+    Py_XDECREF(bases);
+  }
 
   Py_INCREF(&PyBatchingQueueType);
   Py_INCREF(&PyBatchType);
@@ -1697,5 +1758,11 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
                      reinterpret_cast<PyObject*>(&PyEnvServerType));
   PyModule_AddObject(module, "ClosedBatchingQueue", ClosedBatchingQueueError);
   PyModule_AddObject(module, "AsyncError", AsyncErrorError);
+  PyModule_AddObject(module, "ShedError", ShedErrorError);
+  // Extension API generation (runtime/native.py REQUIRED_API_VERSION):
+  // 1 = the ISSUE 14 shed protocol. The default-on native runtime
+  // refuses stale builds instead of silently serving without
+  // admission control.
+  PyModule_AddIntConstant(module, "API_VERSION", 1);
   return module;
 }

@@ -99,6 +99,16 @@ struct BatcherTelemetry {
   HistAccum batch_size;
   HistAccum request_wait_s;  // enqueue -> picked into a batch
   HistAccum request_rtt_s;   // enqueue -> outputs distributed
+  // Admission-gate accounting (ISSUE 14): same semantics as the Python
+  // serving/admission.py series the driver folds these into —
+  // admitted (accepted at enqueue), shed (rejected at the depth
+  // bound), expired (deadline passed in-queue, failed at dequeue),
+  // slo_breaches (served RTT above the SLO target).
+  std::atomic<int64_t> admitted{0};
+  std::atomic<int64_t> shed{0};
+  std::atomic<int64_t> expired{0};
+  std::atomic<int64_t> slo_breaches{0};
+  HistAccum queue_delay_s;  // enqueue -> dequeue, served AND expired
   // Sampled per-request spans (ISSUE 12): 1-in-kTraceEvery computes
   // records its (enqueued, batched, replied) steady-clock stamps here;
   // the driver drains them each monitor tick and folds them into
@@ -120,6 +130,16 @@ class QueueStopped : public std::runtime_error {
 class AsyncError : public std::runtime_error {
  public:
   using std::runtime_error::runtime_error;
+};
+// The typed shed reply (ISSUE 14; Python twin:
+// torchbeast_tpu/runtime/errors.ShedError). Derives from AsyncError so
+// a catch site that only knows the base still treats a shed as an
+// inference-side condition, but the actor pool catches EXACTLY this
+// type and re-submits the same env step after backoff — a shed is flow
+// control, never a retired actor or a lost rollout.
+class ShedError : public AsyncError {
+ public:
+  using AsyncError::AsyncError;
 };
 
 // Concatenate structurally-equal nests leaf-wise along batch_dim.
@@ -340,6 +360,9 @@ class DynamicBatcher {
     // Trace sampling (ISSUE 12): this request records a full span.
     bool traced = false;
     std::chrono::steady_clock::time_point batched_at;
+    // Deadline gate (ISSUE 14): absolute expiry; unset when admission
+    // control is disarmed.
+    std::optional<std::chrono::steady_clock::time_point> deadline;
   };
 
   class Batch {
@@ -368,6 +391,10 @@ class DynamicBatcher {
 
     const ArrayNest& inputs() const { return inputs_; }
 
+    void set_slo_target(std::optional<double> target_s) {
+      slo_target_s_ = target_s;
+    }
+
     void set_outputs(const ArrayNest& outputs) {
       if (outputs_set_) throw std::runtime_error("set_outputs called twice");
       int64_t expected = size();
@@ -389,8 +416,14 @@ class DynamicBatcher {
           return slice(a, batch_dim_, start, count);
         });
         if (telemetry_) {
-          telemetry_->request_rtt_s.observe(
-              std::chrono::duration<double>(now - r.enqueued_at).count());
+          double rtt =
+              std::chrono::duration<double>(now - r.enqueued_at).count();
+          telemetry_->request_rtt_s.observe(rtt);
+          // SLO breach accounting (ISSUE 14): the C++ pool has no
+          // Python-side request path, so served-RTT-over-target is
+          // counted here and folded into slo.rtt_breaches.
+          if (slo_target_s_ && rtt > *slo_target_s_)
+            telemetry_->slo_breaches.fetch_add(1);
           if (r.traced) {
             auto to_s = [](std::chrono::steady_clock::time_point tp) {
               return std::chrono::duration<double>(tp.time_since_epoch())
@@ -421,15 +454,30 @@ class DynamicBatcher {
     ArrayNest inputs_;
     std::vector<Request> requests_;
     std::shared_ptr<BatcherTelemetry> telemetry_;
+    std::optional<double> slo_target_s_;
     bool outputs_set_ = false;
   };
 
+  // Admission control (ISSUE 14): `shed_max_queue_depth` bounds the
+  // queued-request count at enqueue (over it -> ShedError at the
+  // caller), `deadline_ms` arms the dequeue-side expiry, and
+  // `slo_target_ms` arms served-RTT breach counting. All optional —
+  // disarmed, the batcher behaves exactly as before.
   DynamicBatcher(int64_t batch_dim, int64_t min_batch_size,
-                 int64_t max_batch_size, std::optional<int64_t> timeout_ms)
+                 int64_t max_batch_size, std::optional<int64_t> timeout_ms,
+                 std::optional<int64_t> shed_max_queue_depth = std::nullopt,
+                 std::optional<double> deadline_ms = std::nullopt,
+                 std::optional<double> slo_target_ms = std::nullopt)
       : batch_dim_(batch_dim),
         queue_(batch_dim, min_batch_size, max_batch_size, timeout_ms,
                std::nullopt, /*check_inputs=*/true),
-        telemetry_(std::make_shared<BatcherTelemetry>()) {}
+        telemetry_(std::make_shared<BatcherTelemetry>()),
+        shed_max_queue_depth_(shed_max_queue_depth),
+        deadline_ms_(deadline_ms),
+        slo_target_ms_(slo_target_ms) {
+    if (shed_max_queue_depth_ && *shed_max_queue_depth_ < 1)
+      throw std::invalid_argument("shed_max_queue_depth must be >= 1");
+  }
 
   int64_t size() const { return queue_.size(); }
   bool is_closed() const { return queue_.is_closed(); }
@@ -442,8 +490,27 @@ class DynamicBatcher {
     int64_t rows = inputs.front().dim(batch_dim_);
     if (rows > queue_.max_batch_size())
       throw std::invalid_argument("compute() exceeds maximum_batch_size");
+    // Enqueue-side admission gate (ISSUE 14): shed while the queue is
+    // at the depth bound — the caller's retry path re-submits after
+    // backoff. Racy-by-design against concurrent producers (the bound
+    // is flow control, not an invariant); counted BEFORE the throw so
+    // shed accounting is exact.
+    if (shed_max_queue_depth_ && queue_.size() >= *shed_max_queue_depth_) {
+      telemetry_->shed.fetch_add(1);
+      throw ShedError(
+          "admission gate: inference queue at its depth bound; "
+          "re-submit after backoff");
+    }
+    // admitted counts only under an armed gate, mirroring the Python
+    // AdmissionController (disarmed runs report no serving.* series).
+    if (shed_max_queue_depth_ || deadline_ms_)
+      telemetry_->admitted.fetch_add(1);
     Request req{std::make_shared<std::promise<ArrayNest>>(), rows,
                 std::chrono::steady_clock::now()};
+    if (deadline_ms_)
+      req.deadline = req.enqueued_at +
+                     std::chrono::microseconds(
+                         static_cast<int64_t>(*deadline_ms_ * 1000.0));
     // Sampled tracing (1-in-kTraceEvery, like the Python pool): N
     // racing actors may interleave ticks, which only shifts WHICH
     // request gets traced.
@@ -460,20 +527,88 @@ class DynamicBatcher {
 
   // Blocks; throws QueueStopped when closed.
   std::unique_ptr<Batch> get_batch() {
-    auto [inputs, requests] = queue_.dequeue_many();
-    auto now = std::chrono::steady_clock::now();
-    int64_t rows = 0;
-    for (Request& r : requests) {
-      rows += r.rows;
-      r.batched_at = now;
-      telemetry_->request_wait_s.observe(
-          std::chrono::duration<double>(now - r.enqueued_at).count());
+    while (true) {
+      auto [inputs, requests] = queue_.dequeue_many();
+      auto now = std::chrono::steady_clock::now();
+      if (deadline_ms_) {
+        // Dequeue-side deadline gate (ISSUE 14): fail requests that
+        // sat in the queue past their deadline with the typed
+        // ShedError and cut their rows out of the batch (the queue
+        // concatenated them already — re-slice the survivors). A
+        // fully-expired batch loops back for the next one. First pass
+        // marks expired requests (promise reset() after the exception
+        // = the expiry mark); the rebuild pass only runs — and only
+        // moves survivors out — when something actually expired.
+        int64_t n_expired = 0;
+        for (Request& r : requests) {
+          telemetry_->queue_delay_s.observe(
+              std::chrono::duration<double>(now - r.enqueued_at).count());
+          if (r.deadline && now > *r.deadline) {
+            ++n_expired;
+            if (r.traced) {
+              // A sampled request shed here must still land in the
+              // trace export (the Python twin stamps "shed" and
+              // finishes): record (enqueued, shed, shed) — the batch
+              // stage shows the queue wait that killed it, the reply
+              // stage is zero-length. Dropping it would blind trace
+              // analysis to exactly the overload traffic the gate
+              // exists to observe.
+              auto to_s = [](std::chrono::steady_clock::time_point tp) {
+                return std::chrono::duration<double>(tp.time_since_epoch())
+                    .count();
+              };
+              std::lock_guard<std::mutex> lock(telemetry_->trace_mu);
+              if (telemetry_->trace_spans.size() < kTraceSpanCap)
+                telemetry_->trace_spans.push_back(
+                    {to_s(r.enqueued_at), to_s(now), to_s(now)});
+            }
+            r.promise->set_exception(std::make_exception_ptr(ShedError(
+                "deadline expired in queue: the reply would land past "
+                "the request's deadline budget; re-submit after "
+                "backoff")));
+            r.promise.reset();
+          }
+        }
+        if (n_expired > 0) {
+          telemetry_->expired.fetch_add(n_expired);
+          std::vector<Request> live;
+          std::vector<std::pair<int64_t, int64_t>> live_spans;  // start,count
+          int64_t offset = 0;
+          for (Request& r : requests) {
+            int64_t start = offset;
+            offset += r.rows;
+            if (!r.promise) continue;  // expired above
+            live_spans.emplace_back(start, r.rows);
+            live.push_back(std::move(r));
+          }
+          if (live.empty()) continue;
+          inputs = inputs.map([&](const Array& a) {
+            std::vector<Array> pieces;
+            pieces.reserve(live_spans.size());
+            for (const auto& [start, count] : live_spans)
+              pieces.push_back(slice(a, batch_dim_, start, count));
+            return concatenate(pieces, batch_dim_);
+          });
+          requests = std::move(live);
+        }
+      }
+      // (Disarmed, queue delay == request_wait_s below; the serving.*
+      // delay series only exists under an armed gate, like Python.)
+      int64_t rows = 0;
+      for (Request& r : requests) {
+        rows += r.rows;
+        r.batched_at = now;
+        telemetry_->request_wait_s.observe(
+            std::chrono::duration<double>(now - r.enqueued_at).count());
+      }
+      telemetry_->batches.fetch_add(1);
+      telemetry_->rows.fetch_add(rows);
+      telemetry_->batch_size.observe(static_cast<double>(rows));
+      auto batch = std::make_unique<Batch>(batch_dim_, std::move(inputs),
+                                           std::move(requests), telemetry_);
+      if (slo_target_ms_) batch->set_slo_target(*slo_target_ms_ / 1000.0);
+      return batch;
     }
-    telemetry_->batches.fetch_add(1);
-    telemetry_->rows.fetch_add(rows);
-    telemetry_->batch_size.observe(static_cast<double>(rows));
-    return std::make_unique<Batch>(batch_dim_, std::move(inputs),
-                                   std::move(requests), telemetry_);
   }
 
   void close() {
@@ -488,6 +623,9 @@ class DynamicBatcher {
   int64_t batch_dim_;
   BatchingQueue<Request> queue_;
   std::shared_ptr<BatcherTelemetry> telemetry_;
+  const std::optional<int64_t> shed_max_queue_depth_;
+  const std::optional<double> deadline_ms_;
+  const std::optional<double> slo_target_ms_;
 };
 
 }  // namespace tbt

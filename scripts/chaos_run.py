@@ -1,13 +1,14 @@
 #!/usr/bin/env python
-"""Chaos acceptance harness (ISSUE 6): run polybeast under a seeded
-multi-fault plan and PROVE recovery, not just survival.
+"""Chaos acceptance harness (ISSUE 6, scaled + overload-aware in
+ISSUE 14): run polybeast under a seeded multi-fault plan and PROVE
+recovery, not just survival.
 
 Two in-process polybeast runs on the same config:
 
   1. baseline — fault-free,
-  2. chaos    — a seeded FaultPlan firing >=3 fault classes mid-run
-                (env-server SIGKILL, transport sever, state-table
-                poison by default),
+  2. chaos    — a seeded FaultPlan firing >=4 fault classes mid-run
+                (env-server SIGKILL x scale, transport sever x scale,
+                state-table poison, learner stall by default),
 
 then assert:
 
@@ -16,17 +17,33 @@ then assert:
     fault-free baseline within --return_tol,
   - recovery telemetry counters EXACTLY equal the injected fault
     counts (server restarts == SIGKILLs, actor reconnects ==
-    SIGKILLs + severs with the 1:1 actor/server topology, inference
-    restarts == table rebuilds == poisons),
+    SIGKILLs x actors-per-server + severs, inference restarts ==
+    table rebuilds == poisons),
+  - load shedding is real AND lossless: with the admission gate armed
+    (--request_deadline_ms) and a learner stall planned, the serving
+    tier sheds (serving.shed + serving.expired > 0) and every shed was
+    re-submitted (serving.resubmitted == shed + expired — a shed is
+    never a lost rollout),
   - nothing leaked: no live child processes, no new /dev/shm segments.
 
+`--scale N` multiplies the actor/server fleet AND the fault plan
+together (N SIGKILLs on distinct servers, N severs on distinct actors
+disjoint from the killed servers' actors, staggered triggers), so the
+10x acceptance run (scale 10 on the 16-actor/8-server base = 160/80)
+exercises the same exact accounting as the CI selftest.
+
 `--selftest` is the CPU CI gate (Mock env, short run, schema-pinned in
-tests/test_bench_scripts.py); the default mode is the Catch acceptance
-run whose artifact is committed under benchmarks/artifacts/.
+tests/test_bench_scripts.py; scripts/check.sh runs it at --scale 2);
+the default mode is the Catch acceptance run whose artifact is
+committed under benchmarks/artifacts/.
 
 Usage:
   python scripts/chaos_run.py --selftest
+  python scripts/chaos_run.py --selftest --scale 2
   python scripts/chaos_run.py --out benchmarks/artifacts/chaos_run.json
+  python scripts/chaos_run.py --native --scale 10 --num_servers 8 \\
+      --num_actors 16 --batch_size 16 --request_deadline_ms 2000 \\
+      --out benchmarks/artifacts/chaos_run_10x.json
 """
 
 import argparse
@@ -58,7 +75,38 @@ def parse_args(argv=None):
                         "then ride the pool's C++ FaultHooks instead "
                         "of the Python FaultingTransport wrap — the "
                         "same plan, the same exact accounting "
-                        "(ISSUE 12).")
+                        "(ISSUE 12). Without this flag both legs pin "
+                        "--no_native_runtime: the harness's "
+                        "interposition accounting must know which "
+                        "runtime it audits, not inherit the driver "
+                        "default.")
+    p.add_argument("--scale", type=int, default=1,
+                   help="Scale knob (ISSUE 14): multiplies "
+                        "num_actors/num_servers AND the fault plan "
+                        "together — scale N plans N env-server "
+                        "SIGKILLs (distinct servers) and N transport "
+                        "severs (distinct actors, disjoint from the "
+                        "killed servers' actors), staggered across "
+                        "the run. Requires num_servers >= 2*scale "
+                        "so the two target sets stay disjoint.")
+    # beastlint: disable=FLAG-PARITY  armed by default here: the chaos harness's whole point is exercising the shed path; the driver default (0 = off) preserves pre-ISSUE-14 behavior
+    p.add_argument("--request_deadline_ms", type=float, default=300.0,
+                   help="Forwarded to both legs: arms the admission "
+                        "gate so the planned learner_stall produces "
+                        "real sheds (asserted > 0). 0 disarms it "
+                        "(and the shed assertions).")
+    p.add_argument("--stall_s", type=float, default=3.0,
+                   help="learner_stall fault duration: how long the "
+                        "learner AND serving threads freeze (the "
+                        "shared-chip overload model). Must exceed "
+                        "request_deadline_ms for deterministic "
+                        "expiry sheds.")
+    # Replica serving knobs forwarded to BOTH legs verbatim (same
+    # type/default as polybeast, FLAG-PARITY-checked): 0 = central
+    # serving only; set --replica_refresh_updates to chaos-test the
+    # snapshot/lag machinery too (Python runtime only).
+    p.add_argument("--replica_refresh_updates", type=int, default=0)
+    p.add_argument("--max_policy_lag", type=int, default=20)
     # Resilience knobs forwarded to BOTH legs: re-declared here (same
     # type/default as polybeast) so beastlint FLAG-PARITY keeps the
     # chaos harness from drifting away from the driver's resilience
@@ -125,21 +173,37 @@ def parse_args(argv=None):
 
 
 def build_plan(args) -> dict:
-    """>=3 fault classes, step-triggered at fractions of the run so the
-    pipeline is warm at injection time. With num_actors == num_servers
-    every server feeds exactly one actor, which is what makes the
-    reconnect accounting exact (1 reconnect per SIGKILL, 1 per sever)."""
-    t = args.total_steps
-    return {
-        "seed": args.seed,
-        "faults": [
-            {"kind": "env_server_sigkill", "at_step": int(t * 0.2),
-             "target": 0},
-            {"kind": "transport_sever", "at_step": int(t * 0.45),
-             "target": args.num_actors - 1},
-            {"kind": "state_table_poison", "at_step": int(t * 0.7)},
-        ],
-    }
+    """>=4 fault classes, step-triggered at fractions of the run so the
+    pipeline is warm at injection time, SCALED with --scale (the plan
+    grows with the fleet, ISSUE 14).
+
+    The plan-scaling rule (schema-pinned in tests/test_bench_scripts):
+    scale N plans N `env_server_sigkill` on servers 0..N-1 and N
+    `transport_sever` on actors N..2N-1 — actor i connects to server
+    i % num_servers, so with num_servers >= 2N the severed actors'
+    servers are never killed and each fault maps to EXACTLY one
+    recovery: reconnects == kills * (num_actors // num_servers) +
+    severs. One state-table poison and one learner_stall (duration
+    --stall_s) round out the classes; triggers stagger across
+    [0.15, 0.65] of the run so recoveries do not overlap their own
+    class's next injection."""
+    t, n = args.total_steps, args.scale
+    faults = []
+    for i in range(n):
+        faults.append({
+            "kind": "env_server_sigkill",
+            "at_step": int(t * (0.15 + 0.4 * i / n)),
+            "target": i,
+        })
+        faults.append({
+            "kind": "transport_sever",
+            "at_step": int(t * (0.25 + 0.4 * i / n)),
+            "target": n + i,
+        })
+    faults.append({"kind": "learner_stall", "at_step": int(t * 0.5),
+                   "duration_s": args.stall_s})
+    faults.append({"kind": "state_table_poison", "at_step": int(t * 0.7)})
+    return {"seed": args.seed, "faults": faults}
 
 
 def make_flags(args, savedir, xpid, chaos_plan_path=None):
@@ -169,9 +233,17 @@ def make_flags(args, savedir, xpid, chaos_plan_path=None):
         "--inference_restart_budget", str(args.inference_restart_budget),
         "--max_actor_reconnects", str(args.max_actor_reconnects),
         "--learner_stall_timeout_s", str(args.learner_stall_timeout_s),
+        "--request_deadline_ms", str(args.request_deadline_ms),
+        "--replica_refresh_updates", str(args.replica_refresh_updates),
+        "--max_policy_lag", str(args.max_policy_lag),
     ]
+    # The runtime is pinned explicitly either way: the harness's fault
+    # interposition accounting (FaultHooks vs FaultingTransport) must
+    # audit the runtime it CHOSE, not inherit the driver's default.
     if getattr(args, "native", False):
         argv += ["--native_runtime"]
+    else:
+        argv += ["--no_native_runtime"]
     if chaos_plan_path is not None:
         argv += ["--chaos_plan", chaos_plan_path]
     return polybeast.make_parser().parse_args(argv)
@@ -244,16 +316,53 @@ def main(argv=None) -> int:
         args.num_servers = args.num_actors = 2
         args.batch_size = 2
         args.return_tol = 1e-6
+        # Short stall, same contract: it still exceeds the deadline so
+        # expiry sheds fire deterministically.
+        args.stall_s = min(args.stall_s, 1.5)
+
+    if args.scale < 1:
+        print("--scale must be >= 1", file=sys.stderr)
+        return 2
+    # The scale knob multiplies the fleet AND the plan together.
+    args.num_servers *= args.scale
+    args.num_actors *= args.scale
+    if args.num_actors % args.num_servers != 0:
+        print(
+            f"num_actors {args.num_actors} must be a multiple of "
+            f"num_servers {args.num_servers} (uniform actors-per-server "
+            "is what keeps reconnect accounting exact)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.num_servers < 2 * args.scale:
+        print(
+            f"num_servers {args.num_servers} must be >= 2*scale "
+            f"{2 * args.scale} (kill and sever target sets must stay "
+            "disjoint for exact accounting)",
+            file=sys.stderr,
+        )
+        return 2
+    if (
+        args.request_deadline_ms > 0
+        and args.stall_s * 1000 <= args.request_deadline_ms
+    ):
+        print(
+            "--stall_s must exceed --request_deadline_ms or the stall "
+            "cannot produce deterministic expiry sheds",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.native:
-        from torchbeast_tpu.runtime.native import available
+        # gap_reason, not available(): a stale extension would make the
+        # driver fall back to the Python pool and this harness would
+        # silently audit the WRONG runtime into a "native": true
+        # artifact.
+        from torchbeast_tpu.runtime.native import gap_reason
 
-        if not available():
-            print(
-                "chaos_run --native needs the _tbt_core extension "
-                "(bash scripts/build_native.sh)",
-                file=sys.stderr,
-            )
+        reason = gap_reason()
+        if reason is not None:
+            print(f"chaos_run --native: {reason}", file=sys.stderr)
             return 2
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -320,6 +429,10 @@ def main(argv=None) -> int:
     n_kill = plan_counts.get("env_server_sigkill", 0)
     n_sever = plan_counts.get("transport_sever", 0)
     n_poison = plan_counts.get("state_table_poison", 0)
+    # Uniform fan-in (validated above): a killed server drops ALL its
+    # actors' streams, so each SIGKILL accounts for actors-per-server
+    # reconnects (1 at the classic 1:1 topology).
+    actors_per_server = args.num_actors // args.num_servers
     counters = chaos["counters"]
     expected = {
         # every chaos.<kind>.injected counter must match the plan...
@@ -328,11 +441,12 @@ def main(argv=None) -> int:
             for kind, n in plan_counts.items()
         },
         # ...and each fault class maps to its recovery counter exactly:
-        # 1 respawn per SIGKILL, 1 reconnect per SIGKILL (1:1
-        # actor/server topology) + 1 per sever, 1 rebuild+restart per
-        # poison.
+        # 1 respawn per SIGKILL, actors-per-server reconnects per
+        # SIGKILL + 1 per sever, 1 rebuild+restart per poison.
         "recovery.server_restarts": n_kill,
-        "recovery.actor_reconnects": n_kill + n_sever,
+        "recovery.actor_reconnects": (
+            n_kill * actors_per_server + n_sever
+        ),
         "recovery.inference_restarts": n_poison,
         "recovery.table_rebuilds": n_poison,
     }
@@ -340,6 +454,25 @@ def main(argv=None) -> int:
         got = int(counters.get(name, 0))
         if got != want:
             failures.append(f"counter {name}: got {got}, want {want}")
+
+    # -- load shedding: real AND lossless (ISSUE 14) ----------------------
+    serving = {
+        key: int(counters.get(f"serving.{key}", 0))
+        for key in ("admitted", "shed", "expired", "resubmitted")
+    }
+    shed_total = serving["shed"] + serving["expired"]
+    n_stall = plan_counts.get("learner_stall", 0)
+    if serving["resubmitted"] != shed_total:
+        failures.append(
+            f"shed accounting broken: resubmitted {serving['resubmitted']}"
+            f" != shed {serving['shed']} + expired {serving['expired']} "
+            "(a shed was a lost request)"
+        )
+    if args.request_deadline_ms > 0 and n_stall > 0 and shed_total == 0:
+        failures.append(
+            "learner stall injected with the admission gate armed but "
+            "nothing was shed (the overload path was not exercised)"
+        )
 
     # -- no leaks ----------------------------------------------------------
     for run in (baseline, chaos):
@@ -358,12 +491,17 @@ def main(argv=None) -> int:
         "bench": "chaos_run",
         "selftest": bool(args.selftest),
         "native": bool(args.native),
+        "scale": args.scale,
+        "num_actors": args.num_actors,
+        "num_servers": args.num_servers,
+        "request_deadline_ms": args.request_deadline_ms,
         "ok": not failures,
         "failures": failures,
         "env": args.env,
         "total_steps": args.total_steps,
         "plan": plan_dict,
         "expected_counters": expected,
+        "serving": serving,
         "results": {"baseline": baseline, "chaos": chaos},
         "telemetry": telemetry.telemetry_block(),
     }

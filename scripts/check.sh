@@ -48,4 +48,9 @@ python -m torchbeast_tpu.analysis --selftest
 echo "== check: protocol model check (shm ring + doorbell)"
 python -m torchbeast_tpu.analysis --check-protocol
 
+if [[ "$FAST" -eq 0 ]]; then
+    echo "== check: chaos selftest, scaled (x2 fleet + x2 fault plan, shed audit)"
+    JAX_PLATFORMS=cpu python scripts/chaos_run.py --selftest --scale 2
+fi
+
 echo "== check: PASS"

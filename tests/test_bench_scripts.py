@@ -326,10 +326,12 @@ def test_learner_bench_selftest(tmp_path):
 
 def test_chaos_run_selftest(tmp_path):
     """chaos_run --selftest: two short poly runs (fault-free + seeded
-    3-class fault plan) with the acceptance contract schema-pinned —
-    completion, exact recovery-counter accounting, return parity, and
-    the no-leak sweep — so the chaos harness can't rot between
-    acceptance rounds (ISSUE 6)."""
+    4-class fault plan) with the acceptance contract schema-pinned —
+    completion, exact recovery-counter accounting, return parity, REAL
+    load shedding under the injected learner stall with the
+    no-lost-rollout audit (resubmitted == shed + expired), and the
+    no-leak sweep — so the chaos harness can't rot between acceptance
+    rounds (ISSUE 6, serving tier ISSUE 14)."""
     out_json = tmp_path / "chaos_run.json"
     proc = _run([
         "scripts/chaos_run.py", "--selftest", "--out", str(out_json),
@@ -339,15 +341,26 @@ def test_chaos_run_selftest(tmp_path):
     assert out["bench"] == "chaos_run"
     assert out["selftest"] is True
     assert out["ok"] is True and out["failures"] == []
+    assert out["scale"] == 1
 
-    # >= 3 fault classes, every one injected exactly as planned.
+    # >= 4 fault classes, every one injected exactly as planned.
     kinds = {f["kind"] for f in out["plan"]["faults"]}
     assert {
         "env_server_sigkill", "transport_sever", "state_table_poison",
+        "learner_stall",
     } <= kinds
     chaos = out["results"]["chaos"]
     assert chaos["chaos"]["pending"] == []
     assert chaos["chaos"]["abandoned"] == []
+
+    # The serving-tier audit (ISSUE 14): the learner stall produced
+    # real sheds, and every shed was re-submitted — never a lost
+    # rollout.
+    serving = out["serving"]
+    assert set(serving) == {"admitted", "shed", "expired", "resubmitted"}
+    assert serving["shed"] + serving["expired"] > 0
+    assert serving["resubmitted"] == serving["shed"] + serving["expired"]
+    assert serving["admitted"] > 0
 
     # The exact-accounting contract: every expected counter key is
     # present and equal (chaos.<kind>.injected + the recovery mapping).
@@ -373,6 +386,46 @@ def test_chaos_run_selftest(tmp_path):
     _validate_telemetry_block(out["telemetry"])
     saved = json.loads(out_json.read_text())
     assert saved["bench"] == "chaos_run" and saved["ok"] is True
+
+
+def test_chaos_run_plan_scaling_rule():
+    """The --scale plan-scaling rule, pinned WITHOUT a full run: scale
+    N plans N SIGKILLs on servers 0..N-1 and N severs on actors
+    N..2N-1 (actor i serves from server i % num_servers, so with
+    num_servers >= 2N the sever targets' servers are never killed —
+    what keeps reconnect accounting exact), plus exactly one poison
+    and one learner_stall, triggers staggered and strictly inside the
+    run."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+
+    args = chaos_run.parse_args([])
+    args.scale = 3
+    args.num_servers, args.num_actors = 6, 12
+    plan = chaos_run.build_plan(args)
+    by_kind = {}
+    for fault in plan["faults"]:
+        by_kind.setdefault(fault["kind"], []).append(fault)
+    assert len(by_kind["env_server_sigkill"]) == 3
+    assert len(by_kind["transport_sever"]) == 3
+    assert len(by_kind["state_table_poison"]) == 1
+    assert len(by_kind["learner_stall"]) == 1
+    kill_servers = {f["target"] for f in by_kind["env_server_sigkill"]}
+    sever_actors = {f["target"] for f in by_kind["transport_sever"]}
+    assert kill_servers == {0, 1, 2}
+    assert sever_actors == {3, 4, 5}
+    # Disjointness: a severed actor's server is never a killed one.
+    assert not {a % args.num_servers for a in sever_actors} & kill_servers
+    steps = [f["at_step"] for f in plan["faults"]]
+    assert all(0 < s < args.total_steps for s in steps)
+    # Staggered within a class: no two same-class faults share a step.
+    for kind in ("env_server_sigkill", "transport_sever"):
+        kind_steps = [f["at_step"] for f in by_kind[kind]]
+        assert len(set(kind_steps)) == len(kind_steps)
+    assert by_kind["learner_stall"][0]["duration_s"] == args.stall_s
 
 
 def test_vtrace_bench_emits_rows(tmp_path):

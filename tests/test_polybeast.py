@@ -62,6 +62,45 @@ def test_polybeast_train_native_runtime(tmp_path):
 
 
 @pytest.mark.slow
+def test_polybeast_replica_serving(tmp_path):
+    """Replica serving end to end through the driver (ISSUE 14): the
+    learner publishes versioned snapshots, replica threads answer
+    acting requests with policy_lag recorded into the rollout, and the
+    run trains to completion with requests actually served from the
+    replica path."""
+    from torchbeast_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    before = {
+        name: int(reg.counter(name).value())
+        for name in (
+            "serving.replica_requests",
+            "serving.snapshots_published",
+        )
+    }
+    flags = make_flags(
+        tmp_path, xpid="poly-replica", use_lstm=True,
+        no_native_runtime=True, replica_refresh_updates="2",
+        max_policy_lag="50",
+    )
+    stats = polybeast.train(flags)
+    assert stats["step"] >= 60
+    assert np.isfinite(stats["total_loss"])
+    published = (
+        int(reg.counter("serving.snapshots_published").value())
+        - before["serving.snapshots_published"]
+    )
+    replica_served = (
+        int(reg.counter("serving.replica_requests").value())
+        - before["serving.replica_requests"]
+    )
+    assert published >= 2  # v0 + at least one refresh
+    assert replica_served > 0  # requests really went to the replica
+    # The recorded lag histogram saw real observations (0-lag counts).
+    assert reg.histogram("serving.policy_lag").count > 0
+
+
+@pytest.mark.slow
 def test_polybeast_test_mode(tmp_path):
     # Train a checkpoint, then greedy-evaluate it via the poly CLI (the
     # reference's poly test() raises NotImplementedError).

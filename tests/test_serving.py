@@ -1,0 +1,602 @@
+"""Serving-tier contracts (ISSUE 14): admission control + load
+shedding, the shed/retry no-lost-rollout contract, snapshotted policy
+replicas, and the policy-lag recording/degradation machinery.
+
+The load-bearing pins:
+- a shed is NEVER a lost rollout: a deliberately wedged batcher sheds,
+  the actor retries with backoff, and the rollout stream completes
+  bit-identical to the unshed run;
+- `policy_lag` recorded in a reply matches the snapshot version that
+  ACTUALLY served it (version-skew pin);
+- serving.resubmitted == serving.shed + serving.expired, exactly.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchbeast_tpu import telemetry
+from torchbeast_tpu.envs import CountingEnv
+from torchbeast_tpu.resilience.supervisor import PipelineHealth
+from torchbeast_tpu.runtime.actor_pool import ActorPool
+from torchbeast_tpu.runtime.env_server import EnvServer
+from torchbeast_tpu.runtime.errors import ShedError
+from torchbeast_tpu.runtime.inference import inference_loop
+from torchbeast_tpu.runtime.native import import_native
+from torchbeast_tpu.runtime.queues import BatchingQueue, DynamicBatcher
+from torchbeast_tpu.serving import (
+    AdmissionController,
+    PolicySnapshotStore,
+    ReplicaRouter,
+    ReplicaServingHooks,
+)
+
+EPISODE_LEN = 5
+T = 3
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController unit surface
+
+
+def _registry():
+    return telemetry.MetricsRegistry()
+
+
+def test_admission_depth_gate_sheds():
+    reg = _registry()
+    adm = AdmissionController(
+        deadline_ms=1000, max_queue_depth=2, registry=reg
+    )
+    assert adm.admit(0) is not None  # absolute deadline returned
+    assert adm.admit(1) is not None
+    with pytest.raises(ShedError) as e:
+        adm.admit(2)
+    assert not e.value.expired
+    assert adm.counts() == {"admitted": 2, "shed": 1, "expired": 0}
+
+
+def test_admission_deadline_disarmed_returns_none():
+    adm = AdmissionController(
+        deadline_ms=0, max_queue_depth=4, registry=_registry()
+    )
+    assert adm.deadline_s is None
+    assert adm.admit(0) is None
+
+
+def test_admission_split_expired_and_slo_gauges():
+    reg = _registry()
+    adm = AdmissionController(deadline_ms=50, registry=reg)
+    now = time.perf_counter()
+    deadlines = [now - 1.0, now + 10.0, now - 0.5, None]
+    enqueued = [now - 1.1, now - 0.01, now - 0.6, now - 0.2]
+    live, expired = adm.split_expired(deadlines, enqueued)
+    assert live == [1, 3] and expired == [0, 2]
+    counts = adm.counts()
+    assert counts["expired"] == 2
+    # Queue-delay histogram observed for EVERY dequeued request; the
+    # p99-vs-SLO gauges refresh every N splits (strictly throttled —
+    # refreshed explicitly here).
+    assert reg.histogram("serving.queue_delay_s").count == 4
+    adm.refresh_gauges()
+    p99 = reg.gauge("serving.queue_delay_p99_s").value()
+    assert p99 > 0
+    assert reg.gauge("serving.slo_ratio").value() == pytest.approx(
+        p99 / 0.05
+    )
+    err = adm.expired_error()
+    assert isinstance(err, ShedError) and err.expired
+
+
+def test_batcher_sheds_at_depth_and_expires_in_queue():
+    """End-to-end through the Python DynamicBatcher: depth shed at
+    compute(), deadline expiry at dequeue, live rows still served."""
+    reg = _registry()
+    adm = AdmissionController(
+        deadline_ms=80, max_queue_depth=2, registry=reg
+    )
+    batcher = DynamicBatcher(
+        batch_dim=1, maximum_batch_size=8, timeout_ms=10, admission=adm
+    )
+    results = {}
+
+    def submit(name):
+        try:
+            results[name] = batcher.compute(
+                {"x": np.full((1, 1), ord(name), np.float32)}
+            )
+        except ShedError as e:
+            results[name] = e
+
+    threads = [
+        threading.Thread(target=submit, args=(n,), daemon=True)
+        for n in "ab"
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 2
+    while batcher.size() < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    # Depth gate: the third concurrent request sheds immediately.
+    with pytest.raises(ShedError):
+        batcher.compute({"x": np.zeros((1, 1), np.float32)})
+    # Let both queued requests rot past their deadline, then start the
+    # consumer: it fails the stale two as expired and loops back to
+    # blocking (the whole batch expired). A fresh request — admitted
+    # now that the expired ones were drained — is served normally.
+    time.sleep(0.15)
+    served = {}
+
+    def consume():
+        batch = next(batcher)
+        served["rows"] = len(batch)
+        batch.set_outputs({"y": np.zeros((1, len(batch)), np.float32)})
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    deadline = time.monotonic() + 2
+    while batcher.size() > 0 and time.monotonic() < deadline:
+        time.sleep(0.005)  # expired pair drained by the consumer
+    threading.Thread(target=submit, args=("c",), daemon=True).start()
+    consumer.join(2)
+    assert served["rows"] == 1  # only the fresh request was served
+    for t in threads:
+        t.join(2)
+    assert isinstance(results["a"], ShedError) and results["a"].expired
+    assert isinstance(results["b"], ShedError) and results["b"].expired
+    counts = adm.counts()
+    assert counts == {"admitted": 3, "shed": 1, "expired": 2}
+    batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Shed/retry contract: a shed is never a lost rollout
+
+
+class CountingPolicyServer:
+    """The deterministic counting 'model' from test_env_server: state +=
+    1 per forward, reset where done — policy-independent of params, so
+    shed-and-resubmitted steps reproduce the unshed run exactly."""
+
+    def __call__(self, env_outputs, agent_state, batch_size):
+        done = np.asarray(env_outputs["done"])  # [1, B]
+        state = np.where(done, 0, np.asarray(agent_state)) + 1
+        outputs = {
+            "action": np.zeros_like(done, dtype=np.int32),
+            "policy_logits": state[..., None].astype(np.float32),
+            "baseline": state.astype(np.float32),
+        }
+        return outputs, state
+
+
+def _start_counting_server(path):
+    server = EnvServer(
+        lambda: CountingEnv(episode_length=EPISODE_LEN), f"unix:{path}"
+    )
+    server.start()
+    deadline = time.monotonic() + 5
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError("server did not bind")
+        time.sleep(0.01)
+    return server
+
+
+def _collect_rollouts(address, admission=None, wedge=None,
+                      num_rollouts=5):
+    """Run one actor against the counting server; return the rollout
+    items. `wedge` (a threading.Event) stalls the serving thread while
+    set — with `admission` armed that manufactures real sheds."""
+    learner_queue = BatchingQueue(
+        batch_dim=1, minimum_batch_size=1, maximum_batch_size=1
+    )
+    batcher = DynamicBatcher(
+        batch_dim=1, timeout_ms=20, admission=admission
+    )
+
+    def throttle():
+        while wedge is not None and wedge.is_set():
+            time.sleep(0.01)
+
+    inf_thread = threading.Thread(
+        target=inference_loop,
+        args=(batcher, CountingPolicyServer(), 8),
+        kwargs={"throttle_fn": throttle if wedge is not None else None},
+        daemon=True,
+    )
+    inf_thread.start()
+
+    pool = ActorPool(
+        unroll_length=T,
+        learner_queue=learner_queue,
+        inference_batcher=batcher,
+        env_server_addresses=[address],
+        initial_agent_state=np.zeros((1, 1), np.int64),
+    )
+    pool_thread = threading.Thread(target=pool.run, daemon=True)
+    pool_thread.start()
+
+    items = []
+    for item in learner_queue:
+        items.append(item)
+        if wedge is not None and len(items) == 2:
+            # Wedge mid-stream: the actor's next requests expire in the
+            # queue (or shed at depth) and must be re-submitted.
+            wedge.set()
+            time.sleep(0.35)
+            wedge.clear()
+        if len(items) >= num_rollouts:
+            break
+    batcher.close()
+    learner_queue.close()
+    pool_thread.join(5)
+    return items
+
+
+@pytest.mark.slow
+def test_shed_retry_rollouts_bit_identical():
+    """THE no-lost-rollout pin: a wedged batcher sheds mid-run; the
+    actor re-submits; the resulting rollout stream is bit-identical to
+    the unshed run, and resubmitted == shed + expired exactly."""
+    reg = telemetry.get_registry()
+    base = int(reg.counter("serving.resubmitted").value())
+
+    tmp = tempfile.mkdtemp()
+    path_a = os.path.join(tmp, "srv_a")
+    server = _start_counting_server(path_a)
+    try:
+        clean = _collect_rollouts(f"unix:{path_a}")
+    finally:
+        server.stop()
+
+    path_b = os.path.join(tmp, "srv_b")
+    server = _start_counting_server(path_b)
+    adm = AdmissionController(
+        deadline_ms=60, max_queue_depth=2, registry=reg
+    )
+    wedge = threading.Event()
+    try:
+        shed = _collect_rollouts(
+            f"unix:{path_b}", admission=adm, wedge=wedge
+        )
+    finally:
+        server.stop()
+
+    counts = adm.counts()
+    shed_total = counts["shed"] + counts["expired"]
+    assert shed_total > 0, "the wedge produced no sheds; test is vacuous"
+    resubmitted = int(reg.counter("serving.resubmitted").value()) - base
+    assert resubmitted == shed_total
+
+    assert len(clean) == len(shed)
+    for a, b in zip(clean, shed):
+        for key in a["batch"]:
+            np.testing.assert_array_equal(
+                a["batch"][key], b["batch"][key], err_msg=key
+            )
+        np.testing.assert_array_equal(
+            np.asarray(a["initial_agent_state"]),
+            np.asarray(b["initial_agent_state"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# PolicySnapshotStore
+
+
+def test_snapshot_store_bf16_roundtrip_restores_dtypes():
+    import jax.numpy as jnp
+
+    store = PolicySnapshotStore(4, registry=_registry())
+    params = {
+        "w": np.arange(8, dtype=np.float32) / 7.0,
+        "n": np.arange(4, dtype=np.int32),
+        "h": np.ones(3, dtype=jnp.bfloat16),
+    }
+    assert store.latest() is None
+    store.note_update(0)
+    store.publish(0, params)
+    version, restored = store.latest()
+    assert version == 0
+    assert restored["w"].dtype == np.float32  # restored, bf16-rounded
+    assert restored["n"].dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(restored["n"]), params["n"])
+    assert restored["h"].dtype == jnp.bfloat16
+    # f32 values round-trip through bf16 rounding (not bit-exact, but
+    # within one bf16 ulp).
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), params["w"], rtol=1e-2
+    )
+    # The cache is per-version: same object back on a second read.
+    assert store.latest()[1] is restored
+
+
+def test_snapshot_store_refresh_due_and_failure_hook():
+    reg = _registry()
+    store = PolicySnapshotStore(4, registry=reg)
+    assert store.note_update(0)  # nothing published yet: due
+    store.publish(0, {"w": np.zeros(2, np.float32)})
+    assert store.lag() == 0
+    assert not store.note_update(3)  # 3 < refresh period
+    assert store.note_update(4)  # due again
+    store.fail_next_refreshes(2)
+    assert not store.publish(4, {"w": np.zeros(2, np.float32)})
+    assert store.version == 0 and store.lag() == 4
+    assert store.note_update(5)  # STILL due — the drop retries
+    assert not store.publish(5, {"w": np.zeros(2, np.float32)})
+    assert store.note_update(6)
+    assert store.publish(6, {"w": np.zeros(2, np.float32)})
+    assert store.version == 6 and store.lag() == 0
+    assert (
+        int(reg.counter("serving.snapshot_refresh_failures").value()) == 2
+    )
+
+
+# ---------------------------------------------------------------------------
+# Replica hooks: lag recording + degradation
+
+
+def test_replica_lag_matches_snapshot_actually_used():
+    """Version-skew pin: the policy_lag stamped into a reply equals
+    head - (the version of the params handed out for THAT batch) —
+    checked by encoding the version into the params themselves."""
+    reg = _registry()
+    store = PolicySnapshotStore(2, registry=reg)
+    hooks = ReplicaServingHooks(
+        store, max_policy_lag=10, registry=reg, batch_dim=1
+    )
+    store.note_update(3)
+    store.publish(3, {"v": np.full(1, 3.0, np.float32)})
+    store.note_update(5)  # head runs ahead: lag 2
+
+    (params, _key), annotate = hooks.begin_batch()
+    assert float(np.asarray(params["v"])[0]) == 3.0
+    out = annotate({"action": np.zeros((1, 4), np.int32)}, 4)
+    assert out["policy_lag"].shape == (1, 4)
+    assert out["policy_lag"].dtype == np.int32
+    assert (out["policy_lag"] == 5 - 3).all()
+
+    # A fresh publish drops the lag for the NEXT batch atomically.
+    store.note_update(6)
+    store.publish(6, {"v": np.full(1, 6.0, np.float32)})
+    (params, _key), annotate = hooks.begin_batch()
+    assert float(np.asarray(params["v"])[0]) == 6.0
+    out = annotate({"action": np.zeros((1, 2), np.int32)}, 2)
+    assert (out["policy_lag"] == 0).all()
+
+
+def test_replica_degrades_and_recovers_via_health():
+    reg = _registry()
+    store = PolicySnapshotStore(2, registry=reg)
+    health = PipelineHealth(registry=reg)
+    hooks = ReplicaServingHooks(
+        store, max_policy_lag=3, health=health, registry=reg
+    )
+    assert not hooks.serving_ok()  # nothing published yet
+    assert health.state_name == "DEGRADED"
+    store.note_update(0)
+    store.publish(0, {"w": np.zeros(1, np.float32)})
+    assert hooks.serving_ok()
+    assert health.state_name == "HEALTHY"  # first publish recovers it
+
+    store.note_update(4)  # lag 4 > budget 3
+    assert not hooks.serving_ok()
+    assert health.state_name == "DEGRADED"
+    store.publish(4, {"w": np.zeros(1, np.float32)})
+    assert hooks.serving_ok()
+    assert health.state_name == "HEALTHY"
+    assert int(reg.counter("serving.replica_degradations").value()) == 2
+
+
+def test_replica_router_routes_by_health():
+    reg = _registry()
+    store = PolicySnapshotStore(2, registry=reg)
+    hooks = ReplicaServingHooks(store, max_policy_lag=2, registry=reg)
+
+    class FakeBatcher:
+        def __init__(self, tag):
+            self.tag, self.calls = tag, 0
+
+        def compute(self, inputs, trace=None):
+            self.calls += 1
+            return {"served_by": self.tag}
+
+        def size(self):
+            return 0
+
+        def is_closed(self):
+            return False
+
+    central, replica = FakeBatcher("central"), FakeBatcher("replica")
+    router = ReplicaRouter(central, replica, hooks, registry=reg)
+    # No snapshot yet: central.
+    assert router.compute({})["served_by"] == "central"
+    store.note_update(0)
+    store.publish(0, {"w": np.zeros(1, np.float32)})
+    assert router.compute({})["served_by"] == "replica"
+    store.note_update(10)  # lag blows the budget: back to central
+    assert router.compute({})["served_by"] == "central"
+    assert int(reg.counter("serving.replica_requests").value()) == 1
+    assert int(reg.counter("serving.central_requests").value()) == 2
+
+
+def test_replica_router_falls_back_on_replica_failure():
+    from torchbeast_tpu.runtime.queues import AsyncError
+
+    reg = _registry()
+    store = PolicySnapshotStore(2, registry=reg)
+    hooks = ReplicaServingHooks(store, max_policy_lag=2, registry=reg)
+    store.note_update(0)
+    store.publish(0, {"w": np.zeros(1, np.float32)})
+
+    class DeadReplica:
+        def compute(self, inputs, trace=None):
+            raise AsyncError("replica thread died")
+
+        def size(self):
+            return 0
+
+        def is_closed(self):
+            return False
+
+    class Central:
+        def compute(self, inputs, trace=None):
+            return {"served_by": "central"}
+
+        def size(self):
+            return 0
+
+        def is_closed(self):
+            return False
+
+    router = ReplicaRouter(Central(), DeadReplica(), hooks, registry=reg)
+    assert router.compute({})["served_by"] == "central"
+
+    class SheddingReplica(DeadReplica):
+        def compute(self, inputs, trace=None):
+            raise ShedError("over capacity")
+
+    router = ReplicaRouter(
+        Central(), SheddingReplica(), hooks, registry=reg
+    )
+    # Sheds keep their retry contract — NOT swallowed by the fallback.
+    with pytest.raises(ShedError):
+        router.compute({})
+
+
+# ---------------------------------------------------------------------------
+# Replica serving end-to-end through inference_loop (legacy act path)
+
+
+def test_replica_serving_stamps_lag_into_reply():
+    """inference_loop + serving_hooks: the reply's policy_lag leaf
+    matches the snapshot served, end to end through the batcher."""
+    reg = _registry()
+    store = PolicySnapshotStore(2, registry=reg)
+    hooks = ReplicaServingHooks(
+        store, max_policy_lag=10, registry=reg, batch_dim=1
+    )
+    store.note_update(7)
+    store.publish(7, {"v": np.full(1, 7.0, np.float32)})
+    store.note_update(9)  # lag 2 at serve time
+
+    batcher = DynamicBatcher(batch_dim=1, timeout_ms=10)
+
+    def act_fn(env_outputs, agent_state, batch_size, ctx):
+        params, _key = ctx
+        value = float(np.asarray(params["v"])[0])
+        done = np.asarray(env_outputs["done"])
+        outputs = {
+            "action": np.zeros_like(done, dtype=np.int32),
+            "policy_logits": np.full(
+                done.shape + (2,), value, np.float32
+            ),
+            "baseline": np.full(done.shape, value, np.float32),
+        }
+        return outputs, np.asarray(agent_state)
+
+    thread = threading.Thread(
+        target=inference_loop,
+        args=(batcher, act_fn, 8),
+        kwargs={"serving_hooks": hooks},
+        daemon=True,
+    )
+    thread.start()
+    reply = batcher.compute({
+        "env": {
+            "frame": np.zeros((1, 1, 2, 2), np.uint8),
+            "reward": np.zeros((1, 1), np.float32),
+            "done": np.zeros((1, 1), bool),
+            "last_action": np.zeros((1, 1), np.int32),
+        },
+        "agent_state": np.zeros((1, 1), np.int64),
+    })
+    batcher.close()
+    thread.join(5)
+    out = reply["outputs"]
+    # The baseline (params value) and the lag must describe the SAME
+    # snapshot: params v7 served at head 9 -> lag 2.
+    assert float(out["baseline"][0, 0]) == 7.0
+    assert out["policy_lag"].shape == (1, 1)
+    assert int(out["policy_lag"][0, 0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Native twin (when built): shed protocol across the GIL boundary
+
+
+needs_native = pytest.mark.skipif(
+    import_native() is None, reason="_tbt_core not built"
+)
+
+
+@needs_native
+def test_native_api_version_and_shed_type():
+    core = import_native()
+    from torchbeast_tpu.runtime.native import REQUIRED_API_VERSION, gap_reason
+
+    assert getattr(core, "API_VERSION", 0) >= REQUIRED_API_VERSION
+    assert gap_reason() is None
+    # One except-clause catches sheds from either runtime.
+    assert issubclass(core.ShedError, ShedError)
+    assert issubclass(core.ShedError, core.AsyncError)
+
+
+@needs_native
+def test_native_batcher_sheds_at_depth_and_expires():
+    core = import_native()
+    batcher = core.DynamicBatcher(
+        batch_dim=1, maximum_batch_size=8, timeout_ms=10,
+        shed_max_queue_depth=2, request_deadline_ms=80.0,
+    )
+    results = {}
+
+    def submit(name):
+        try:
+            results[name] = batcher.compute(
+                {"x": np.full((1, 1), float(ord(name)), np.float32)}
+            )
+        except Exception as e:  # noqa: BLE001
+            results[name] = e
+
+    threads = [
+        threading.Thread(target=submit, args=(n,), daemon=True)
+        for n in "ab"
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 2
+    while batcher.size() < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with pytest.raises(core.ShedError):
+        batcher.compute({"x": np.zeros((1, 1), np.float32)})
+    time.sleep(0.15)  # let the queued two expire
+    served = {}
+
+    def consume():
+        batch = next(iter(batcher))
+        served["rows"] = len(batch)
+        batch.set_outputs({"y": np.zeros((1, len(batch)), np.float32)})
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    deadline = time.monotonic() + 2
+    while batcher.size() > 0 and time.monotonic() < deadline:
+        time.sleep(0.005)  # expired pair drained by the consumer
+    threading.Thread(target=submit, args=("c",), daemon=True).start()
+    consumer.join(2)
+    assert served["rows"] == 1
+    for t in threads:
+        t.join(2)
+    assert isinstance(results["a"], ShedError)
+    assert isinstance(results["b"], ShedError)
+    tm = batcher.telemetry()
+    assert tm["admitted"] == 3
+    assert tm["shed"] == 1
+    assert tm["expired"] == 2
+    assert tm["queue_delay_s"]["count"] >= 3
+    batcher.close()

@@ -136,10 +136,30 @@ def make_parser():
                              "offset it per host so no two hosts share a "
                              "stream. Default: OS entropy per env.")
     parser.add_argument("--num_inference_threads", type=int, default=2)
-    parser.add_argument("--native_runtime", action="store_true",
-                        help="Use the C++ queues/batcher/actor-pool "
+    # Tri-state: None (default) = native-first with a clean, logged
+    # fallback to the Python pool when _tbt_core is absent/stale;
+    # True (explicit --native_runtime) = native REQUIRED, unusable
+    # extension is a hard error (a benchmark asking for the C++ pool
+    # must never silently publish Python-pool numbers); False = forced
+    # Python pool.
+    parser.add_argument("--native_runtime", dest="native_runtime",
+                        action="store_true", default=None,
+                        help="Require the C++ queues/batcher/actor-pool "
                              "(_tbt_core; build with "
-                             "scripts/build_native.sh).")
+                             "scripts/build_native.sh). The DEFAULT "
+                             "(neither flag) is native-first since "
+                             "ISSUE 14: the C++ pool when usable, a "
+                             "logged fallback to the Python pool when "
+                             "the extension is absent or stale "
+                             "(predates the shed protocol); passing "
+                             "this flag explicitly makes an unusable "
+                             "extension a hard error instead.")
+    parser.add_argument("--no_native_runtime", dest="native_runtime",
+                        action="store_false",
+                        help="Force the Python queues/batcher/actor-"
+                             "pool (the semantic reference "
+                             "implementation; required for replica "
+                             "serving today).")
     parser.add_argument("--native_server", action="store_true",
                         help="Serve environments with the C++ EnvServer "
                              "(GIL-free socket I/O; combined-launcher "
@@ -262,6 +282,44 @@ def make_parser():
                              "steady-state behavior unchanged.")
     parser.add_argument("--max_inference_batch_size", type=int, default=64)
     parser.add_argument("--inference_timeout_ms", type=float, default=100)
+    parser.add_argument("--request_deadline_ms", type=float, default=0.0,
+                        help="Arm the serving tier's admission gate "
+                             "(serving/admission.py): inference "
+                             "requests carry this enqueue deadline — "
+                             "requests that would queue past it (or "
+                             "arrive while the queue is at its depth "
+                             "bound, 4x max_inference_batch_size) are "
+                             "shed with a typed ShedReply the actor "
+                             "re-submits after backoff, so overload "
+                             "degrades tail latency instead of "
+                             "growing the queue without bound. The "
+                             "same number is the per-connection SLO "
+                             "target exported in the telemetry `slo` "
+                             "block. 0 = no admission control (every "
+                             "request queues forever, the pre-ISSUE-14 "
+                             "behavior).")
+    parser.add_argument("--replica_refresh_updates", type=int, default=0,
+                        help="Serve acting requests from versioned "
+                             "bf16 policy snapshots published every N "
+                             "updates (serving/snapshot.py + "
+                             "replica.py): replica serving threads "
+                             "answer from the latest snapshot with the "
+                             "true per-request policy_lag recorded "
+                             "into the rollout (V-trace sees the real "
+                             "behavior policy either way — the logits "
+                             "ARE the stale policy's). 0 = central "
+                             "serving only. Python runtime only today "
+                             "(ignored with a warning under "
+                             "--native_runtime).")
+    parser.add_argument("--max_policy_lag", type=int, default=20,
+                        help="Replica staleness budget, in updates: "
+                             "when the latest snapshot trails the "
+                             "learner head beyond this (a stalled "
+                             "refresh), the replica DEGRADES back to "
+                             "the central serving path through the "
+                             "health machine instead of serving "
+                             "arbitrarily stale actions; it recovers "
+                             "when a fresh snapshot lands.")
     parser.add_argument("--max_frame_bytes", type=int,
                         default=wire.DEFAULT_MAX_FRAME_BYTES,
                         help="Reject wire frames longer than this before "
@@ -801,19 +859,56 @@ def train(flags):
         # from state_lock so the inference hot path never waits on a dispatch.
         donation_lock = threading.Lock()
 
-        if flags.native_runtime:
-            from torchbeast_tpu.runtime.native import import_native
+        # Native-first runtime (ISSUE 14 / ROADMAP item 1): the C++
+        # pool by default; an absent or stale _tbt_core falls back to
+        # the Python pool with the reason logged — unless the user
+        # EXPLICITLY asked for native, which must stay a hard error
+        # (silently downgrading an explicit benchmark request would
+        # publish Python-pool numbers as native ones).
+        native_pref = flags.native_runtime  # None=auto, True/False=forced
+        use_native = native_pref is not False
+        if use_native:
+            from torchbeast_tpu.runtime.native import (
+                gap_reason,
+                import_native,
+            )
 
-            core = import_native()
-            if core is None:
+            reason = gap_reason()
+            if reason is None:
+                queue_mod = import_native()
+                log.info("Using native (C++) runtime")
+            elif native_pref is True:
                 raise RuntimeError(
-                    "--native_runtime requested but _tbt_core is not built; "
-                    "run scripts/build_native.sh"
+                    f"--native_runtime requested but {reason}"
                 )
-            queue_mod = core
-            log.info("Using native (C++) runtime")
-        else:
+            else:
+                use_native = False
+                log.warning(
+                    "Native runtime unavailable (%s); falling back to "
+                    "the Python pool", reason,
+                )
+        if not use_native:
             import torchbeast_tpu.runtime as queue_mod
+
+        # Admission control + deadline-aware load shedding on the
+        # central inference path (ISSUE 14, serving/admission.py):
+        # armed by --request_deadline_ms. The depth bound defaults to
+        # 4x the max batch — deep enough that the consumer's formation
+        # pipeline never starves, shallow enough that queueing past it
+        # only manufactures deadline expiries.
+        deadline_ms = getattr(flags, "request_deadline_ms", 0.0) or 0.0
+        shed_depth = (
+            4 * flags.max_inference_batch_size if deadline_ms > 0 else None
+        )
+        slo_target_s = deadline_ms / 1000.0 if deadline_ms > 0 else None
+        admission = None
+        if deadline_ms > 0 and not use_native:
+            from torchbeast_tpu.serving import AdmissionController
+
+            admission = AdmissionController(
+                deadline_ms=deadline_ms, max_queue_depth=shed_depth,
+                registry=reg,
+            )
 
         # Each host's queue batches its LOCAL rows; shard_batch assembles the
         # global array across hosts (local_rows == batch_size single-host).
@@ -821,13 +916,24 @@ def train(flags):
         # runtime only (the C++ classes don't take the kwarg; their
         # depths still land in the monitor-loop gauges below).
         queue_tm = (
-            {} if flags.native_runtime
+            {} if use_native
             else {"telemetry_name": "learner_queue"}
         )
-        batcher_tm = (
-            {} if flags.native_runtime
-            else {"telemetry_name": "inference"}
-        )
+        if use_native:
+            # The C++ batcher gates admission in-process (actor threads
+            # never touch Python on a shed); counters fold back into
+            # the serving.* series each monitor tick.
+            batcher_tm = {}
+            if deadline_ms > 0:
+                batcher_tm = {
+                    "request_deadline_ms": deadline_ms,
+                    "shed_max_queue_depth": shed_depth,
+                    "slo_target_ms": deadline_ms,
+                }
+        else:
+            batcher_tm = {
+                "telemetry_name": "inference", "admission": admission,
+            }
         learner_queue = queue_mod.BatchingQueue(
             batch_dim=1,
             minimum_batch_size=local_rows,
@@ -844,6 +950,25 @@ def train(flags):
             **batcher_tm,
         )
 
+        # The model's acting inputs (a subset of the actor traffic's
+        # _ENV_KEYS nest) — ONE definition for the central act path,
+        # the state table's filter/act, and the replica act path.
+        _MODEL_KEYS = ("frame", "reward", "done", "last_action")
+
+        def _act_with(params_now, key, env_outputs, agent_state):
+            """One legacy-path forward with explicit params/rng: the
+            central act_fn and the replica act path differ ONLY in
+            where (params, key) come from."""
+            # act_step consumes [B, ...] (adds T=1 itself); inputs are [1, B].
+            model_inputs = {k: env_outputs[k][0] for k in _MODEL_KEYS}
+            out, new_state = act_step(params_now, key, model_inputs, agent_state)
+            out = {
+                "action": np.asarray(out.action)[None],
+                "policy_logits": np.asarray(out.policy_logits)[None],
+                "baseline": np.asarray(out.baseline)[None],
+            }
+            return out, new_state
+
         def act_fn(env_outputs, agent_state, batch_size):
             """Bucket-static jitted forward. Called CONCURRENTLY from every
             inference thread (no global lock — see the measurement note at
@@ -852,19 +977,7 @@ def train(flags):
             with state_lock:
                 params_now = state["infer_params"]
                 state["rng"], key = jax.random.split(state["rng"])
-            model_inputs = {
-                k: env_outputs[k]
-                for k in ("frame", "reward", "done", "last_action")
-            }
-            # act_step consumes [B, ...] (adds T=1 itself); inputs are [1, B].
-            model_inputs = {k: v[0] for k, v in model_inputs.items()}
-            out, new_state = act_step(params_now, key, model_inputs, agent_state)
-            out = {
-                "action": np.asarray(out.action)[None],
-                "policy_logits": np.asarray(out.policy_logits)[None],
-                "baseline": np.asarray(out.baseline)[None],
-            }
-            return out, new_state
+            return _act_with(params_now, key, env_outputs, agent_state)
 
         # Device-resident agent-state table (runtime/state_table.py):
         # recurrent state lives in a [.., num_actors+1, ..] on-device
@@ -886,8 +999,6 @@ def train(flags):
                     params_now = state["infer_params"]
                     state["rng"], key = jax.random.split(state["rng"])
                 return params_now, key
-
-            _MODEL_KEYS = ("frame", "reward", "done", "last_action")
 
             def _table_act(ctx, env_outputs, agent_state):
                 params_now, key = ctx
@@ -992,6 +1103,94 @@ def train(flags):
                 len(buckets), time.time() - t0,
             )
 
+        # The chaos learner_stall gate (shared-chip overload model):
+        # consulted by the learner's dispatch site and every serving
+        # loop's per-batch site; None when chaos is unarmed.
+        throttle = chaos.throttle if chaos is not None else None
+
+        # Snapshotted policy replicas (ISSUE 14, serving/): the learner
+        # publishes versioned bf16 snapshots every
+        # --replica_refresh_updates; replica serving threads answer
+        # acting requests from them through the SAME state table (ctx
+        # override — state continuity is routing-independent), stamping
+        # the true policy_lag into each reply. Lag beyond
+        # --max_policy_lag degrades the replica back to the central
+        # path via the health machine. Python runtime only: the router
+        # sits in the Python pool's request path.
+        replica_parts = None
+        refresh_updates = getattr(flags, "replica_refresh_updates", 0) or 0
+        if refresh_updates > 0 and use_native:
+            log.warning(
+                "--replica_refresh_updates is a Python-runtime feature "
+                "today (the routing sits in the Python actor pool); "
+                "ignored under the native runtime — central serving "
+                "only. Pass --no_native_runtime to serve from replicas."
+            )
+        elif refresh_updates > 0:
+            from torchbeast_tpu.serving import (
+                PolicySnapshotStore,
+                ReplicaRouter,
+                ReplicaServingHooks,
+            )
+
+            snapshot_store = PolicySnapshotStore(
+                refresh_updates, registry=reg
+            )
+            # Version 0 = the initial params, published before serving
+            # starts so the replica path is never empty-handed.
+            snapshot_store.note_update(0)
+            snapshot_store.publish(0, state["infer_params"])
+            replica_hooks = ReplicaServingHooks(
+                snapshot_store,
+                max_policy_lag=flags.max_policy_lag,
+                rng_seed=flags.seed + 7919 * (proc_id + 1),
+                health=health,
+                batch_dim=1,
+                registry=reg,
+            )
+            replica_batcher = DynamicBatcher(
+                batch_dim=1,
+                minimum_batch_size=1,
+                maximum_batch_size=flags.max_inference_batch_size,
+                timeout_ms=flags.inference_timeout_ms,
+                telemetry_name="replica",
+                admission=admission,
+            )
+            replica_parts = {
+                "store": snapshot_store,
+                "hooks": replica_hooks,
+                "batcher": replica_batcher,
+                "router": ReplicaRouter(
+                    inference_batcher, replica_batcher, replica_hooks,
+                    registry=reg,
+                ),
+            }
+
+            def _replica_act_fn(env_outputs, agent_state, batch_size, ctx):
+                """Legacy-path replica forward: the central act body
+                with the hook-provided (snapshot params, key) instead
+                of the live ones (stateless models only — the
+                state-table path feeds ctx through the table step)."""
+                params_now, key = ctx
+                return _act_with(params_now, key, env_outputs, agent_state)
+
+            def _replica_loop():
+                inference_loop(
+                    replica_batcher,
+                    None if state_table is not None else _replica_act_fn,
+                    flags.max_inference_batch_size,
+                    lock=None,
+                    pipelined=False,
+                    state_table=state_table,
+                    serving_hooks=replica_hooks,
+                    throttle_fn=throttle,
+                )
+
+            log.info(
+                "Replica serving armed: refresh every %d updates, "
+                "max policy lag %d", refresh_updates, flags.max_policy_lag,
+            )
+
         def _serve_loop():
             # Pipelined dispatch only with a single consumer thread: its
             # held-reply optimization is unsafe with several threads
@@ -1004,6 +1203,7 @@ def train(flags):
                 lock=None,
                 pipelined=flags.num_inference_threads == 1,
                 state_table=state_table,
+                throttle_fn=throttle,
             )
 
         # Supervised serving threads (ISSUE 6): a poisoned state table
@@ -1011,6 +1211,9 @@ def train(flags):
         # initial state and restarts the thread, up to
         # --inference_restart_budget times; exhaustion goes HALTED
         # (checkpoint-and-exit below) instead of wedging the actors.
+        # Replica loops (when armed) ride the SAME supervisor: they
+        # share the state table, so poison recovery must rebuild once
+        # and restart every serving thread under one budget.
         infer_supervisor = InferenceSupervisor(
             _serve_loop,
             num_threads=flags.num_inference_threads,
@@ -1018,34 +1221,47 @@ def train(flags):
             restart_budget=getattr(flags, "inference_restart_budget", 3),
             health=health,
             registry=reg,
+            extra_loop_fns=(
+                [_replica_loop] if replica_parts is not None else None
+            ),
         )
 
-        pool_cls = queue_mod.ActorPool if flags.native_runtime else ActorPool
+        pool_cls = queue_mod.ActorPool if use_native else ActorPool
         pool_kwargs = {"max_frame_bytes": flags.max_frame_bytes}
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
+        if not use_native:
+            # SLO breach accounting + replica routing live actor-side
+            # in the Python pool (the C++ pool counts breaches
+            # batcher-side and retries sheds in its own loops).
+            pool_kwargs["slo_target_s"] = slo_target_s
+            if replica_parts is not None:
+                pool_kwargs["record_policy_lag"] = True
         # Chaos interposition (ISSUE 6/12) on EITHER runtime: the Python
         # pool wraps each fresh transport in a FaultingTransport; the
         # C++ pool builds its FaultHooks (csrc/chaos.h) and the
         # controller drives them through the pool's chaos_* methods.
         if chaos is not None:
-            if flags.native_runtime:
+            if use_native:
                 pool_kwargs["fault_hooks"] = True
             else:
                 pool_kwargs["transport_wrap"] = chaos.wrap_transport
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
-            inference_batcher=inference_batcher,
+            inference_batcher=(
+                replica_parts["router"]
+                if replica_parts is not None else inference_batcher
+            ),
             env_server_addresses=addresses,
             initial_agent_state=model.initial_state(1),
             max_reconnects=flags.max_actor_reconnects,
             connect_timeout_s=flags.actor_connect_timeout_s,
             **pool_kwargs,
         )
-        if chaos is not None and flags.native_runtime:
+        if chaos is not None and use_native:
             chaos.attach_native_pool(actors)
-        if flags.native_runtime and telemetry_on:
+        if use_native and telemetry_on:
             # The C++ core has no registry access; fold its per-request
             # stage stamps + wire/step counters into the same series the
             # Python runtime writes, on every exported line.
@@ -1054,7 +1270,7 @@ def train(flags):
             tele.add_tick_callback(
                 NativeTelemetryFolder(
                     reg, pool=actors, batcher=inference_batcher,
-                    queue=learner_queue,
+                    queue=learner_queue, slo_target_s=slo_target_s,
                 ).tick
             )
         actor_thread = threading.Thread(
@@ -1090,6 +1306,22 @@ def train(flags):
                     getattr(actors, "live_actors", lambda: -1)()
                 )
             )
+            # Per-connection SLO block (ISSUE 14 satellite) on EVERY
+            # telemetry line: the p99 of actor.request_rtt_s against
+            # the same target the shed gate's deadline uses, plus the
+            # breach count — dashboards and the admission gate read
+            # one number.
+            h_rtt = reg.histogram("actor.request_rtt_s")
+            c_breach = reg.counter("slo.rtt_breaches")
+
+            def _slo_tick():
+                tele.set_static("slo", {
+                    "target_s": slo_target_s,
+                    "p99_s": round(h_rtt.percentile(0.99), 6),
+                    "breaches": int(c_breach.value()),
+                })
+
+            tele.add_tick_callback(_slo_tick)
 
         # Stage latencies (dequeue/learn) become learner.* histograms
         # in the snapshot; with telemetry off, a private registry keeps
@@ -1169,6 +1401,7 @@ def train(flags):
             # supersteps each dispatch carries K updates and [K]-stacked
             # stats, so this ONE delayed sync covers K updates.
             pending = None  # (device_stats, step_after, arena_release)
+            updates_done = 0  # snapshot versioning, in UPDATES
 
             def flush(pending_entry):
                 device_stats, at_step, release = pending_entry
@@ -1203,6 +1436,10 @@ def train(flags):
                     batch, initial_agent_state = staged
                     release = None
                 timings.time("dequeue")
+                if throttle is not None:
+                    # Chaos learner_stall gate: models the busy-chip
+                    # stall at the dispatch site (no-op unarmed).
+                    throttle()
                 # Dispatch under donation_lock (NOT state_lock): opt_state is
                 # donated, so the dispatch that invalidates the old opt
                 # buffers must not race a checkpoint's device_get of them —
@@ -1232,6 +1469,15 @@ def train(flags):
                         )
                         now_step = state["step"]
                 watchdog.ping()
+                updates_done += superstep_k
+                if replica_parts is not None:
+                    # Versioned snapshot publish (serving/snapshot.py):
+                    # due when the head has run >= refresh_updates past
+                    # the last snapshot — a dropped refresh (the chaos
+                    # failure hook) stays due and retries next update.
+                    store = replica_parts["store"]
+                    if store.note_update(updates_done):
+                        store.publish(updates_done, infer_view)
                 if pending is not None:
                     flush(pending)
                 pending = (train_stats, now_step, release)
@@ -1401,7 +1647,12 @@ def train(flags):
                 pass  # start_trace itself failed; don't mask the cause
         # Shutdown ordering mirrors the reference (polybeast_learner.py:
         # 587-593): close batcher + queue, join actors, join threads.
-        for closer in (inference_batcher, learner_queue):
+        # The replica batcher (when armed) closes alongside the central
+        # one so replica serving threads exit their loops cleanly.
+        closers = [inference_batcher, learner_queue]
+        if replica_parts is not None:
+            closers.insert(1, replica_parts["batcher"])
+        for closer in closers:
             try:
                 closer.close()
             except RuntimeError:

@@ -32,6 +32,13 @@ Fault classes (FAULT_KINDS):
                          the header class, see the plan docs)
     state_table_poison   poison the DeviceStateTable (the donated-
                          dispatch failure mode, runtime/state_table.py)
+    learner_stall        stall the learner AND the serving threads for
+                         `duration_s` (the shared-chip overload model:
+                         a busy learner chip slows inference dispatch
+                         too) — the fault that makes the admission
+                         gate shed for real (ISSUE 14). Injected via
+                         the driver-installed `throttle()` gate; no
+                         target needed.
     preempt_sigterm      SIGTERM this process (preemption: the driver's
                          graceful checkpoint-and-exit path)
 
@@ -72,6 +79,7 @@ FAULT_KINDS = (
     "shm_corrupt_header",
     "shm_corrupt_payload",
     "state_table_poison",
+    "learner_stall",
     "preempt_sigterm",
 )
 
@@ -263,6 +271,9 @@ class ChaosController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_at: Optional[float] = None
+        # learner_stall window end (monotonic); consulted by throttle()
+        # from the learner loop and the serving threads.
+        self._stall_until = 0.0  # guarded-by: self._lock
 
     # -- driver attachment ------------------------------------------------
     def attach_servers(self, supervisor) -> None:
@@ -318,6 +329,25 @@ class ChaosController:
             time.sleep(delay_s)
         else:  # blackhole: hold the op until the window heals
             time.sleep(max(0.0, until - now))
+
+    # -- learner_stall gate (called from driver loops) --------------------
+    def stall_remaining(self) -> float:
+        """Seconds left in the active learner_stall window (0 = none)."""
+        with self._lock:
+            until = self._stall_until
+        return max(0.0, until - time.monotonic())
+
+    def throttle(self) -> None:
+        """The shared-chip stall model (ISSUE 14): the driver installs
+        this at the learner's update-dispatch site and the serving
+        loops' per-batch site (inference_loop's throttle_fn). Outside a
+        stall window it is one lock acquire; inside, it sleeps the
+        window out in short slices so shutdown never waits on it."""
+        while not self._stop.is_set():
+            remaining = self.stall_remaining()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.05))
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ChaosController":
@@ -472,6 +502,13 @@ class ChaosController:
             if table is None:
                 return False
             table.poison()
+            return True
+        if kind == "learner_stall":
+            # Armed unconditionally: the gate is pull-based (the driver
+            # loops consult throttle()), so there is no handle to wait
+            # for — the window simply starts now.
+            with self._lock:
+                self._stall_until = time.monotonic() + fault.duration_s
             return True
         if kind == "preempt_sigterm":
             os.kill(os.getpid(), signal.SIGTERM)

@@ -187,9 +187,15 @@ class InferenceSupervisor:
         health: Optional[PipelineHealth] = None,
         registry=None,
         name: str = "inference",
+        extra_loop_fns: Optional[List[Callable[[], None]]] = None,
     ):
-        self._loop_fn = loop_fn
-        self._num_threads = num_threads
+        # `extra_loop_fns` (ISSUE 14): replica serving loops ride the
+        # SAME supervisor as the central ones — they share the state
+        # table, so a poison event must rebuild once and restart ALL
+        # serving threads under one budget/generation, not race two
+        # supervisors over the same table.
+        self._loops = [loop_fn] * num_threads + list(extra_loop_fns or [])
+        self._num_threads = len(self._loops)
         self._table = state_table
         self._budget = restart_budget
         self._health = health
@@ -223,7 +229,7 @@ class InferenceSupervisor:
                 target=self._run, args=(i,), daemon=True,
                 name=f"{self._name}-{i}",
             )
-            for i in range(self._num_threads)
+            for i in range(len(self._loops))
         ]
         for t in self._threads:
             t.start()
@@ -244,11 +250,12 @@ class InferenceSupervisor:
         return isinstance(e, StateTablePoisonedError)
 
     def _run(self, index: int) -> None:
+        loop_fn = self._loops[index]
         while True:
             with self._lock:
                 gen = self._recovery_gen
             try:
-                self._loop_fn()
+                loop_fn()
                 return  # batcher closed: clean shutdown
             except BaseException as e:  # noqa: BLE001
                 if self._is_poison_error(e) or (

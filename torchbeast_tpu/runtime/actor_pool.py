@@ -34,7 +34,7 @@ from torchbeast_tpu import telemetry
 from torchbeast_tpu.resilience.backoff import Backoff
 from torchbeast_tpu.runtime import transport as transport_lib
 from torchbeast_tpu.runtime import wire
-from torchbeast_tpu.runtime.errors import StateTablePoisonedError
+from torchbeast_tpu.runtime.errors import ShedError, StateTablePoisonedError
 from torchbeast_tpu.runtime.queues import (
     AsyncError,
     BatchingQueue,
@@ -64,6 +64,9 @@ class ActorPool:
         max_frame_bytes: Optional[int] = None,
         backoff_factory: Optional[Callable[[], Backoff]] = None,
         transport_wrap: Optional[Callable] = None,
+        shed_backoff_factory: Optional[Callable[[], Backoff]] = None,
+        slo_target_s: Optional[float] = None,
+        record_policy_lag: bool = False,
     ):
         self._unroll_length = unroll_length
         self._learner_queue = learner_queue
@@ -101,6 +104,25 @@ class ActorPool:
         # Chaos hook (resilience/chaos.py): wraps every fresh transport
         # so the fault plan can sever/delay/corrupt it mid-stream.
         self._transport_wrap = transport_wrap
+        # Shed handling (ISSUE 14): a ShedError from compute() is FLOW
+        # CONTROL, not a failure — the SAME env step is re-submitted
+        # after a jittered backoff, outside the reconnect budget, so a
+        # shed can never retire an actor or lose a rollout. The backoff
+        # starts smaller than the reconnect one (overload drains in
+        # batches, not in server-restart time) and resets per request.
+        self._shed_backoff_factory = shed_backoff_factory or (
+            lambda: Backoff(base_s=0.05, cap_s=1.0)
+        )
+        # Per-connection SLO (ISSUE 14 satellite): RTTs above the
+        # target count as breaches; the driver exports {target, p99,
+        # breaches} as the `slo` block on every telemetry line — the
+        # same number the shed gate's deadline uses.
+        self._slo_target_s = slo_target_s
+        # Replica serving (serving/replica.py): replies served from a
+        # snapshot carry a policy_lag leaf; central-path replies don't.
+        # Normalizing the missing leaf to 0 keeps rollouts that mix
+        # both paths structurally uniform for the learner queue.
+        self._record_policy_lag = record_policy_lag
         self._count = 0  # guarded-by: self._count_lock
         self._reconnects = 0  # guarded-by: self._count_lock
         self._dead = 0  # guarded-by: self._count_lock
@@ -124,6 +146,12 @@ class ActorPool:
         # inference batches (rollout retries) never share a series.
         self._tm_reconnects = reg.counter("recovery.actor_reconnects")
         self._tm_retries = reg.counter("recovery.batch_retries")
+        # Shed accounting twin (serving/admission.py): incremented once
+        # per ShedError received, so serving.resubmitted ==
+        # serving.shed + serving.expired holds exactly — the invariant
+        # chaos_run asserts to prove a shed is never a lost rollout.
+        self._tm_resubmits = reg.counter("serving.resubmitted")
+        self._tm_slo_breaches = reg.counter("slo.rtt_breaches")
         self._tracer = telemetry.get_tracer()
         # Sampled per-request pipeline traces: one in _TRACE_EVERY
         # computes rides a StageTrace through the batcher (enqueue ->
@@ -217,7 +245,11 @@ class ActorPool:
                 return
             except ClosedBatchingQueue:
                 return  # clean shutdown (reference actorpool.cc:452-459)
-            except (AsyncError, StateTablePoisonedError) as e:
+            except (AsyncError, ShedError, StateTablePoisonedError) as e:
+                # ShedError only escapes _request's in-place retry when
+                # the pipeline is already closing (re-raised there);
+                # the _shutting_down() check below turns it into the
+                # clean exit it is.
                 # A broken inference promise mid-training — or a DIRECT
                 # table call (the unroll-boundary read_slot, the
                 # connect-time reset) landing inside the poison-to-
@@ -391,20 +423,80 @@ class ActorPool:
 
     def _request(self, inputs, index: int):
         """One batcher round-trip with RTT telemetry and a sampled
-        per-request StageTrace (enqueue -> batch -> reply)."""
+        per-request StageTrace (enqueue -> batch -> reply).
+
+        Shed contract (ISSUE 14): a ShedError reply — the admission
+        gate refused the request at enqueue, or its deadline expired in
+        the queue — re-submits the SAME inputs after a jittered
+        backoff, forever (overload is transient by construction: the
+        gate sheds to protect drain rate). Shutdown cuts the loop via
+        ClosedBatchingQueue from compute() or the re-raised ShedError
+        when the pipeline is already closing. RTT and SLO breaches are
+        observed for SERVED requests only — a shed's fast rejection
+        must not read as a latency win."""
         trace = None
         if self._traceable:
             # beastlint: disable=RACE  sampling cadence, not an exact count: N actor threads may lose increments, which only shifts WHICH request gets traced
             self._trace_tick += 1
             if self._trace_tick % self._TRACE_EVERY == 0:
                 trace = self._tracer.stage("actor.request", actor=index)
-        t0 = time.perf_counter()
-        if trace is not None:
-            outputs = self._inference_batcher.compute(inputs, trace=trace)
-        else:
-            outputs = self._inference_batcher.compute(inputs)
-        self._tm_rtt.observe(time.perf_counter() - t0)
-        return outputs
+        shed_backoff = None
+        while True:
+            t0 = time.perf_counter()
+            try:
+                if trace is not None:
+                    outputs = self._inference_batcher.compute(
+                        inputs, trace=trace
+                    )
+                else:
+                    outputs = self._inference_batcher.compute(inputs)
+            except ShedError as e:
+                # Counted BEFORE any early exit: every ShedError raised
+                # is counted exactly once, which is what makes the
+                # resubmitted == shed + expired audit exact.
+                self._tm_resubmits.inc()
+                if trace is not None:
+                    if not getattr(e, "expired", False):
+                        # Admission-path shed: the trace never entered
+                        # the queue; close it here. (Expired sheds were
+                        # finished by the batcher's dequeue gate.)
+                        trace.stamp("shed")
+                    trace.finish()
+                    trace = None
+                if self._shutting_down():
+                    raise
+                if shed_backoff is None:
+                    shed_backoff = self._shed_backoff_factory()
+                # Sliced sleep so shutdown never waits out a backoff
+                # (the C++ twin's abort_shed callback, actor_pool.h);
+                # a shutdown mid-sleep falls through to compute(),
+                # which raises ClosedBatchingQueue -> clean exit.
+                deadline = time.monotonic() + shed_backoff.next_delay()
+                while (
+                    time.monotonic() < deadline
+                    and not self._shutting_down()
+                ):
+                    time.sleep(0.05)
+                continue
+            rtt = time.perf_counter() - t0
+            self._tm_rtt.observe(rtt)
+            if (
+                self._slo_target_s is not None
+                and rtt > self._slo_target_s
+            ):
+                self._tm_slo_breaches.inc()
+            return outputs
+
+    def _normalize_lag(self, agent_outputs):
+        """Central-path replies carry no policy_lag leaf; replicas tag
+        theirs. With lag recording on, default the missing leaf to 0 so
+        a rollout mixing both serving paths stacks uniformly."""
+        if (
+            self._record_policy_lag
+            and "policy_lag" not in agent_outputs
+        ):
+            agent_outputs["policy_lag"] = np.zeros((1, 1), np.int32)
+        return agent_outputs
 
     def _compute(self, index: int, env_outputs, agent_state, advance: bool):
         if self._state_table is not None:
@@ -418,12 +510,12 @@ class ActorPool:
                 },
                 index,
             )
-            return outputs["outputs"], agent_state
+            return self._normalize_lag(outputs["outputs"]), agent_state
         outputs = self._request(
             {"env": env_outputs, "agent_state": agent_state}, index
         )
         new_state = outputs["agent_state"]
-        agent_outputs = outputs["outputs"]
+        agent_outputs = self._normalize_lag(outputs["outputs"])
         if not advance:
             new_state = agent_state
         return agent_outputs, new_state

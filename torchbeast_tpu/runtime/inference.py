@@ -113,6 +113,8 @@ def inference_loop(
     lock: threading.Lock = None,
     pipelined: bool = False,
     state_table=None,
+    serving_hooks=None,
+    throttle_fn: Callable = None,
 ):
     """Thread body (run num_inference_threads of these).
 
@@ -160,6 +162,20 @@ def inference_loop(
     a failed STATE-TABLE step poisons the table (its buffer is donated
     into the dispatch, so it may already be consumed) — the loop fails
     the batch and re-raises to kill the thread rather than serve garbage.
+
+    `serving_hooks` (serving/replica.ReplicaServingHooks, or anything
+    with the same `begin_batch() -> (ctx, annotate)` shape) turns this
+    loop into a REPLICA serving loop: each batch's ctx overrides the
+    state table's own context (snapshot params instead of live ones) —
+    or, on the legacy path, rides as a 4th act_fn argument
+    (`act_fn(env, state, batch_size, ctx)`) — and `annotate(outputs, n)`
+    stamps the matching policy_lag into the reply at flush time, so the
+    lag recorded is the lag of the params that actually served.
+
+    `throttle_fn` (resilience/chaos.ChaosController.throttle) is the
+    chaos harness's shared-chip stall model: called once per batch
+    before dispatch; sleeps while a learner_stall window is active so
+    induced overload grows the batcher queue the way a busy chip would.
     """
     buckets = default_buckets(max_batch_size)
 
@@ -189,21 +205,25 @@ def inference_loop(
     _observe_sizes = getattr(inference_batcher, "_tm", None) is None
 
     def flush(entry):
-        batch, outputs, new_state, n = entry
+        batch, outputs, new_state, n, annotate = entry
         t_reply = time.perf_counter()
         try:
             if state_table is not None:
                 # Device-side slice + one explicit device_get; the
                 # reply carries no agent-state leaves.
-                batch.set_outputs(
-                    {"outputs": state_table.fetch(outputs, n)}
-                )
+                fetched = state_table.fetch(outputs, n)
+                if annotate is not None:
+                    fetched = annotate(fetched, n)
+                batch.set_outputs({"outputs": fetched})
                 return
             outputs = nest.map(np.asarray, outputs)
             new_state = nest.map(np.asarray, new_state)
+            outputs = slice_to(outputs, n, batch_dim)
+            if annotate is not None:
+                outputs = annotate(outputs, n)
             batch.set_outputs(
                 {
-                    "outputs": slice_to(outputs, n, batch_dim),
+                    "outputs": outputs,
                     "agent_state": slice_to(new_state, n, batch_dim),
                 }
             )
@@ -214,7 +234,19 @@ def inference_loop(
             _h_reply.observe(time.perf_counter() - t_reply)
 
     pending = None
-    for batch in inference_batcher:
+    batches = iter(inference_batcher)
+    while True:
+        # The stall gate runs BEFORE the blocking pull: a stalled chip
+        # does not pick work up, so queued requests age toward their
+        # deadline and the dequeue-side expiry gate sees the truth. A
+        # throttle placed after the pull would grab fresh requests and
+        # hold them un-expirable for the whole window.
+        if throttle_fn is not None:
+            throttle_fn()
+        try:
+            batch = next(batches)
+        except StopIteration:
+            break
         try:
             inputs = batch.get_inputs()
             env_outputs = inputs["env"]
@@ -241,31 +273,41 @@ def inference_loop(
                 _h_dispatch.observe(time.perf_counter() - t0)
                 return result
 
+            # Replica mode: ONE atomic (snapshot ctx, lag annotation)
+            # pick per batch, so the lag stamped into the reply is the
+            # lag of the params this dispatch actually used.
+            ctx = annotate = None
+            if serving_hooks is not None:
+                ctx, annotate = serving_hooks.begin_batch()
+
             if state_table is not None:
                 slots = pad_slots(
                     inputs["slot"], padded, state_table.trash_slot
                 )
                 advance = pad_advance(inputs["advance"], padded)
                 outputs = dispatch(
-                    lambda: state_table.step(slots, advance, env_padded)
+                    lambda: state_table.step(
+                        slots, advance, env_padded, context=ctx
+                    )
                 )
                 new_state = None
             else:
                 state_padded = pad_to(
                     inputs["agent_state"], padded, batch_dim
                 )
+                act_args = (env_padded, state_padded, padded)
+                if serving_hooks is not None:
+                    act_args = act_args + (ctx,)
                 if lock is not None:
                     t_lock = time.perf_counter()
                     with lock:
                         _h_lock.observe(time.perf_counter() - t_lock)
                         outputs, new_state = dispatch(
-                            lambda: act_fn(
-                                env_padded, state_padded, padded
-                            )
+                            lambda: act_fn(*act_args)
                         )
                 else:
                     outputs, new_state = dispatch(
-                        lambda: act_fn(env_padded, state_padded, padded)
+                        lambda: act_fn(*act_args)
                     )
         except Exception as e:  # noqa: BLE001
             batch.fail(e)
@@ -297,8 +339,8 @@ def inference_loop(
             flush(pending)
             pending = None
         if pipelined and inference_batcher.size() > 0:
-            pending = (batch, outputs, new_state, n)
+            pending = (batch, outputs, new_state, n, annotate)
         else:
-            flush((batch, outputs, new_state, n))
+            flush((batch, outputs, new_state, n, annotate))
     if pending is not None:  # batcher closed with a reply in flight
         flush(pending)

@@ -54,6 +54,34 @@ def available() -> bool:
     return import_native() is not None
 
 
+# The extension API generation this tree requires. Bumped when the
+# Python side starts DEPENDING on a C++ surface (not merely tolerating
+# its absence): 1 = the ISSUE 14 shed protocol (ShedError type,
+# admission kwargs on DynamicBatcher, shed counters in telemetry) —
+# an older .so would silently serve without admission control, so the
+# default-on runtime falls back to Python instead.
+REQUIRED_API_VERSION = 1
+
+
+def gap_reason(core=None) -> Optional[str]:
+    """Why the native runtime can NOT be used (None = usable). The
+    driver's default-on plumbing logs this and falls back to the
+    Python pool — `--native_runtime` behavior stays an explicit,
+    observable choice rather than an import-time surprise."""
+    if core is None:
+        core = import_native()
+    if core is None:
+        return "_tbt_core is not built (run scripts/build_native.sh)"
+    have = getattr(core, "API_VERSION", 0)
+    if have < REQUIRED_API_VERSION:
+        return (
+            f"_tbt_core is stale: API version {have} < required "
+            f"{REQUIRED_API_VERSION} (rebuild with "
+            "scripts/build_native.sh)"
+        )
+    return None
+
+
 class NativeTelemetryFolder:
     """Folds the C++ pool/batcher/queue telemetry into the registry.
 
@@ -66,10 +94,11 @@ class NativeTelemetryFolder:
     """
 
     def __init__(self, registry, pool=None, batcher=None, queue=None,
-                 tracer=None):
+                 tracer=None, slo_target_s=None):
         self._pool = pool
         self._batcher = batcher
         self._queue = queue
+        self._slo_target_s = slo_target_s
         # Sampled C++ request spans (ISSUE 12) land in the process
         # tracer as the same actor.request.* stage spans the Python
         # pool's StageTraces emit, so a native run's trace export is
@@ -95,6 +124,19 @@ class NativeTelemetryFolder:
         self._c_ring_rechecks = registry.counter("ring.recheck_wakeups")
         self._h_rtt = registry.histogram("actor.request_rtt_s")
         self._h_request_wait = registry.histogram("inference.request_wait_s")
+        # Serving-tier fold (ISSUE 14): the C++ batcher gates admission
+        # and deadline expiry in-process; its counters land on the SAME
+        # serving.* series the Python AdmissionController writes, and
+        # the C++ pool's shed_resubmits on the actor-side twin — so the
+        # chaos harness audits one schema on either runtime.
+        self._c_admitted = registry.counter("serving.admitted")
+        self._c_shed = registry.counter("serving.shed")
+        self._c_expired = registry.counter("serving.expired")
+        self._c_resubmits = registry.counter("serving.resubmitted")
+        self._c_slo_breaches = registry.counter("slo.rtt_breaches")
+        self._h_queue_delay = registry.histogram("serving.queue_delay_s")
+        self._g_delay_p99 = registry.gauge("serving.queue_delay_p99_s")
+        self._g_slo_ratio = registry.gauge("serving.slo_ratio")
         self._c_queue_in = registry.counter("learner_queue.items_in")
         self._h_queue_wait = registry.histogram(
             "learner_queue.dequeue_wait_s"
@@ -173,6 +215,10 @@ class NativeTelemetryFolder:
                     self._c_ring_rechecks, "ring_recheck_wakeups",
                     p.get("ring_recheck_wakeups", 0),
                 )
+                self._inc_delta(
+                    self._c_resubmits, "shed_resubmits",
+                    p.get("shed_resubmits", 0),
+                )
             if self._batcher is not None:
                 b = self._batcher.telemetry()
                 # batches/rows/batch_size stay with the Python serving
@@ -181,6 +227,34 @@ class NativeTelemetryFolder:
                 # them here would double-count.
                 self._fold_hist(self._h_request_wait, b["request_wait_s"])
                 self._fold_hist(self._h_rtt, b["request_rtt_s"])
+                # .get: an extension built before ISSUE 14 reports no
+                # admission accounting (and the stale gate keeps such a
+                # build off the default path anyway).
+                self._inc_delta(
+                    self._c_admitted, "serving_admitted",
+                    b.get("admitted", 0),
+                )
+                self._inc_delta(
+                    self._c_shed, "serving_shed", b.get("shed", 0)
+                )
+                self._inc_delta(
+                    self._c_expired, "serving_expired",
+                    b.get("expired", 0),
+                )
+                self._inc_delta(
+                    self._c_slo_breaches, "slo_breaches",
+                    b.get("slo_breaches", 0),
+                )
+                delay = b.get("queue_delay_s")
+                if delay is not None:
+                    self._fold_hist(self._h_queue_delay, delay)
+                    # The p99/SLO gauges the Python AdmissionController
+                    # refreshes inline are refolded here per tick from
+                    # the registry's cumulative histogram.
+                    p99 = self._h_queue_delay.percentile(0.99)
+                    self._g_delay_p99.set(p99)
+                    if self._slo_target_s:
+                        self._g_slo_ratio.set(p99 / self._slo_target_s)
                 self._fold_traces()
             if self._queue is not None:
                 q = self._queue.telemetry()

@@ -682,6 +682,7 @@ class DynamicBatcher:
         timeout_ms: Optional[float] = None,
         check_outputs: bool = True,
         telemetry_name: Optional[str] = None,
+        admission=None,
     ):
         self._batch_dim = batch_dim
         self._queue = BatchingQueue(
@@ -702,6 +703,11 @@ class DynamicBatcher:
         )
         self._check_outputs = check_outputs
         self._compute_timeout_s = 600  # reference: 10-min future timeout
+        # Overload gate (ISSUE 14, serving/admission.py): when armed,
+        # compute() may shed at enqueue (bounded queue depth) and
+        # __next__ sheds requests whose deadline expired in the queue —
+        # both as the typed ShedError the actor retry path re-submits.
+        self._admission = admission
 
     def size(self) -> int:
         return self._queue.size()
@@ -739,6 +745,10 @@ class DynamicBatcher:
         through the pipeline: stamped "enqueue" here, "batch" when the
         consumer picks the request up, "reply"/"failed" when its rows
         come back — per-request stage attribution for sampled traffic.
+
+        With an armed admission controller this may raise ShedError
+        BEFORE enqueueing (depth gate) — the caller re-submits after
+        backoff (runtime/actor_pool.py owns that retry contract).
         """
         size = np.asarray(nest.front(inputs)).shape[self._batch_dim]
         if size > self._queue._max:
@@ -746,11 +756,20 @@ class DynamicBatcher:
                 f"compute() input has {size} rows along batch_dim, more "
                 f"than maximum_batch_size={self._queue._max}"
             )
+        deadline = None
+        if self._admission is not None:
+            # May raise ShedError; checked before the trace stamps so a
+            # shed-at-admission request never emits a half-open trace.
+            deadline = self._admission.admit(self._queue.size())
         promise = _Promise()
-        t_enq = time.perf_counter() if self._tm is not None else 0.0
+        t_enq = (
+            time.perf_counter()
+            if (self._tm is not None or self._admission is not None)
+            else 0.0
+        )
         if trace is not None:
             trace.stamp("enqueue")
-        self._queue.enqueue(inputs, (promise, size, t_enq, trace))
+        self._queue.enqueue(inputs, (promise, size, t_enq, trace, deadline))
         if not promise.event.wait(timeout=self._compute_timeout_s):
             raise TimeoutError(
                 "Compute response not ready after 10 minutes"
@@ -762,18 +781,55 @@ class DynamicBatcher:
     def __iter__(self):
         return self
 
+    def _shed_expired(self, batch_inputs, payloads):
+        """Deadline gate at dequeue (ISSUE 14): fail requests that sat
+        in the queue past their deadline with the typed ShedError and
+        cut their rows out of the batch. Returns (inputs, payloads)
+        restricted to live requests — possibly ([], []) when the whole
+        batch expired."""
+        live_idx, expired_idx = self._admission.split_expired(
+            [p[4] for p in payloads], [p[2] for p in payloads]
+        )
+        if not expired_idx:
+            return batch_inputs, payloads
+        for i in expired_idx:
+            promise, _, _, trace, _ = payloads[i]
+            if trace is not None:
+                trace.stamp("shed")
+                trace.finish()
+            promise.error = self._admission.expired_error()
+            promise.event.set()
+        if not live_idx:
+            return None, []
+        offsets = np.cumsum([0] + [p[1] for p in payloads])
+        rows = np.concatenate(
+            [np.arange(offsets[i], offsets[i + 1]) for i in live_idx]
+        )
+        bd = self._batch_dim
+        batch_inputs = nest.map(
+            lambda a: np.take(np.asarray(a), rows, axis=bd), batch_inputs
+        )
+        return batch_inputs, [payloads[i] for i in live_idx]
+
     # beastlint: hot
     def __next__(self) -> Batch:
-        batch_inputs, payloads = self._queue.dequeue_many()
-        promises = [p[0] for p in payloads]
-        sizes = [p[1] for p in payloads]
-        traces = [p[3] for p in payloads if p[3] is not None]
-        if self._tm_request_wait is not None:
-            now = time.perf_counter()
-            for p in payloads:
-                self._tm_request_wait.observe(now - p[2])
-        for trace in traces:
-            trace.stamp("batch")
-        return Batch(
-            self._batch_dim, batch_inputs, promises, sizes, traces=traces
-        )
+        while True:
+            batch_inputs, payloads = self._queue.dequeue_many()
+            if self._admission is not None:
+                batch_inputs, payloads = self._shed_expired(
+                    batch_inputs, payloads
+                )
+                if not payloads:
+                    continue  # the whole batch expired in-queue
+            promises = [p[0] for p in payloads]
+            sizes = [p[1] for p in payloads]
+            traces = [p[3] for p in payloads if p[3] is not None]
+            if self._tm_request_wait is not None:
+                now = time.perf_counter()
+                for p in payloads:
+                    self._tm_request_wait.observe(now - p[2])
+            for trace in traces:
+                trace.stamp("batch")
+            return Batch(
+                self._batch_dim, batch_inputs, promises, sizes, traces=traces
+            )

@@ -234,12 +234,19 @@ class DeviceStateTable:
     def _put_ids(self, slots):
         return jax.device_put(np.asarray(slots, np.int32).reshape(-1))
 
-    def step(self, slots, advance, env_outputs):
+    def step(self, slots, advance, env_outputs, context=None):
         """One acting dispatch over already-padded inputs.
 
         slots: [n] int ids (padding rows = trash_slot), advance: [n]
         bool, env_outputs: env nest padded to n along batch_dim.
         Returns the on-device outputs nest (fetch with `fetch`).
+
+        `context` overrides the table's own context_fn for THIS
+        dispatch — the replica serving path (serving/replica.py) feeds
+        snapshot params through the same jitted step (ctx leaves are
+        traced arguments, so a replica batch never recompiles); the
+        state rows gathered/scattered are the shared table's either
+        way, so state continuity is preserved across routing changes.
 
         `input_filter` (host-side, BEFORE device_put) subsets the env
         nest to what act_fn actually reads: leaves the model ignores
@@ -249,7 +256,9 @@ class DeviceStateTable:
         """
         if self._input_filter is not None:
             env_outputs = self._input_filter(env_outputs)
-        ctx = self._context_fn() if self._context_fn is not None else None
+        ctx = context
+        if ctx is None and self._context_fn is not None:
+            ctx = self._context_fn()
         slots_d = self._put_ids(slots)
         advance_d = jax.device_put(np.asarray(advance, bool).reshape(-1))
         env_d = jax.tree_util.tree_map(jax.device_put, env_outputs)

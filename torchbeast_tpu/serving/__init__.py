@@ -1,0 +1,37 @@
+"""Overload-robust serving tier (ISSUE 14).
+
+Three pieces, composable and individually optional:
+
+- admission: bounded-depth admission control + deadline-aware load
+  shedding on the central inference path. Requests carry an enqueue
+  deadline (--request_deadline_ms); over-budget requests get a typed
+  ShedError reply that the actor pool's retry path re-submits — a shed
+  is flow control, never a lost rollout. Counters
+  serving.admitted/shed/expired/resubmitted plus a queue-delay
+  histogram feeding a p99-vs-SLO gauge.
+- snapshot: PolicySnapshotStore — the learner publishes versioned bf16
+  param snapshots every --replica_refresh_updates updates; replicas
+  refresh from it and record how stale they served.
+- replica: policy-lag-tolerant replica serving threads answering
+  acting requests from snapshots (IMPALA's off-policy correction and
+  IMPACT's clipped targets make bounded lag algorithmically safe),
+  with per-request policy_lag recorded into the rollout and lag beyond
+  --max_policy_lag degrading the replica back to the central path
+  through the resilience health machine.
+
+The typed ShedError itself lives in runtime/errors.py so the jax-free
+catch sites (the actor pool, the C++ extension's exception bridge) can
+import it without this package's numpy surface.
+"""
+
+from torchbeast_tpu.runtime.errors import ShedError  # noqa: F401
+from torchbeast_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+)
+from torchbeast_tpu.serving.replica import (  # noqa: F401
+    ReplicaRouter,
+    ReplicaServingHooks,
+)
+from torchbeast_tpu.serving.snapshot import (  # noqa: F401
+    PolicySnapshotStore,
+)

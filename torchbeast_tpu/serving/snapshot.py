@@ -1,0 +1,145 @@
+"""Versioned policy snapshots for replica serving (ISSUE 14).
+
+The learner publishes a bf16-cast copy of its params every
+--replica_refresh_updates updates; replica serving threads answer
+acting requests from the latest snapshot instead of the live learner
+params. bf16 is the publication format (half the bytes per refresh —
+the number that matters when snapshots push to env-server hosts over
+the shm/native stack); `latest()` hands serving code a tree restored
+to the ORIGINAL param dtypes (f32 params round-trip through bf16
+rounding, bf16-resident params pass through untouched), cached per
+version so repeated reads cost one dict lookup.
+
+Version bookkeeping is in UPDATES: `note_update(v)` advances the
+learner head every update, `publish(v, params)` stamps a snapshot at
+head version v, and `lag()` = head - latest snapshot version — the
+number recorded into rollouts as `policy_lag` and compared against
+--max_policy_lag by the replica health gate.
+
+`fail_next_refreshes(n)` is the chaos/test hook: the next n publishes
+are dropped (counted in serving.snapshot_refresh_failures) so the
+lag-degradation path can be exercised deterministically.
+"""
+
+import threading
+from typing import Any, Optional, Tuple
+
+from torchbeast_tpu import telemetry
+
+
+class PolicySnapshotStore:
+    def __init__(self, refresh_updates: int, registry=None):
+        if refresh_updates < 1:
+            raise ValueError(
+                f"refresh_updates must be >= 1, got {refresh_updates}"
+            )
+        self.refresh_updates = refresh_updates
+        reg = registry if registry is not None else telemetry.get_registry()
+        self._c_published = reg.counter("serving.snapshots_published")
+        self._c_refresh_failures = reg.counter(
+            "serving.snapshot_refresh_failures"
+        )
+        self._g_version = reg.gauge("serving.snapshot_version")
+        self._g_lag = reg.gauge("serving.snapshot_lag")
+        self._lock = threading.Lock()
+        self._head = 0  # guarded-by: self._lock
+        self._version = -1  # guarded-by: self._lock (-1: nothing published)
+        self._bf16 = None  # guarded-by: self._lock
+        self._dtypes = None  # guarded-by: self._lock
+        self._restored = None  # (version, tree) cache  # guarded-by: self._lock
+        self._fail_next = 0  # guarded-by: self._lock
+
+    # -- learner side -----------------------------------------------------
+    def note_update(self, version: int) -> bool:
+        """Advance the learner head; returns True when a refresh is DUE
+        — the head has run >= refresh_updates past the last snapshot
+        (or nothing is published yet). Due-based rather than modulo so
+        superstep strides (version advances by K per dispatch) and
+        dropped refreshes (the failure hook) retry on the next update
+        instead of waiting for the next aligned boundary."""
+        with self._lock:
+            self._head = version
+            if self._version < 0:
+                lag, due = version, True
+            else:
+                lag = version - self._version
+                due = lag >= self.refresh_updates
+        self._g_lag.set(lag)
+        return due
+
+    def publish(self, version: int, params: Any) -> bool:
+        """Stamp a bf16 snapshot at `version`. Returns False when the
+        refresh was dropped (the injected-failure hook)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                drop = True
+            else:
+                drop = False
+        if drop:
+            self._c_refresh_failures.inc()
+            return False
+        dtypes = jax.tree_util.tree_map(lambda a: a.dtype, params)
+        bf16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            params,
+        )
+        with self._lock:
+            self._version = version
+            self._head = max(self._head, version)
+            self._bf16 = bf16
+            self._dtypes = dtypes
+            self._restored = None
+        self._c_published.inc()
+        self._g_version.set(version)
+        self._g_lag.set(0)
+        return True
+
+    def fail_next_refreshes(self, n: int) -> None:
+        with self._lock:
+            self._fail_next += int(n)
+
+    # -- replica side -----------------------------------------------------
+    @property
+    def head(self) -> int:
+        with self._lock:
+            return self._head
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def lag(self) -> int:
+        """Updates the latest snapshot trails the learner head by."""
+        with self._lock:
+            if self._version < 0:
+                return self._head
+            return self._head - self._version
+
+    def latest(self) -> Optional[Tuple[int, Any]]:
+        """(version, params restored to their original dtypes), or None
+        before the first publish. The restored tree is cached per
+        version — replicas read this per batch."""
+        import jax
+
+        with self._lock:
+            if self._bf16 is None:
+                return None
+            if self._restored is not None and (
+                self._restored[0] == self._version
+            ):
+                return self._restored
+            version, bf16, dtypes = self._version, self._bf16, self._dtypes
+        restored = jax.tree_util.tree_map(
+            lambda a, dt: a.astype(dt) if a.dtype != dt else a, bf16, dtypes
+        )
+        with self._lock:
+            # Last-writer-wins on a racing publish is fine: the cache is
+            # re-validated against _version on the next read.
+            self._restored = (version, restored)
+        return (version, restored)
