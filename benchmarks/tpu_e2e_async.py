@@ -75,15 +75,42 @@ def run_config(args, native, shm, log_path, tag):
     ]
     if args.use_lstm:
         cmd += ["--use_lstm"]
+    # The runtime is pinned EXPLICITLY either way (chaos_run.py's
+    # convention): since the ISSUE 14 native-first default flip, a leg
+    # that merely omits --native_runtime would silently run the C++
+    # pool — and a "python baseline" that is secretly native corrupts
+    # every ratio this bench publishes.
     if native:
         cmd += ["--native_runtime"]
         if args.native_server:
             cmd += ["--native_server"]
+    else:
+        cmd += ["--no_native_runtime"]
     if args.no_device_agent_state:
         cmd += ["--no_device_agent_state"]
+    if getattr(args, "device_split", ""):
+        cmd += ["--device_split", args.device_split]
+    n_learn = getattr(args, "num_learner_devices", 0) or 0
+    if n_learn > 1:
+        cmd += ["--num_learner_devices", str(n_learn)]
 
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
+    # Forced host devices (the Sebulba scaling curve's CPU lane): the
+    # child sees N virtual devices; the flag replaces any inherited
+    # count so legs can't leak their topology into each other.
+    n_forced = getattr(args, "xla_device_count", 0) or 0
+    if n_forced:
+        flags_env = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags_env} "
+            f"--xla_force_host_platform_device_count={n_forced}"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
     # Each leg runs in its own process group and the WHOLE group is
     # killed on timeout: the driver's spawned env-server children
     # otherwise outlive the timeout kill and poison the next leg's
@@ -163,6 +190,17 @@ def run_config(args, native, shm, log_path, tag):
                 / (final_snap["time"] - mid["time"]),
                 1,
             )
+    # Ring-wait counters (ISSUE 12/15, ROADMAP item 1): the adaptive
+    # doorbell recheck's metastability signature — committed with the
+    # parity artifact so the counters have an in-anger baseline.
+    ring = None
+    if final_snap:
+        counters = final_snap.get("counters", {})
+        ring = {
+            k: int(counters[k])
+            for k in ("ring.doorbell_waits", "ring.recheck_wakeups")
+            if k in counters
+        } or None
     if not rows:
         return {
             "error": f"no telemetry rows parsed (rc={rc}, "
@@ -176,11 +214,11 @@ def run_config(args, native, shm, log_path, tag):
     return {
         "config": {
             **{
-                k: getattr(args, k)
+                k: getattr(args, k, None)
                 for k in ("env", "model", "use_lstm", "num_servers",
                           "num_actors", "batch_size", "unroll_length",
                           "total_steps", "superstep_k",
-                          "no_device_agent_state")
+                          "no_device_agent_state", "device_split")
             },
             "native": native,
             "transport": "shm" if shm else "socket",
@@ -196,6 +234,8 @@ def run_config(args, native, shm, log_path, tag):
         # Acting-path wire accounting from the run's telemetry snapshot:
         # which side holds agent state and what crosses per step.
         "acting_path": acting,
+        # shm doorbell-wait counters (None on socket transports).
+        "ring": ring,
         # The run's final cumulative telemetry snapshot — bench variance
         # is attributable (queue wait vs batch wait vs dispatch) without
         # re-running under a profiler.
@@ -242,6 +282,17 @@ def main():
                     help="Legacy acting path (agent state rides every "
                          "inference request/reply) — for before/after "
                          "comparison against the device-resident table.")
+    ap.add_argument("--device_split", default="",
+                    help="Forwarded to polybeast: the Sebulba device "
+                         "split spec ('auto' / 'inf=K,learn=rest|M'; "
+                         "Python runtime). Combine with "
+                         "--xla_device_count for a forced-host-device "
+                         "CPU lane.")
+    ap.add_argument("--xla_device_count", type=int, default=0,
+                    help="Run the child with JAX_PLATFORMS=cpu and N "
+                         "forced host devices (XLA_FLAGS "
+                         "--xla_force_host_platform_device_count=N). "
+                         "0 = inherit the ambient backend.")
     ap.add_argument("--out", default="/tmp/tbt_e2e.log")
     ap.add_argument("--artifact", default=_ARTIFACT,
                     help="Comparison-verdict artifact path ('' skips "

@@ -107,6 +107,10 @@ def parse_args(argv=None):
     # snapshot/lag machinery too (Python runtime only).
     p.add_argument("--replica_refresh_updates", type=int, default=0)
     p.add_argument("--max_policy_lag", type=int, default=20)
+    # Continuous-batching depth knob forwarded verbatim (same
+    # type/default as polybeast, FLAG-PARITY-checked): the admission
+    # gate's queue bound as a multiple of max_inference_batch_size.
+    p.add_argument("--admission_depth_factor", type=int, default=4)
     # Resilience knobs forwarded to BOTH legs: re-declared here (same
     # type/default as polybeast) so beastlint FLAG-PARITY keeps the
     # chaos harness from drifting away from the driver's resilience
@@ -234,6 +238,7 @@ def make_flags(args, savedir, xpid, chaos_plan_path=None):
         "--max_actor_reconnects", str(args.max_actor_reconnects),
         "--learner_stall_timeout_s", str(args.learner_stall_timeout_s),
         "--request_deadline_ms", str(args.request_deadline_ms),
+        "--admission_depth_factor", str(args.admission_depth_factor),
         "--replica_refresh_updates", str(args.replica_refresh_updates),
         "--max_policy_lag", str(args.max_policy_lag),
     ]

@@ -51,6 +51,27 @@ python -m torchbeast_tpu.analysis --check-protocol
 if [[ "$FAST" -eq 0 ]]; then
     echo "== check: chaos selftest, scaled (x2 fleet + x2 fault plan, shed audit)"
     JAX_PLATFORMS=cpu python scripts/chaos_run.py --selftest --scale 2
+
+    echo "== check: Sebulba split smoke (2 forced host devices, inf=1,learn=rest)"
+    # The async driver end to end with the device split on a forced
+    # 2-device CPU topology (ISSUE 15): per-slice serving + the
+    # DP-pinned learner mesh must train a short Mock run to completion.
+    python benchmarks/tpu_e2e_async.py \
+        --device_split inf=1,learn=rest --xla_device_count 2 \
+        --model mlp --use_lstm --num_servers 2 --num_actors 4 \
+        --batch_size 4 --unroll_length 10 --total_steps 4000 \
+        --timeout_s 240 --out /tmp/tbt_split_smoke.log \
+        > /tmp/tbt_split_smoke.json
+    python - <<'EOF'
+import json
+summary = json.load(open("/tmp/tbt_split_smoke.json"))
+assert "error" not in summary, summary
+snap = summary["telemetry"]["snapshot"]
+assert snap["device_split"]["inference_slices"] == 1, snap["device_split"]
+assert snap["learner.mesh_shape"] == {"data": 1, "model": 1}
+assert "inference.slice.0.depth" in snap["gauges"]
+print("sebulba-smoke: PASS (steady sps", summary["steady_sps_mean"], ")")
+EOF
 fi
 
 echo "== check: PASS"

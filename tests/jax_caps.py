@@ -147,6 +147,21 @@ def shardy_spmd_dense_tp_grad_sound() -> bool:
     return _dense_tp_grad_repro(use_shardy=True)
 
 
+def has_multi_device_cpu(n: int = 2) -> bool:
+    """Whether this process sees >= n jax devices. tests/conftest.py
+    forces `--xla_force_host_platform_device_count=8` before jax
+    initializes; on a jax/XLA where that flag is unsupported (or was
+    overridden) the process sees a single device and the Sebulba
+    device-split suites (tests/test_sebulba.py) SKIP visibly instead
+    of failing — same contract as the other probes here."""
+    import jax
+
+    try:
+        return len(jax.devices()) >= n
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
 def mosaic_lowers_stop_gradient() -> bool:
     """Client-side Mosaic (Pallas->TPU) lowering of a kernel containing
     stop_gradient — the construct ops/pallas_attention.py uses; some

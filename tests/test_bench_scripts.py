@@ -388,6 +388,35 @@ def test_chaos_run_selftest(tmp_path):
     assert saved["bench"] == "chaos_run" and saved["ok"] is True
 
 
+def test_dryrun_multichip_selftest(tmp_path):
+    """dryrun_multichip --selftest (ISSUE 15): one tiny run per row
+    family (time-shared baseline + 2-forced-device inference-pinned
+    split) with the scaling-curve row schema pinned — every row must
+    carry the provenance block (`fresh`, forced topology matching the
+    row's device count, jax version) so the committed curve follows
+    the fresh:false replay discipline."""
+    proc = _run(["benchmarks/dryrun_multichip.py", "--selftest"])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "dryrun_multichip_scaling"
+    assert out["selftest"]["ok"] is True
+    assert out["selftest"]["schema_ok"] is True
+    families = {r["family"] for r in out["rows"]}
+    assert families == {"time_shared", "inference_pinned"}
+    for row in out["rows"]:
+        prov = row["provenance"]
+        assert prov["fresh"] is True
+        assert prov["topology"]["device_count"] == row["n_devices"]
+        assert str(row["n_devices"]) in prov["topology"]["forced"]
+        assert prov["jax"]
+        assert row["updates_per_s"] > 0
+        if row["family"] == "inference_pinned":
+            assert row["device_split"] == "inf=1,learn=1"
+    # The acceptance block is present with the CPU no-regression bar
+    # (the verdict itself is the full curve's job, not the selftest's).
+    assert out["acceptance"]["required_min_ratio"] == 0.9
+
+
 def test_chaos_run_plan_scaling_rule():
     """The --scale plan-scaling rule, pinned WITHOUT a full run: scale
     N plans N SIGKILLs on servers 0..N-1 and N severs on actors

@@ -14,6 +14,12 @@ from torchbeast_tpu.parallel.ep import (  # noqa: F401
     expert_param_shardings,
     place_expert_params,
 )
+from torchbeast_tpu.parallel.sebulba import (  # noqa: F401
+    SebulbaServing,
+    ShardedStateTables,
+    SliceRouter,
+    build_sebulba_serving,
+)
 from torchbeast_tpu.parallel.pp import (  # noqa: F401
     pipeline_apply,
     stack_stages,

@@ -82,7 +82,14 @@ def make_parallel_update_step(
     dispatch overhead identically to single-device ones. The grad
     all-reduce happens inside every scan iteration (each scanned update
     consumes its own full global batch), so K scanned collective updates
-    match K sequential parallel dispatches.
+    match K sequential parallel dispatches. The Sebulba device split
+    (runtime/placement.py) compiles its learner superstep through this
+    exact path over a mesh spanning only the split's learner devices
+    (`create_mesh(devices=split.learner_devices)`) — K=1-vs-K=2 parity
+    on a 2-device mesh is pinned by tests/test_sebulba.py. (A 1-device
+    learner group deliberately does NOT come here: polybeast pins the
+    plain-jit update by explicit placement instead — the SPMD
+    partitioner costs ~1.7x on a partition-of-one.)
 
     Precision (--precision bf16_train, torchbeast_tpu/precision.py):
     the staged stack's float leaves may arrive bfloat16 — shardings are

@@ -248,6 +248,41 @@ def make_parser():
                              "--num_learner_devices DP on one "
                              "(data x model) mesh; model=transformer "
                              "only.")
+    parser.add_argument("--device_split", default="",
+                        help="Sebulba device split (runtime/placement."
+                             "py): partition jax.devices() into "
+                             "dedicated inference slices + a learner "
+                             "mesh, so acting batches never time-share "
+                             "a chip with the update step. 'auto' pins "
+                             "1 of every 4 devices to inference; "
+                             "'inf=K,learn=rest' (or learn=M) pins "
+                             "exactly. Each inference device is one "
+                             "slice with its own batcher and pinned "
+                             "DeviceStateTable; actors hash statically "
+                             "to slices (slot state never migrates); "
+                             "slices serve versioned snapshots "
+                             "published device-to-device through the "
+                             "PolicySnapshotStore (--replica_refresh_"
+                             "updates sets the cadence, default every "
+                             "update; --max_policy_lag degradation "
+                             "applies per slice). The learner superstep "
+                             "compiles over the remaining devices as a "
+                             "DP mesh (batch_size divisible by learner "
+                             "device count). Empty = today's "
+                             "time-shared path; a single-device "
+                             "process degrades to it with a warning. "
+                             "Python runtime only today.")
+    parser.add_argument("--admission_depth_factor", type=int, default=4,
+                        help="Admission-gate queue-depth bound as a "
+                             "multiple of --max_inference_batch_size "
+                             "(the continuous-batching depth knob, "
+                             "both runtimes): with --request_deadline_"
+                             "ms armed, requests arriving while a "
+                             "serving queue already holds factor * "
+                             "max_batch pending rows are shed. Deeper "
+                             "keeps the formation pipeline fed under "
+                             "bursts; shallower sheds earlier instead "
+                             "of manufacturing deadline expiries.")
     parser.add_argument("--num_learner_devices", type=int, default=1,
                         help="Width of the DATA-parallel axis: params "
                              "replicated, batch sharded over it, ICI "
@@ -288,7 +323,8 @@ def make_parser():
                              "requests carry this enqueue deadline — "
                              "requests that would queue past it (or "
                              "arrive while the queue is at its depth "
-                             "bound, 4x max_inference_batch_size) are "
+                             "bound, --admission_depth_factor x "
+                             "max_inference_batch_size) are "
                              "shed with a typed ShedReply the actor "
                              "re-submits after backoff, so overload "
                              "degrades tail latency instead of "
@@ -469,6 +505,44 @@ def train(flags):
                 f"divisible by the {proc_count} processes"
             )
     local_rows = flags.batch_size // proc_count
+    # Sebulba device split (ISSUE 15, runtime/placement.py): resolved —
+    # and its composition rules rejected — BEFORE any side effects
+    # (FileWriter dir, server spawns). None = time-shared path, incl.
+    # the single-device degradation.
+    from torchbeast_tpu.runtime.placement import (
+        resolve_device_split,
+        validate_split_composition,
+    )
+
+    split = resolve_device_split(
+        getattr(flags, "device_split", ""), jax.devices()
+    )
+    validate_split_composition(
+        flags, split,
+        parallel_flags=("expert_parallel", "sequence_parallel",
+                        "pipeline_parallel", "tensor_parallel"),
+    )
+    if split is not None:
+        if proc_count > 1:
+            raise ValueError(
+                "--device_split is single-host today (the multi-host "
+                "Sebulba composes the split per host over DCN — a "
+                "follow-up; see ROADMAP)"
+            )
+        if flags.native_runtime is True:
+            raise RuntimeError(
+                "--device_split is a Python-runtime feature today (the "
+                "slice router sits in the Python actor pool's request "
+                "path, like replica serving); drop --native_runtime"
+            )
+    if getattr(flags, "admission_depth_factor", 4) < 1:
+        # Pure flag predicate — rejected BEFORE any side effects, like
+        # the split checks above (the serving-setup site that consumes
+        # it runs after servers have spawned).
+        raise ValueError(
+            "--admission_depth_factor must be >= 1, got "
+            f"{flags.admission_depth_factor}"
+        )
     if flags.xpid is None:
         flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
     plogger = FileWriter(
@@ -583,7 +657,25 @@ def train(flags):
                 )
         pipe_par = getattr(flags, "pipeline_parallel", 0)
         learner_mesh = None
-        if flags.num_learner_devices > 1 or tensor_par > 1:
+        learner_device = None
+        if split is not None:
+            if len(split.learner_devices) > 1:
+                # The split's learner mesh: plain DP over exactly the
+                # learner devices (data=N, model=1).
+                from torchbeast_tpu.parallel import create_mesh
+
+                learner_mesh = create_mesh(
+                    devices=list(split.learner_devices)
+                )
+            else:
+                # ONE learner device: plain jit pinned by explicit
+                # placement (params/opt/batch committed there). A
+                # 1-device mesh would pull the update through the SPMD
+                # partitioner for nothing — measured ~1.7x slower per
+                # update on the CPU lane, which starved the acting
+                # side of the whole 2-core box.
+                learner_device = split.learner_devices[0]
+        elif flags.num_learner_devices > 1 or tensor_par > 1:
             from torchbeast_tpu.parallel import create_mesh
 
             inner = (
@@ -615,6 +707,15 @@ def train(flags):
         remat_plan = remat_plan_lib.last_plan()
         if remat_plan is not None:
             tele.set_static("learner.remat_plan", remat_plan.summary())
+        # The learner mesh shape rides every telemetry line (same
+        # convention as acting_path): {"data": N, "model": 1, ...} for
+        # meshed learners, the 1x1 placeholder for the single-device
+        # update step.
+        tele.set_static(
+            "learner.mesh_shape",
+            {k: int(v) for k, v in learner_mesh.shape.items()}
+            if learner_mesh is not None else {"data": 1, "model": 1},
+        )
         if (
             getattr(flags, "opt_impl", "xla") == "pallas"
             and learner_mesh is not None
@@ -674,10 +775,11 @@ def train(flags):
                 shard_batch,
             )
 
-            if flags.batch_size % flags.num_learner_devices != 0:
+            data_size = int(learner_mesh.shape["data"])
+            if flags.batch_size % data_size != 0:
                 raise ValueError(
                     f"batch_size {flags.batch_size} not divisible by "
-                    f"num_learner_devices {flags.num_learner_devices}"
+                    f"the learner mesh's data axis ({data_size})"
                 )
             # Param/opt sharding rules: EP shards the MoE expert kernels, TP
             # the attention/dense-FFN leaves — disjoint sets, merged onto
@@ -747,10 +849,17 @@ def train(flags):
             )
             log.info(
                 "Parallel learner: data=%d%s (%d chips total, %d processes)",
-                flags.num_learner_devices, inner_desc,
-                flags.num_learner_devices * inner, proc_count,
+                data_size, inner_desc,
+                len(learner_mesh.devices.flat), proc_count,
             )
         else:
+            if learner_device is not None:
+                # Pin the whole update chain to the split's learner
+                # device: committed params/opt here, committed batches
+                # in _place below — the jit executes where its inputs
+                # live, no mesh machinery needed.
+                params = jax.device_put(params, learner_device)
+                opt_state = jax.device_put(opt_state, learner_device)
             if superstep_k > 1:
                 # One dispatch = K scanned updates; the staged arena
                 # stack is consumed exactly once (consume-once deletion,
@@ -867,6 +976,15 @@ def train(flags):
         # publish Python-pool numbers as native ones).
         native_pref = flags.native_runtime  # None=auto, True/False=forced
         use_native = native_pref is not False
+        if split is not None and use_native:
+            # Explicit --native_runtime was already rejected at flag
+            # validation; the native-first default falls back to the
+            # Python pool, where the slice router lives.
+            use_native = False
+            log.info(
+                "Device split active: serving through the Python pool "
+                "(the slice router sits in its request path)"
+            )
         if use_native:
             from torchbeast_tpu.runtime.native import (
                 gap_reason,
@@ -892,13 +1010,16 @@ def train(flags):
 
         # Admission control + deadline-aware load shedding on the
         # central inference path (ISSUE 14, serving/admission.py):
-        # armed by --request_deadline_ms. The depth bound defaults to
-        # 4x the max batch — deep enough that the consumer's formation
-        # pipeline never starves, shallow enough that queueing past it
-        # only manufactures deadline expiries.
+        # armed by --request_deadline_ms. The depth bound is
+        # --admission_depth_factor x the max batch (default 4) — deep
+        # enough that the consumer's formation pipeline never starves,
+        # shallow enough that queueing past it only manufactures
+        # deadline expiries.
         deadline_ms = getattr(flags, "request_deadline_ms", 0.0) or 0.0
+        depth_factor = getattr(flags, "admission_depth_factor", 4)
         shed_depth = (
-            4 * flags.max_inference_batch_size if deadline_ms > 0 else None
+            depth_factor * flags.max_inference_batch_size
+            if deadline_ms > 0 else None
         )
         slo_target_s = deadline_ms / 1000.0 if deadline_ms > 0 else None
         admission = None
@@ -942,13 +1063,18 @@ def train(flags):
             check_inputs=True,
             **queue_tm,
         )
-        inference_batcher = queue_mod.DynamicBatcher(
-            batch_dim=1,
-            minimum_batch_size=1,
-            maximum_batch_size=flags.max_inference_batch_size,
-            timeout_ms=flags.inference_timeout_ms,
-            **batcher_tm,
-        )
+        # Split mode has no CENTRAL batcher: each inference slice owns
+        # one (parallel/sebulba.py, built below once the model exists);
+        # the router presents the batcher-shaped surface to the pool.
+        inference_batcher = None
+        if split is None:
+            inference_batcher = queue_mod.DynamicBatcher(
+                batch_dim=1,
+                minimum_batch_size=1,
+                maximum_batch_size=flags.max_inference_batch_size,
+                timeout_ms=flags.inference_timeout_ms,
+                **batcher_tm,
+            )
 
         # The model's acting inputs (a subset of the actor traffic's
         # _ENV_KEYS nest) — ONE definition for the central act path,
@@ -988,11 +1114,10 @@ def train(flags):
         # through its slot hooks, pymodule.cc); stateless models have
         # nothing to keep resident and fall back.
         state_table = None
-        if (
-            getattr(flags, "device_agent_state", True)
-            and jax.tree_util.tree_leaves(act_model.initial_state(1))
-        ):
-            from torchbeast_tpu.runtime.state_table import DeviceStateTable
+        stateful_acting = getattr(
+            flags, "device_agent_state", True
+        ) and bool(jax.tree_util.tree_leaves(act_model.initial_state(1)))
+        if stateful_acting:
 
             def _table_ctx():
                 with state_lock:
@@ -1017,21 +1142,97 @@ def train(flags):
                 }
                 return outputs, new_state
 
+            # Host-side subset to the model's inputs BEFORE
+            # device_put: actor traffic carries the full _ENV_KEYS
+            # nest (episode_step/episode_return included), which the
+            # model never reads — without the filter those leaves
+            # transfer every dispatch AND the 4-key prewarm dummy
+            # compiles a signature real 6-key traffic misses.
+            def _table_filter(env):
+                return {k: env[k] for k in _MODEL_KEYS}
+
+        if stateful_acting and split is None:
+            from torchbeast_tpu.runtime.state_table import DeviceStateTable
+
             state_table = DeviceStateTable(
                 act_model.initial_state(1),
                 num_slots=num_actors,
                 act_fn=_table_act,
                 context_fn=_table_ctx,
                 batch_dim=1,
-                # Host-side subset to the model's inputs BEFORE
-                # device_put: actor traffic carries the full _ENV_KEYS
-                # nest (episode_step/episode_return included), which the
-                # model never reads — without the filter those leaves
-                # transfer every dispatch AND the 4-key prewarm dummy
-                # compiles a signature real 6-key traffic misses.
-                input_filter=lambda env: {
-                    k: env[k] for k in _MODEL_KEYS
-                },
+                input_filter=_table_filter,
+            )
+
+        # The chaos learner_stall gate (shared-chip overload model):
+        # consulted by the learner's dispatch site and every serving
+        # loop's per-batch site; None when chaos is unarmed. Defined
+        # before serving construction — slice loops bind it then.
+        throttle = chaos.throttle if chaos is not None else None
+
+        # Sebulba split serving (ISSUE 15, parallel/sebulba.py): one
+        # batcher + pinned DeviceStateTable + serving loop per
+        # inference slice, all answering from versioned snapshots the
+        # learner publishes device-to-device through the
+        # PolicySnapshotStore (--replica_refresh_updates sets the
+        # cadence; default: every update). The ShardedStateTables view
+        # drops into every single-table consumer (pool, supervisor,
+        # chaos) unchanged.
+        sebulba = None
+        snapshot_store = None
+        refresh_updates = getattr(flags, "replica_refresh_updates", 0) or 0
+        if split is not None:
+            from torchbeast_tpu.parallel.sebulba import (
+                build_sebulba_serving,
+            )
+            from torchbeast_tpu.serving import PolicySnapshotStore
+
+            snapshot_store = PolicySnapshotStore(
+                max(1, refresh_updates), registry=reg
+            )
+            # Version 0 = the initial params, published before serving
+            # starts so no slice is ever empty-handed.
+            snapshot_store.note_update(0)
+            snapshot_store.publish(0, state["infer_params"])
+
+            def _split_legacy_act(env_outputs, agent_state, batch_size,
+                                  ctx):
+                params_now, key = ctx
+                return _act_with(params_now, key, env_outputs,
+                                 agent_state)
+
+            sebulba = build_sebulba_serving(
+                split,
+                snapshot_store,
+                num_slots=num_actors,
+                max_batch_size=flags.max_inference_batch_size,
+                timeout_ms=flags.inference_timeout_ms,
+                max_policy_lag=flags.max_policy_lag,
+                rng_seed=flags.seed,
+                initial_state=(
+                    act_model.initial_state(1) if stateful_acting
+                    else None
+                ),
+                table_act_fn=_table_act if stateful_acting else None,
+                legacy_act_fn=(
+                    None if stateful_acting else _split_legacy_act
+                ),
+                input_filter=(
+                    _table_filter if stateful_acting else None
+                ),
+                health=health,
+                registry=reg,
+                admission=admission,
+                throttle_fn=throttle,
+            )
+            state_table = sebulba.state_tables
+            tele.set_static("device_split", split.describe())
+            if telemetry_on:
+                tele.add_tick_callback(sebulba.gauge_tick(reg))
+            log.info(
+                "Sebulba serving: %d slice(s), snapshot refresh every "
+                "%d update(s), max policy lag %d",
+                split.n_slices, max(1, refresh_updates),
+                flags.max_policy_lag,
             )
 
         if chaos is not None:
@@ -1085,7 +1286,32 @@ def train(flags):
             buckets = default_buckets(flags.max_inference_batch_size)
             for b in buckets:
                 dummy_env = dummy_env_outputs(1, b, frame_shape, frame_dtype)
-                if state_table is not None:
+                if sebulba is not None:
+                    # Per-slice prewarm with a REAL snapshot ctx (ctx
+                    # leaves are traced, so live batches hit the same
+                    # compiled signature). The stateless path compiles
+                    # per slice device too — the jit cache is keyed by
+                    # the ctx params' device.
+                    for stack in sebulba.stacks:
+                        ctx, _ = stack.hooks.begin_batch()
+                        if stack.state_table is not None:
+                            stack.state_table.step(
+                                np.full(
+                                    b, stack.state_table.trash_slot,
+                                    np.int32,
+                                ),
+                                np.zeros(b, bool),
+                                dummy_env,
+                                context=ctx,
+                            )
+                        else:
+                            dummy_state = jax.tree_util.tree_map(
+                                np.asarray, act_model.initial_state(b)
+                            )
+                            _split_legacy_act(
+                                dummy_env, dummy_state, b, ctx
+                            )
+                elif state_table is not None:
                     # Compile the table step per bucket: all-trash slots,
                     # advance=False — no real slot is disturbed.
                     state_table.step(
@@ -1103,11 +1329,6 @@ def train(flags):
                 len(buckets), time.time() - t0,
             )
 
-        # The chaos learner_stall gate (shared-chip overload model):
-        # consulted by the learner's dispatch site and every serving
-        # loop's per-batch site; None when chaos is unarmed.
-        throttle = chaos.throttle if chaos is not None else None
-
         # Snapshotted policy replicas (ISSUE 14, serving/): the learner
         # publishes versioned bf16 snapshots every
         # --replica_refresh_updates; replica serving threads answer
@@ -1118,8 +1339,12 @@ def train(flags):
         # path via the health machine. Python runtime only: the router
         # sits in the Python pool's request path.
         replica_parts = None
-        refresh_updates = getattr(flags, "replica_refresh_updates", 0) or 0
-        if refresh_updates > 0 and use_native:
+        if split is not None:
+            # The slices ARE snapshot serving under the split;
+            # --replica_refresh_updates already set the publish cadence
+            # above, so a separate replica tier would be redundant.
+            pass
+        elif refresh_updates > 0 and use_native:
             log.warning(
                 "--replica_refresh_updates is a Python-runtime feature "
                 "today (the routing sits in the Python actor pool); "
@@ -1135,7 +1360,7 @@ def train(flags):
 
             snapshot_store = PolicySnapshotStore(
                 refresh_updates, registry=reg
-            )
+            )  # the learner loop publishes into whichever store exists
             # Version 0 = the initial params, published before serving
             # starts so the replica path is never empty-handed.
             snapshot_store.note_update(0)
@@ -1191,39 +1416,82 @@ def train(flags):
                 "max policy lag %d", refresh_updates, flags.max_policy_lag,
             )
 
-        def _serve_loop():
-            # Pipelined dispatch only with a single consumer thread: its
-            # held-reply optimization is unsafe with several threads
-            # draining one batcher (runtime/inference.py docstring);
-            # with >1 threads the overlap comes from the threads.
-            inference_loop(
-                inference_batcher,
-                act_fn,
-                flags.max_inference_batch_size,
-                lock=None,
-                pipelined=flags.num_inference_threads == 1,
-                state_table=state_table,
-                throttle_fn=throttle,
-            )
-
         # Supervised serving threads (ISSUE 6): a poisoned state table
         # no longer ends the run — the supervisor rebuilds it from
         # initial state and restarts the thread, up to
         # --inference_restart_budget times; exhaustion goes HALTED
         # (checkpoint-and-exit below) instead of wedging the actors.
-        # Replica loops (when armed) ride the SAME supervisor: they
-        # share the state table, so poison recovery must rebuild once
+        # Replica/slice loops ride the SAME supervisor: they share the
+        # (sharded) state table, so poison recovery must rebuild once
         # and restart every serving thread under one budget.
-        infer_supervisor = InferenceSupervisor(
-            _serve_loop,
-            num_threads=flags.num_inference_threads,
-            state_table=state_table,
-            restart_budget=getattr(flags, "inference_restart_budget", 3),
-            health=health,
-            registry=reg,
-            extra_loop_fns=(
-                [_replica_loop] if replica_parts is not None else None
-            ),
+        if sebulba is not None:
+            # --num_inference_threads serving threads PER SLICE (same
+            # host-side overlap the central path gets): each slice's
+            # threads drain only that slice's batcher, so the pinned
+            # dispatch story is unchanged.
+            slice_loops = [
+                loop
+                for loop in sebulba.loop_fns
+                for _ in range(max(1, flags.num_inference_threads))
+            ]
+            infer_supervisor = InferenceSupervisor(
+                slice_loops[0],
+                num_threads=1,
+                state_table=state_table,
+                restart_budget=getattr(
+                    flags, "inference_restart_budget", 3
+                ),
+                health=health,
+                registry=reg,
+                extra_loop_fns=slice_loops[1:],
+            )
+        else:
+            def _serve_loop():
+                # Pipelined dispatch only with a single consumer
+                # thread: its held-reply optimization is unsafe with
+                # several threads draining one batcher
+                # (runtime/inference.py docstring); with >1 threads
+                # the overlap comes from the threads.
+                inference_loop(
+                    inference_batcher,
+                    act_fn,
+                    flags.max_inference_batch_size,
+                    lock=None,
+                    pipelined=flags.num_inference_threads == 1,
+                    state_table=state_table,
+                    throttle_fn=throttle,
+                )
+
+            infer_supervisor = InferenceSupervisor(
+                _serve_loop,
+                num_threads=flags.num_inference_threads,
+                state_table=state_table,
+                restart_budget=getattr(
+                    flags, "inference_restart_budget", 3
+                ),
+                health=health,
+                registry=reg,
+                extra_loop_fns=(
+                    [_replica_loop] if replica_parts is not None else None
+                ),
+            )
+
+        # The batcher-shaped surface the pool (and the monitor's depth
+        # series) talks to: the slice router under the split, the
+        # replica router when replicas are armed, else the central
+        # batcher itself.
+        if sebulba is not None:
+            serving_frontend = sebulba.router
+        elif replica_parts is not None:
+            serving_frontend = replica_parts["router"]
+        else:
+            serving_frontend = inference_batcher
+        # Monitor depth series: the central batcher where one exists
+        # (replica mode keeps its historical central-only semantics);
+        # the router's summed slice depths under the split.
+        serving_depth_fn = (
+            inference_batcher.size if inference_batcher is not None
+            else sebulba.router.size
         )
 
         pool_cls = queue_mod.ActorPool if use_native else ActorPool
@@ -1231,11 +1499,11 @@ def train(flags):
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
         if not use_native:
-            # SLO breach accounting + replica routing live actor-side
-            # in the Python pool (the C++ pool counts breaches
-            # batcher-side and retries sheds in its own loops).
+            # SLO breach accounting + replica/slice routing live
+            # actor-side in the Python pool (the C++ pool counts
+            # breaches batcher-side and retries sheds in its own loops).
             pool_kwargs["slo_target_s"] = slo_target_s
-            if replica_parts is not None:
+            if replica_parts is not None or sebulba is not None:
                 pool_kwargs["record_policy_lag"] = True
         # Chaos interposition (ISSUE 6/12) on EITHER runtime: the Python
         # pool wraps each fresh transport in a FaultingTransport; the
@@ -1249,10 +1517,7 @@ def train(flags):
         actors = pool_cls(
             unroll_length=flags.unroll_length,
             learner_queue=learner_queue,
-            inference_batcher=(
-                replica_parts["router"]
-                if replica_parts is not None else inference_batcher
-            ),
+            inference_batcher=serving_frontend,
             env_server_addresses=addresses,
             initial_agent_state=model.initial_state(1),
             max_reconnects=flags.max_actor_reconnects,
@@ -1284,7 +1549,7 @@ def train(flags):
         def _stall_diagnostics():
             return {
                 "learner_queue": learner_queue.size(),
-                "inference_batcher": inference_batcher.size(),
+                "inference_batcher": serving_depth_fn(),
                 "live_actors": getattr(
                     actors, "live_actors", lambda: -1
                 )(),
@@ -1353,8 +1618,8 @@ def train(flags):
             if shard is not None:
                 return shard(batch, initial_agent_state)
             return (
-                jax.device_put(batch),
-                jax.device_put(initial_agent_state),
+                jax.device_put(batch, learner_device),
+                jax.device_put(initial_agent_state, learner_device),
             )
 
         # Superstep mode: rollouts drain straight into the preallocated
@@ -1470,14 +1735,19 @@ def train(flags):
                         now_step = state["step"]
                 watchdog.ping()
                 updates_done += superstep_k
-                if replica_parts is not None:
+                if snapshot_store is not None:
                     # Versioned snapshot publish (serving/snapshot.py):
                     # due when the head has run >= refresh_updates past
                     # the last snapshot — a dropped refresh (the chaos
                     # failure hook) stays due and retries next update.
-                    store = replica_parts["store"]
-                    if store.note_update(updates_done):
-                        store.publish(updates_done, infer_view)
+                    # Under the split this is the CROSS-SLICE publication
+                    # path: infer_view is the learner-mesh params
+                    # (single-process local_view is a pass-through), the
+                    # bf16 cast runs on the mesh, and each slice pulls
+                    # its device copy d2d via latest_on — zero host
+                    # round-trips (tests/test_sebulba.py pins it).
+                    if snapshot_store.note_update(updates_done):
+                        snapshot_store.publish(updates_done, infer_view)
                 if pending is not None:
                     flush(pending)
                 pending = (train_stats, now_step, release)
@@ -1601,14 +1871,14 @@ def train(flags):
                 # native runtime, whose C++ queues carry no instruments.
                 reg.gauge("learner.sps").set(sps)
                 reg.gauge("learner_queue.depth").set(learner_queue.size())
-                reg.gauge("inference.depth").set(inference_batcher.size())
+                reg.gauge("inference.depth").set(serving_depth_fn())
                 tele.write(extra={"step": now_step})
             means = timings.means()
             log.info(
                 "Step %d @ %.1f SPS. Inference batcher size: %d. "
                 "Learner queue size: %d. Loss %.4f. "
                 "[dequeue %.0fms learn %.0fms] %s",
-                now_step, sps, inference_batcher.size(),
+                now_step, sps, serving_depth_fn(),
                 learner_queue.size(),
                 stats_now.get("total_loss", float("nan")),
                 1000 * means.get("dequeue", 0.0),
@@ -1649,7 +1919,13 @@ def train(flags):
         # 587-593): close batcher + queue, join actors, join threads.
         # The replica batcher (when armed) closes alongside the central
         # one so replica serving threads exit their loops cleanly.
-        closers = [inference_batcher, learner_queue]
+        closers = [learner_queue]
+        if inference_batcher is not None:
+            closers.insert(0, inference_batcher)
+        if sebulba is not None:
+            # Every slice batcher closes so each slice's serving thread
+            # exits its loop cleanly.
+            closers = [s.batcher for s in sebulba.stacks] + closers
         if replica_parts is not None:
             closers.insert(1, replica_parts["batcher"])
         for closer in closers:

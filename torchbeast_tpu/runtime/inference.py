@@ -115,6 +115,7 @@ def inference_loop(
     state_table=None,
     serving_hooks=None,
     throttle_fn: Callable = None,
+    telemetry_prefix: str = "inference",
 ):
     """Thread body (run num_inference_threads of these).
 
@@ -176,6 +177,12 @@ def inference_loop(
     chaos harness's shared-chip stall model: called once per batch
     before dispatch; sleeps while a learner_stall window is active so
     induced overload grows the batcher queue the way a busy chip would.
+
+    `telemetry_prefix` names this loop's instrument series (default
+    "inference", today's schema). The Sebulba split runs one loop per
+    inference slice with prefix "inference.slice.<i>" so per-slice
+    batch/latency/poison series land on every telemetry line instead
+    of aggregating into one indistinguishable pile.
     """
     buckets = default_buckets(max_batch_size)
 
@@ -186,18 +193,18 @@ def inference_loop(
     # resolve once; per-batch cost is a few perf_counter calls.
     _reg = telemetry.get_registry()
     _tracer = telemetry.get_tracer()
-    _h_batch = _reg.histogram("inference.batch_size")
+    _h_batch = _reg.histogram(f"{telemetry_prefix}.batch_size")
     # Registered only when a lock exists: a permanently-zero histogram
     # reads as "requests never wait", not "not measured".
     _h_lock = (
-        _reg.histogram("inference.lock_wait_s") if lock is not None
-        else None
+        _reg.histogram(f"{telemetry_prefix}.lock_wait_s")
+        if lock is not None else None
     )
-    _h_dispatch = _reg.histogram("inference.dispatch_s")
-    _h_reply = _reg.histogram("inference.reply_s")
-    _c_batches = _reg.counter("inference.batches")
-    _c_rows = _reg.counter("inference.rows")
-    _c_poison = _reg.counter("inference.poison_exits")
+    _h_dispatch = _reg.histogram(f"{telemetry_prefix}.dispatch_s")
+    _h_reply = _reg.histogram(f"{telemetry_prefix}.reply_s")
+    _c_batches = _reg.counter(f"{telemetry_prefix}.batches")
+    _c_rows = _reg.counter(f"{telemetry_prefix}.rows")
+    _c_poison = _reg.counter(f"{telemetry_prefix}.poison_exits")
     # A Python DynamicBatcher with a telemetry_name already observes
     # inference.batch_size per dequeued batch — observing here too
     # would double-count it. The loop keeps that role only for
@@ -266,7 +273,7 @@ def inference_loop(
                 # bottleneck to XLA.
                 t0 = time.perf_counter()
                 with _tracer.span(
-                    "inference.dispatch", cat="inference",
+                    f"{telemetry_prefix}.dispatch", cat="inference",
                     rows=n, padded=padded,
                 ):
                     result = fn()

@@ -704,9 +704,14 @@ class DynamicBatcher:
         self._check_outputs = check_outputs
         self._compute_timeout_s = 600  # reference: 10-min future timeout
         # Overload gate (ISSUE 14, serving/admission.py): when armed,
-        # compute() may shed at enqueue (bounded queue depth) and
-        # __next__ sheds requests whose deadline expired in the queue —
-        # both as the typed ShedError the actor retry path re-submits.
+        # compute() may shed at enqueue (bounded queue depth — the
+        # driver sizes it as --admission_depth_factor x the max batch)
+        # and __next__ sheds requests whose deadline expired in the
+        # queue — both as the typed ShedError the actor retry path
+        # re-submits. One AdmissionController may gate SEVERAL
+        # batchers (the Sebulba split shares one across its per-slice
+        # batchers): the depth bound applies per queue, the counters
+        # aggregate.
         self._admission = admission
 
     def size(self) -> int:

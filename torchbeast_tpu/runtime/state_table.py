@@ -87,7 +87,18 @@ class DeviceStateTable:
         context_fn: Optional[Callable] = None,
         batch_dim: int = 1,
         input_filter: Optional[Callable] = None,
+        device=None,
     ):
+        """`device` (optional): pin the table — and every dispatch — to
+        one specific jax device. The Sebulba split (runtime/placement.py)
+        builds one table per inference slice this way: the initial
+        state, slot ids, and env inputs are all explicitly device_put
+        there, so the jitted step executes on that device and the
+        donated table buffer never leaves it. Context leaves (params,
+        rng) are the CALLER's placement responsibility — the slice
+        serving hooks place them on the same device (a mixed-device
+        dispatch is a jax error, not a silent transfer). None keeps
+        today's default-device behavior."""
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if not _leaves(initial_state):
@@ -97,6 +108,7 @@ class DeviceStateTable:
             )
         self.num_slots = num_slots
         self.batch_dim = batch_dim
+        self.device = device
         self._act_fn = act_fn
         self._context_fn = context_fn
         self._input_filter = input_filter
@@ -119,6 +131,11 @@ class DeviceStateTable:
         self._initial = jax.tree_util.tree_map(
             jnp.asarray, initial_state
         )
+        if device is not None:
+            # Commit the initial state to the pinned device: every
+            # derived value (_fresh_table's tile, reset's gather) then
+            # computes — and stays — there.
+            self._initial = jax.device_put(self._initial, device)
         # Cached host copy: the actor pool hands it to rollouts as the
         # boundary state for freshly-connected actors.
         self.initial_state_host = jax.tree_util.tree_map(
@@ -232,7 +249,9 @@ class DeviceStateTable:
             )
 
     def _put_ids(self, slots):
-        return jax.device_put(np.asarray(slots, np.int32).reshape(-1))
+        return jax.device_put(
+            np.asarray(slots, np.int32).reshape(-1), self.device
+        )
 
     def step(self, slots, advance, env_outputs, context=None):
         """One acting dispatch over already-padded inputs.
@@ -260,8 +279,12 @@ class DeviceStateTable:
         if ctx is None and self._context_fn is not None:
             ctx = self._context_fn()
         slots_d = self._put_ids(slots)
-        advance_d = jax.device_put(np.asarray(advance, bool).reshape(-1))
-        env_d = jax.tree_util.tree_map(jax.device_put, env_outputs)
+        advance_d = jax.device_put(
+            np.asarray(advance, bool).reshape(-1), self.device
+        )
+        env_d = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self.device), env_outputs
+        )
         with self._lock:
             self._require_alive()
             table, self._table = self._table, None

@@ -52,7 +52,17 @@ class ReplicaServingHooks:
         health=None,
         batch_dim: int = 1,
         registry=None,
+        device=None,
+        health_key: str = "replica_lag",
     ):
+        """`device` (optional) pins this hook set to one inference
+        slice (the Sebulba split): begin_batch hands out the snapshot
+        placed on that device via `PolicySnapshotStore.latest_on` —
+        device-to-device, no host round-trip — and the rng key is
+        device_put alongside so the slice's state-table dispatch never
+        sees mixed-device arguments. `health_key` scopes the lag
+        degradation per slice (one slice's recovery must not mask
+        another's stall in the health machine's keyed causes)."""
         if max_policy_lag < 1:
             raise ValueError(
                 f"max_policy_lag must be >= 1, got {max_policy_lag}"
@@ -61,6 +71,8 @@ class ReplicaServingHooks:
         self.max_policy_lag = max_policy_lag
         self._health = health
         self._batch_dim = batch_dim
+        self._device = device
+        self._health_key = health_key
         self._rng_lock = threading.Lock()
         self._rng_seed = rng_seed
         self._rng = None  # lazily built (jax import stays off module load)
@@ -76,6 +88,10 @@ class ReplicaServingHooks:
             if self._rng is None:
                 self._rng = jax.random.PRNGKey(self._rng_seed)
             self._rng, key = jax.random.split(self._rng)
+        if self._device is not None:
+            # 8 bytes per batch: the key must be committed to the
+            # slice device or the pinned table dispatch mixes devices.
+            key = jax.device_put(key, self._device)
         return key
 
     def serving_ok(self) -> bool:
@@ -91,16 +107,15 @@ class ReplicaServingHooks:
             if self._health is not None:
                 self._health.recover(
                     "replica snapshot refreshed within the lag budget",
-                    key="replica_lag",
+                    key=self._health_key,
                 )
         elif not ok and not was_degraded:
             self._c_degraded.inc()
             if self._health is not None:
                 self._health.degrade(
                     f"replica policy lag {lag} exceeds --max_policy_lag "
-                    f"{self.max_policy_lag} (refresh stalled?); serving "
-                    "falls back to the central path",
-                    key="replica_lag",
+                    f"{self.max_policy_lag} (refresh stalled?)",
+                    key=self._health_key,
                 )
         return ok
 
@@ -110,7 +125,10 @@ class ReplicaServingHooks:
         table's step (params, rng) — or act_fn via `params_for_batch`
         — and `annotate(outputs, n)` stamps the matching policy_lag
         into the reply at flush time."""
-        latest = self.store.latest()
+        if self._device is not None:
+            latest = self.store.latest_on(self._device)
+        else:
+            latest = self.store.latest()
         if latest is None:
             raise RuntimeError(
                 "replica serving before the first snapshot publish "

@@ -47,6 +47,10 @@ class PolicySnapshotStore:
         self._bf16 = None  # guarded-by: self._lock
         self._dtypes = None  # guarded-by: self._lock
         self._restored = None  # (version, tree) cache  # guarded-by: self._lock
+        # Per-device placement cache: {device: (version, tree)} — the
+        # Sebulba cross-slice publication path (latest_on), one
+        # device-to-device jax.device_put per (version, device).
+        self._placed = {}  # guarded-by: self._lock
         self._fail_next = 0  # guarded-by: self._lock
 
     # -- learner side -----------------------------------------------------
@@ -143,3 +147,35 @@ class PolicySnapshotStore:
             # re-validated against _version on the next read.
             self._restored = (version, restored)
         return (version, restored)
+
+    def latest_on(self, device) -> Optional[Tuple[int, Any]]:
+        """(version, restored params committed to `device`), or None
+        before the first publish — the Sebulba split's cross-slice
+        publication path (runtime/placement.py).
+
+        The whole chain is device-side: the learner publishes its
+        DEVICE params (the bf16 cast is an on-device jax op), the
+        dtype restore in `latest()` likewise, and the placement here is
+        ONE explicit device-to-device jax.device_put per (version,
+        device) — no leaf ever round-trips through host memory (pinned
+        by the jax.transfer_guard("disallow") test in
+        tests/test_sebulba.py). Cached per device and re-validated
+        against the version, so steady-state replica batches cost one
+        dict lookup.
+        """
+        import jax
+
+        latest = self.latest()
+        if latest is None:
+            return None
+        version, restored = latest
+        with self._lock:
+            cached = self._placed.get(device)
+            if cached is not None and cached[0] == version:
+                return cached
+        placed = jax.device_put(restored, device)
+        with self._lock:
+            # Last-writer-wins on a racing publish, same as _restored:
+            # the next read re-validates against the version.
+            self._placed[device] = (version, placed)
+        return (version, placed)
