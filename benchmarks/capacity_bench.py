@@ -1,0 +1,391 @@
+"""Native serving-plane capacity curve (ISSUE 16).
+
+Measures the C++ serving plane — slot-hash slice routing, versioned
+replica serving, and continuous batching — as a CAPACITY curve: steady
+SPS, steady admitted-requests/s, and request p99 vs actor count, for
+two admission families at an identical workload:
+
+- `continuous`:  late-arriving admitted requests roll into the next
+                 dispatch window (csrc/queues.h roll-in path; the
+                 default since ISSUE 16).
+- `depth_gated`: `--no_continuous_batching` — admission falls back to
+                 the `--admission_depth_factor` queue-depth bound and
+                 the dispatch window closes when it fills.
+
+Every row runs the FULL native stack in a subprocess (tpu_e2e_async
+.run_config): C++ pool over shm rings, `--device_split inf=2,learn=rest`
+per-slice batchers behind the native SliceRouter, and the native
+ReplicaRouter serving from versioned snapshots
+(`--replica_refresh_updates`). Rows carry the shm scheduler-health
+counters (`ring.doorbell_waits` / `ring.recheck_wakeups`) and one extra
+row repeats the saturation point under INDUCED scheduler pressure
+(spinner processes competing for the cores) so the counters have an
+in-anger contrast, not just a healthy baseline.
+
+Every row carries PROVENANCE (the `fresh:false` replay discipline from
+the chip-capture rounds): `fresh`, the forced CPU topology (including
+the host core count — the saturation point is a property of the box),
+and the jax version.
+
+Acceptance: at the saturation actor count, the continuous family's
+steady admitted-requests/s >= 1.1x the depth-gated family's. Where the
+box cannot show the gap (single-core CPU lane: both families are
+compute-bound on the same core, so rolling requests into a window buys
+batching efficiency but no extra cores), the artifact records the
+measured ceiling under `acceptance.measured_ceiling` instead of
+pretending — the honesty convention every committed artifact follows.
+
+Usage:
+  python benchmarks/capacity_bench.py [--actors 2,4,8,12] [--out PATH]
+  python benchmarks/capacity_bench.py --selftest  # schema + tiny rows
+"""
+
+import argparse
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+sys.path.insert(0, _HERE)
+
+_ARTIFACT = os.path.join(_HERE, "artifacts", "capacity_curve.json")
+
+FAMILIES = ("continuous", "depth_gated")
+
+# inf=2,learn=rest over 3 forced host devices: two pinned inference
+# slices (the native SliceRouter fans over both) + one learner device.
+_DEVICE_SPLIT = "inf=2,learn=rest"
+_FORCED_DEVICES = 3
+
+
+def _provenance() -> dict:
+    import jax
+
+    return {
+        "fresh": True,
+        "captured_at": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+        "topology": {
+            "platform": "cpu",
+            "device_count": _FORCED_DEVICES,
+            "forced": (
+                f"--xla_force_host_platform_device_count="
+                f"{_FORCED_DEVICES}"
+            ),
+            "host_cpus": os.cpu_count(),
+        },
+        "jax": jax.__version__,
+    }
+
+
+def _steady_rate(summary: dict, counter: str):
+    """Steady per-second rate of a cumulative counter over the same
+    warmup-discarded window run_config's steady-SPS uses."""
+    tel = summary.get("telemetry") or {}
+    fin, mid = tel.get("snapshot"), tel.get("mid_snapshot")
+    if not fin or not mid:
+        return None
+    c1 = (fin.get("counters") or {}).get(counter)
+    c0 = (mid.get("counters") or {}).get(counter, 0)
+    dt = fin.get("time", 0) - mid.get("time", 0)
+    if c1 is None or dt <= 0:
+        return None
+    return round((c1 - c0) / dt, 1)
+
+
+def _hist_p99_ms(summary: dict, name: str):
+    snap = (summary.get("telemetry") or {}).get("snapshot") or {}
+    hist = (snap.get("histograms") or {}).get(name)
+    if not hist or not hist.get("count"):
+        return None
+    return round(hist["p99"] * 1e3, 2)
+
+
+def _counters(summary: dict, names) -> dict:
+    snap = (summary.get("telemetry") or {}).get("snapshot") or {}
+    counters = snap.get("counters") or {}
+    return {n: int(counters[n]) for n in names if n in counters}
+
+
+class _SchedulerPressure:
+    """Spinner subprocesses competing for every core while a row runs
+    — the induced-pressure contrast for the ring-wait counters."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._procs = []
+
+    def __enter__(self):
+        for _ in range(self._n):
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-c", "while True: pass"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            ))
+        return self
+
+    def __exit__(self, *exc):
+        for proc in self._procs:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+        return False
+
+
+def run_row(args, family: str, num_actors: int,
+            pressure: bool = False) -> dict:
+    import tpu_e2e_async
+
+    extra = ["--replica_refresh_updates",
+             str(args.replica_refresh_updates),
+             "--request_deadline_ms",
+             str(args.request_deadline_ms)]
+    if family == "depth_gated":
+        extra.append("--no_continuous_batching")
+    row_args = argparse.Namespace(
+        env=args.env,
+        model=args.model,
+        use_lstm=False,
+        num_servers=args.num_servers,
+        num_actors=num_actors,
+        batch_size=args.batch_size,
+        unroll_length=args.unroll_length,
+        total_steps=args.total_steps,
+        superstep_k=args.superstep_k,
+        no_device_agent_state=False,
+        native_server=False,
+        timeout_s=args.timeout_s,
+        device_split=_DEVICE_SPLIT,
+        xla_device_count=_FORCED_DEVICES,
+        num_learner_devices=0,
+        extra_flags=extra,
+    )
+    tag = f"cap-{family}-{num_actors}a" + ("-pressure" if pressure else "")
+    log_path = f"/tmp/tbt_capacity_{tag}.log"
+    spinners = args.pressure_spinners if pressure else 0
+    with _SchedulerPressure(spinners):
+        summary = tpu_e2e_async.run_config(
+            row_args, native=True, shm=True, log_path=log_path, tag=tag
+        )
+    row = {
+        "family": family,
+        "num_actors": num_actors,
+        "scheduler_pressure": pressure,
+        "pressure_spinners": spinners,
+        "device_split": _DEVICE_SPLIT,
+        "provenance": _provenance(),
+    }
+    if "error" in summary:
+        row["error"] = summary["error"]
+        return row
+    row.update({
+        "steady_sps": (
+            summary["steady_sps_telemetry"] or summary["steady_sps_mean"]
+        ),
+        "admitted_per_s": _steady_rate(summary, "serving.admitted"),
+        "request_p99_ms": _hist_p99_ms(summary, "actor.request_rtt_s"),
+        "queue_delay_p99_ms": _hist_p99_ms(
+            summary, "serving.queue_delay_s"
+        ),
+        "policy_lag_p99": (
+            ((summary.get("telemetry") or {}).get("snapshot") or {})
+            .get("histograms", {})
+            .get("serving.policy_lag", {})
+            .get("p99")
+        ),
+        # shm scheduler-health counters, per curve row (ISSUE 16).
+        "ring": summary.get("ring"),
+        "serving": _counters(summary, (
+            "serving.admitted", "serving.shed", "serving.expired",
+            "serving.rolled", "serving.replica_requests",
+            "serving.central_requests",
+        )),
+        "slices": _counters(summary, tuple(
+            f"inference.slice.{i}.requests" for i in range(2)
+        )),
+        "wall_s": summary["wall_s"],
+    })
+    return row
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    # beastlint: disable=FLAG-PARITY  capacity rows need a zero-variance env; the driver default trains real Atari
+    ap.add_argument("--env", type=str, default="Mock")
+    # beastlint: disable=FLAG-PARITY  mlp keeps per-row compile under the CPU-lane row budget; the driver trains the deep net
+    ap.add_argument("--model", default="mlp")
+    # beastlint: disable=FLAG-PARITY  two servers saturate the single-core lane; the curve varies actors, not servers
+    ap.add_argument("--num_servers", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=8)
+    # beastlint: disable=FLAG-PARITY  short unrolls put more requests/s through admission at equal SPS — the capacity axis under test
+    ap.add_argument("--unroll_length", type=int, default=16)
+    # beastlint: disable=FLAG-PARITY  ~9 subprocess rows per invocation: 12k steps/row (~8 telemetry ticks) keeps the full curve inside a CI budget
+    ap.add_argument("--total_steps", type=int, default=12000)
+    ap.add_argument("--superstep_k", type=int, default=1)
+    # beastlint: disable=FLAG-PARITY  replica serving armed by default here — the native replica tier is what this bench measures; the driver default (0 = off) serves from the learner
+    ap.add_argument("--replica_refresh_updates", type=int, default=1)
+    # beastlint: disable=FLAG-PARITY  admission armed by default here — admitted-requests/s IS the capacity axis; the driver default (0 = off) admits everything
+    ap.add_argument("--request_deadline_ms", type=float, default=300.0)
+    ap.add_argument("--actors", default="2,4,8,12",
+                    help="Comma-separated actor counts (the curve's x "
+                         "axis); the largest is the saturation point "
+                         "the acceptance ratio reads.")
+    ap.add_argument("--pressure_spinners", type=int,
+                    default=max(1, (os.cpu_count() or 1)),
+                    help="Spinner processes for the induced-pressure "
+                         "row (default: one per host core).")
+    ap.add_argument("--required_ratio", type=float, default=1.1,
+                    help="Admitted-SPS gate: continuous vs depth_gated "
+                         "at saturation.")
+    ap.add_argument("--timeout_s", type=int, default=300)
+    ap.add_argument("--out", default=_ARTIFACT,
+                    help="Artifact path ('' skips the write).")
+    ap.add_argument("--selftest", action="store_true",
+                    help="One tiny row per family; verifies the row "
+                         "schema (ring counters + provenance incl.) "
+                         "and prints one JSON verdict line.")
+    return ap.parse_args(argv)
+
+
+_ROW_KEYS = {
+    "family", "num_actors", "scheduler_pressure", "device_split",
+    "provenance", "steady_sps", "admitted_per_s", "request_p99_ms",
+    "ring", "serving", "slices",
+}
+
+
+def _schema_ok(rows) -> bool:
+    for row in rows:
+        if "error" in row:
+            return False
+        if not _ROW_KEYS <= set(row):
+            return False
+        prov = row["provenance"]
+        if not (
+            {"fresh", "captured_at", "topology", "jax"} <= set(prov)
+            and prov["fresh"] is True
+            and prov["topology"]["device_count"] == _FORCED_DEVICES
+        ):
+            return False
+        # shm transport: the ring block must be present with both
+        # doorbell counters (the per-row scheduler-health dump).
+        ring = row["ring"]
+        if not ring or not (
+            {"ring.doorbell_waits", "ring.recheck_wakeups"} <= set(ring)
+        ):
+            return False
+        if row["admitted_per_s"] is None or row["steady_sps"] is None:
+            return False
+        if not row["slices"] or not any(row["slices"].values()):
+            return False
+    return True
+
+
+def main():
+    args = parse_args()
+
+    if args.selftest:
+        # ~17s of steady state on the 1-core lane: enough for the
+        # >=3 telemetry ticks the steady admitted-rate window needs.
+        args.total_steps = 6000
+        args.num_servers = 2
+        args.batch_size = 4
+        specs = [("continuous", 2, False), ("depth_gated", 2, False)]
+    else:
+        counts = sorted(
+            int(x) for x in args.actors.split(",") if x.strip()
+        )
+        specs = [(f, n, False) for f in FAMILIES for n in counts]
+        # The induced-pressure contrast row: saturation actor count,
+        # continuous family, spinners competing for every core.
+        specs.append(("continuous", counts[-1], True))
+
+    rows = [run_row(args, *spec) for spec in specs]
+
+    def admitted(family, n):
+        for row in rows:
+            if (
+                row["family"] == family
+                and row["num_actors"] == n
+                and not row["scheduler_pressure"]
+            ):
+                return row.get("admitted_per_s")
+        return None
+
+    saturation = max(r["num_actors"] for r in rows)
+    cont = admitted("continuous", saturation)
+    gated = admitted("depth_gated", saturation)
+    ratio = round(cont / gated, 3) if cont and gated else None
+    gate_met = bool(ratio is not None and ratio >= args.required_ratio)
+    acceptance = {
+        "saturation_actors": saturation,
+        "admitted_sps_continuous": cont,
+        "admitted_sps_depth_gated": gated,
+        "admitted_sps_ratio": ratio,
+        "required_min_ratio": args.required_ratio,
+        "gate_met": gate_met,
+        # Rows all ran and the ratio is measurable: the bench's own
+        # health. Where gate_met is False the artifact documents the
+        # measured ceiling below instead of failing the box for not
+        # being a TPU pod.
+        "ok": bool(
+            ratio is not None and all("error" not in r for r in rows)
+        ),
+    }
+    if ratio is not None and not gate_met:
+        acceptance["measured_ceiling"] = {
+            "ratio": ratio,
+            "note": (
+                "Measured ceiling on this box: with "
+                f"{os.cpu_count()} host core(s), both admission "
+                "families are compute-bound on the same cores, so "
+                "continuous batching's window roll-ins buy batching "
+                "efficiency but no extra parallelism. The >= "
+                f"{args.required_ratio}x gap is predicted where "
+                "inference slices own real chips and a closed window "
+                "leaves them idle."
+            ),
+        }
+    out = {
+        "bench": "capacity_curve",
+        "workload": {
+            k: getattr(args, k)
+            for k in ("env", "model", "num_servers", "batch_size",
+                      "unroll_length", "total_steps", "superstep_k",
+                      "replica_refresh_updates", "request_deadline_ms")
+        },
+        "device_split": _DEVICE_SPLIT,
+        "rows": rows,
+        "acceptance": acceptance,
+    }
+
+    if args.selftest:
+        out["selftest"] = {
+            "ok": bool(
+                _schema_ok(rows) and all("error" not in r for r in rows)
+            ),
+            "schema_ok": bool(_schema_ok(rows)),
+        }
+        print(json.dumps(out))
+        sys.exit(0 if out["selftest"]["ok"] else 1)
+
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(out))
+    if not out["acceptance"]["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,11 @@ def run_config(args, native, shm, log_path, tag):
     n_learn = getattr(args, "num_learner_devices", 0) or 0
     if n_learn > 1:
         cmd += ["--num_learner_devices", str(n_learn)]
+    # Caller-owned flag passthrough (capacity_bench rides this for
+    # --replica_refresh_updates / --no_continuous_batching): run_config
+    # stays the single subprocess harness instead of forking a copy per
+    # bench that needs one more flag.
+    cmd += [str(f) for f in getattr(args, "extra_flags", ()) or ()]
 
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
@@ -178,18 +183,18 @@ def run_config(args, native, shm, log_path, tag):
     # wall time, first third discarded as warmup) — the per-tick log SPS
     # samples alias the monitor cadence and read noisy on a loaded box.
     steady_sps_telemetry = None
-    if len(snaps) >= 3:
-        mid = snaps[len(snaps) // 3]
-        if (
-            final_snap.get("step") is not None
-            and mid.get("step") is not None
-            and final_snap["time"] > mid["time"]
-        ):
-            steady_sps_telemetry = round(
-                (final_snap["step"] - mid["step"])
-                / (final_snap["time"] - mid["time"]),
-                1,
-            )
+    mid_snap = snaps[len(snaps) // 3] if len(snaps) >= 3 else None
+    if (
+        mid_snap is not None
+        and final_snap.get("step") is not None
+        and mid_snap.get("step") is not None
+        and final_snap["time"] > mid_snap["time"]
+    ):
+        steady_sps_telemetry = round(
+            (final_snap["step"] - mid_snap["step"])
+            / (final_snap["time"] - mid_snap["time"]),
+            1,
+        )
     # Ring-wait counters (ISSUE 12/15, ROADMAP item 1): the adaptive
     # doorbell recheck's metastability signature — committed with the
     # parity artifact so the counters have an in-anger baseline.
@@ -242,6 +247,10 @@ def run_config(args, native, shm, log_path, tag):
         "telemetry": {
             "enabled": final_snap is not None,
             "snapshot": final_snap,
+            # The warmup-boundary snapshot the steady-SPS window starts
+            # at — counter deltas (final - mid) / (time delta) give
+            # steady per-second rates for any cumulative series.
+            "mid_snapshot": mid_snap,
         },
         "telemetry_lines": len(snaps),
         "n_telemetry_rows": len(rows),

@@ -89,14 +89,21 @@ class ActorPool {
     int64_t ring_recheck_wakeups = 0;
   };
 
+  // `inference_batcher` is any InferenceClient: a plain DynamicBatcher
+  // (central serving) or a routing facade (csrc/routing.h SliceRouter /
+  // ReplicaRouter — ISSUE 16); the pool is topology-blind either way.
+  // `record_policy_lag` normalizes replies missing a policy_lag leaf to
+  // zeros — the Python pool's _normalize_lag contract, needed when the
+  // serving plane mixes replica replies (stamped) with central ones
+  // (unstamped) so rollout nests stay structurally uniform.
   ActorPool(int64_t unroll_length, std::shared_ptr<LearnerQueue> learner_queue,
-            std::shared_ptr<DynamicBatcher> inference_batcher,
+            std::shared_ptr<InferenceClient> inference_batcher,
             std::vector<std::string> addresses, ArrayNest initial_agent_state,
             double connect_timeout_s = 600, int64_t max_reconnects = 0,
             bool use_slots = false, SlotHook slot_reset = nullptr,
             SlotHook slot_read = nullptr,
             size_t max_frame_bytes = wire::kMaxFrameBytes,
-            bool enable_fault_hooks = false)
+            bool enable_fault_hooks = false, bool record_policy_lag = false)
       : unroll_length_(unroll_length),
         learner_queue_(std::move(learner_queue)),
         inference_batcher_(std::move(inference_batcher)),
@@ -107,7 +114,8 @@ class ActorPool {
         use_slots_(use_slots),
         slot_reset_(std::move(slot_reset)),
         slot_read_(std::move(slot_read)),
-        max_frame_bytes_(max_frame_bytes) {
+        max_frame_bytes_(max_frame_bytes),
+        record_policy_lag_(record_policy_lag) {
     if (use_slots_ && (!slot_reset_ || !slot_read_))
       throw std::invalid_argument(
           "slot framing needs slot_reset and slot_read hooks");
@@ -412,13 +420,13 @@ class ActorPool {
         inputs.emplace("advance", ArrayNest(scalar_array<uint8_t>(
                                       DType::kBool, advance ? 1 : 0)));
         ArrayNest result = shed_compute(ArrayNest(inputs));
-        return result.dict().at("outputs");
+        return normalize_lag(result.dict().at("outputs"));
       }
       inputs.emplace("agent_state", *state);
       ArrayNest result = shed_compute(ArrayNest(inputs));
       const auto& d = result.dict();
       if (advance) *state = d.at("agent_state");
-      return d.at("outputs");
+      return normalize_lag(d.at("outputs"));
     };
 
     // Prime the boundary agent output (state advance discarded — the
@@ -463,6 +471,20 @@ class ActorPool {
     }
   }
 
+  // The Python pool's _normalize_lag (runtime/actor_pool.py): central
+  // replies carry no policy_lag leaf (their params rebind every update
+  // — lag is definitionally 0); replica replies stamp the real lag.
+  // Rollout stacking needs one structure, so the missing leaf becomes
+  // explicit zeros. Off (the default) this is a single branch.
+  ArrayNest normalize_lag(ArrayNest outputs) const {
+    if (!record_policy_lag_ || !outputs.is_dict()) return outputs;
+    ArrayNest::Dict d = outputs.dict();
+    if (d.find("policy_lag") != d.end()) return outputs;
+    d.emplace("policy_lag",
+              ArrayNest(scalar_array<int32_t>(DType::kI32, 0)));
+    return ArrayNest(std::move(d));
+  }
+
   static int64_t read_scalar_i64(const Array& a) {
     switch (a.dtype()) {
       case DType::kI32:
@@ -500,7 +522,7 @@ class ActorPool {
 
   const int64_t unroll_length_;
   std::shared_ptr<LearnerQueue> learner_queue_;
-  std::shared_ptr<DynamicBatcher> inference_batcher_;
+  std::shared_ptr<InferenceClient> inference_batcher_;
   const std::vector<std::string> addresses_;
   const ArrayNest initial_agent_state_;
   const double connect_timeout_s_;
@@ -509,6 +531,7 @@ class ActorPool {
   const SlotHook slot_reset_;
   const SlotHook slot_read_;
   const size_t max_frame_bytes_;
+  const bool record_policy_lag_;
 
   std::atomic<int64_t> count_{0};
   std::atomic<int64_t> reconnect_count_{0};

@@ -25,6 +25,7 @@
 #include "actor_pool.h"
 #include "env_server.h"
 #include "queues.h"
+#include "routing.h"
 #include "shm.h"
 
 namespace {
@@ -508,6 +509,37 @@ struct PyActorPool {
   std::shared_ptr<tbt::ActorPool> pool;
 };
 
+struct PySliceRouter {
+  PyObject_HEAD
+  std::shared_ptr<tbt::SliceRouter> router;
+};
+
+struct PyReplicaRouter {
+  PyObject_HEAD
+  std::shared_ptr<tbt::ReplicaRouter> router;
+};
+
+extern PyTypeObject PyDynamicBatcherType;
+extern PyTypeObject PySliceRouterType;
+extern PyTypeObject PyReplicaRouterType;
+
+// Any native InferenceClient the pool (or a router) can serve through:
+// a plain batcher, a slice fan-out, or a replica/central pair. Raises
+// TypeError (returns nullptr) for anything else.
+std::shared_ptr<tbt::InferenceClient> client_from(PyObject* obj,
+                                                  const char* param) {
+  if (PyObject_TypeCheck(obj, &PyDynamicBatcherType))
+    return reinterpret_cast<PyDynamicBatcher*>(obj)->batcher;
+  if (PyObject_TypeCheck(obj, &PySliceRouterType))
+    return reinterpret_cast<PySliceRouter*>(obj)->router;
+  if (PyObject_TypeCheck(obj, &PyReplicaRouterType))
+    return reinterpret_cast<PyReplicaRouter*>(obj)->router;
+  PyErr_Format(PyExc_TypeError,
+               "%s must be a DynamicBatcher, SliceRouter or ReplicaRouter",
+               param);
+  return nullptr;
+}
+
 extern PyTypeObject PyBatchType;
 
 // --- BatchingQueue
@@ -720,15 +752,17 @@ int batcher_init(PyDynamicBatcher* self, PyObject* args, PyObject* kwargs) {
                                  "maximum_batch_size", "timeout_ms",
                                  "shed_max_queue_depth",
                                  "request_deadline_ms", "slo_target_ms",
-                                 nullptr};
+                                 "continuous", nullptr};
   long long batch_dim = 1, min_bs = 1;
   PyObject *max_bs_obj = Py_None, *timeout_obj = Py_None;
   PyObject *shed_depth_obj = Py_None, *deadline_obj = Py_None,
            *slo_obj = Py_None;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|LLOOOOO",
+  int continuous = 0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|LLOOOOOp",
                                    const_cast<char**>(kwlist), &batch_dim,
                                    &min_bs, &max_bs_obj, &timeout_obj,
-                                   &shed_depth_obj, &deadline_obj, &slo_obj))
+                                   &shed_depth_obj, &deadline_obj, &slo_obj,
+                                   &continuous))
     return -1;
   try {
     int64_t max_bs = max_bs_obj == Py_None
@@ -756,7 +790,7 @@ int batcher_init(PyDynamicBatcher* self, PyObject* args, PyObject* kwargs) {
     if (PyErr_Occurred()) return -1;
     self->batcher = std::make_shared<tbt::DynamicBatcher>(
         batch_dim, min_bs, max_bs, timeout_ms, shed_depth, deadline_ms,
-        slo_ms);
+        slo_ms, continuous != 0);
     return 0;
   } catch (...) {
     set_py_error();
@@ -824,13 +858,14 @@ PyObject* batcher_telemetry(PyDynamicBatcher* self, PyObject*) {
     return nullptr;
   }
   return Py_BuildValue(
-      "{s:L,s:L,s:L,s:L,s:L,s:L,s:N,s:N,s:N,s:N}", "batches",
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:N,s:N,s:N,s:N}", "batches",
       static_cast<long long>(telemetry->batches.load()), "rows",
       static_cast<long long>(telemetry->rows.load()), "admitted",
       static_cast<long long>(telemetry->admitted.load()), "shed",
       static_cast<long long>(telemetry->shed.load()), "expired",
       static_cast<long long>(telemetry->expired.load()), "slo_breaches",
-      static_cast<long long>(telemetry->slo_breaches.load()),
+      static_cast<long long>(telemetry->slo_breaches.load()), "rolled",
+      static_cast<long long>(telemetry->rolled.load()),
       "request_wait_s", wait_py, "request_rtt_s", rtt_py, "batch_size",
       sizes_py, "queue_delay_s", delay_py);
 }
@@ -891,6 +926,214 @@ PyMethodDef batcher_methods[] = {
     {nullptr, nullptr, 0, nullptr}};
 
 PyTypeObject PyDynamicBatcherType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// --- SliceRouter (ISSUE 16): slot-hash fan-out over per-slice batchers.
+// The router only holds shared_ptrs to the slices' C++ objects, so the
+// Python batcher wrappers need not outlive it.
+int slice_router_init(PySliceRouter* self, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {"slices", nullptr};
+  PyObject* slices_obj;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O",
+                                   const_cast<char**>(kwlist), &slices_obj))
+    return -1;
+  PyObject* seq = PySequence_Fast(slices_obj, "slices must be a sequence");
+  if (!seq) return -1;
+  std::vector<std::shared_ptr<tbt::InferenceClient>> slices;
+  for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); ++i) {
+    auto client = client_from(PySequence_Fast_GET_ITEM(seq, i), "slices[i]");
+    if (!client) {
+      Py_DECREF(seq);
+      return -1;
+    }
+    slices.push_back(std::move(client));
+  }
+  Py_DECREF(seq);
+  try {
+    self->router = std::make_shared<tbt::SliceRouter>(std::move(slices));
+    return 0;
+  } catch (...) {
+    set_py_error();
+    return -1;
+  }
+}
+
+PyObject* slice_router_compute(PySliceRouter* self, PyObject* arg) {
+  ArrayNest nest;
+  if (!nest_from_py(arg, &nest)) return nullptr;
+  ArrayNest result;
+  auto router = self->router;
+  if (!call_nogil([&] { result = router->compute(std::move(nest)); }))
+    return nullptr;
+  return nest_to_py(result);
+}
+
+// Cumulative per-slice routed counts: {"requests": [c0, c1, ...]}. The
+// driver folds deltas into "inference.slice.<i>.requests" (the series
+// name the Python SliceRouter publishes — pinned by ROUTE-PARITY).
+PyObject* slice_router_telemetry(PySliceRouter* self, PyObject*) {
+  std::vector<int64_t> counts = self->router->request_counts();
+  PyObject* list = PyList_New(static_cast<Py_ssize_t>(counts.size()));
+  if (!list) return nullptr;
+  for (size_t i = 0; i < counts.size(); ++i) {
+    PyObject* n = PyLong_FromLongLong(counts[i]);
+    if (!n) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, static_cast<Py_ssize_t>(i), n);
+  }
+  return Py_BuildValue("{s:N}", "requests", list);
+}
+
+PyObject* slice_router_n_slices(PySliceRouter* self, PyObject*) {
+  return PyLong_FromLongLong(self->router->n_slices());
+}
+
+PyObject* slice_router_close(PySliceRouter* self, PyObject*) {
+  auto router = self->router;
+  if (!call_nogil([&] { router->close(); })) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject* slice_router_size(PySliceRouter* self, PyObject*) {
+  return PyLong_FromLongLong(self->router->size());
+}
+
+PyObject* slice_router_is_closed(PySliceRouter* self, PyObject*) {
+  return PyBool_FromLong(self->router->is_closed());
+}
+
+void slice_router_dealloc(PySliceRouter* self) {
+  self->router.~shared_ptr();
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* slice_router_new(PyTypeObject* type, PyObject*, PyObject*) {
+  PySliceRouter* self =
+      reinterpret_cast<PySliceRouter*>(type->tp_alloc(type, 0));
+  if (self) new (&self->router) std::shared_ptr<tbt::SliceRouter>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+PyMethodDef slice_router_methods[] = {
+    {"compute", reinterpret_cast<PyCFunction>(slice_router_compute), METH_O,
+     nullptr},
+    {"telemetry", reinterpret_cast<PyCFunction>(slice_router_telemetry),
+     METH_NOARGS, nullptr},
+    {"n_slices", reinterpret_cast<PyCFunction>(slice_router_n_slices),
+     METH_NOARGS, nullptr},
+    {"close", reinterpret_cast<PyCFunction>(slice_router_close), METH_NOARGS,
+     nullptr},
+    {"size", reinterpret_cast<PyCFunction>(slice_router_size), METH_NOARGS,
+     nullptr},
+    {"is_closed", reinterpret_cast<PyCFunction>(slice_router_is_closed),
+     METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject PySliceRouterType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// --- ReplicaRouter (ISSUE 16): replica-first with central fallback.
+// Health is pushed from the Python serving hooks via set_serving() — the
+// actor threads never take the GIL to route.
+int replica_router_init(PyReplicaRouter* self, PyObject* args,
+                        PyObject* kwargs) {
+  static const char* kwlist[] = {"central", "replica", nullptr};
+  PyObject *central_obj, *replica_obj;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO",
+                                   const_cast<char**>(kwlist), &central_obj,
+                                   &replica_obj))
+    return -1;
+  auto central = client_from(central_obj, "central");
+  if (!central) return -1;
+  auto replica = client_from(replica_obj, "replica");
+  if (!replica) return -1;
+  try {
+    self->router = std::make_shared<tbt::ReplicaRouter>(std::move(central),
+                                                        std::move(replica));
+    return 0;
+  } catch (...) {
+    set_py_error();
+    return -1;
+  }
+}
+
+PyObject* replica_router_compute(PyReplicaRouter* self, PyObject* arg) {
+  ArrayNest nest;
+  if (!nest_from_py(arg, &nest)) return nullptr;
+  ArrayNest result;
+  auto router = self->router;
+  if (!call_nogil([&] { result = router->compute(std::move(nest)); }))
+    return nullptr;
+  return nest_to_py(result);
+}
+
+PyObject* replica_router_set_serving(PyReplicaRouter* self, PyObject* arg) {
+  int truth = PyObject_IsTrue(arg);
+  if (truth < 0) return nullptr;
+  self->router->set_serving(truth == 1);
+  Py_RETURN_NONE;
+}
+
+PyObject* replica_router_serving(PyReplicaRouter* self, PyObject*) {
+  return PyBool_FromLong(self->router->serving());
+}
+
+PyObject* replica_router_telemetry(PyReplicaRouter* self, PyObject*) {
+  return Py_BuildValue(
+      "{s:L,s:L}", "replica_requests",
+      static_cast<long long>(self->router->replica_requests()),
+      "central_requests",
+      static_cast<long long>(self->router->central_requests()));
+}
+
+PyObject* replica_router_close(PyReplicaRouter* self, PyObject*) {
+  auto router = self->router;
+  if (!call_nogil([&] { router->close(); })) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject* replica_router_size(PyReplicaRouter* self, PyObject*) {
+  return PyLong_FromLongLong(self->router->size());
+}
+
+PyObject* replica_router_is_closed(PyReplicaRouter* self, PyObject*) {
+  return PyBool_FromLong(self->router->is_closed());
+}
+
+void replica_router_dealloc(PyReplicaRouter* self) {
+  self->router.~shared_ptr();
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* replica_router_new(PyTypeObject* type, PyObject*, PyObject*) {
+  PyReplicaRouter* self =
+      reinterpret_cast<PyReplicaRouter*>(type->tp_alloc(type, 0));
+  if (self) new (&self->router) std::shared_ptr<tbt::ReplicaRouter>();
+  return reinterpret_cast<PyObject*>(self);
+}
+
+PyMethodDef replica_router_methods[] = {
+    {"compute", reinterpret_cast<PyCFunction>(replica_router_compute),
+     METH_O, nullptr},
+    {"set_serving", reinterpret_cast<PyCFunction>(replica_router_set_serving),
+     METH_O, nullptr},
+    {"serving", reinterpret_cast<PyCFunction>(replica_router_serving),
+     METH_NOARGS, nullptr},
+    {"telemetry", reinterpret_cast<PyCFunction>(replica_router_telemetry),
+     METH_NOARGS, nullptr},
+    {"close", reinterpret_cast<PyCFunction>(replica_router_close),
+     METH_NOARGS, nullptr},
+    {"size", reinterpret_cast<PyCFunction>(replica_router_size), METH_NOARGS,
+     nullptr},
+    {"is_closed", reinterpret_cast<PyCFunction>(replica_router_is_closed),
+     METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject PyReplicaRouterType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
@@ -964,20 +1207,27 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
       "unroll_length",     "learner_queue", "inference_batcher",
       "env_server_addresses", "initial_agent_state", "connect_timeout_s",
       "max_reconnects", "state_table", "max_frame_bytes", "fault_hooks",
-      nullptr};
+      "record_policy_lag", nullptr};
   long long unroll_length = 0, max_reconnects = 0;
   PyObject *queue_obj, *batcher_obj, *addresses_obj, *state_obj;
   PyObject* table_obj = Py_None;
   PyObject* max_frame_obj = Py_None;
   double connect_timeout_s = 600;
   int fault_hooks = 0;
+  int record_policy_lag = 0;
+  // inference_batcher is any native InferenceClient (DynamicBatcher,
+  // SliceRouter, ReplicaRouter) — dispatched by client_from below, so
+  // the pool serves through whatever topology the driver assembled.
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "LO!O!OO|dLOOp", const_cast<char**>(kwlist),
+          args, kwargs, "LO!OOO|dLOOpp", const_cast<char**>(kwlist),
           &unroll_length, &PyBatchingQueueType, &queue_obj,
-          &PyDynamicBatcherType, &batcher_obj, &addresses_obj, &state_obj,
+          &batcher_obj, &addresses_obj, &state_obj,
           &connect_timeout_s, &max_reconnects, &table_obj, &max_frame_obj,
-          &fault_hooks))
+          &fault_hooks, &record_policy_lag))
     return -1;
+  std::shared_ptr<tbt::InferenceClient> batcher =
+      client_from(batcher_obj, "inference_batcher");
+  if (!batcher) return -1;
   std::vector<std::string> addresses;
   PyObject* seq = PySequence_Fast(addresses_obj, "addresses must be a sequence");
   if (!seq) return -1;
@@ -1031,10 +1281,11 @@ int pool_init(PyActorPool* self, PyObject* args, PyObject* kwargs) {
     self->pool = std::make_shared<tbt::ActorPool>(
         unroll_length,
         reinterpret_cast<PyBatchingQueue*>(queue_obj)->queue,
-        reinterpret_cast<PyDynamicBatcher*>(batcher_obj)->batcher,
+        std::move(batcher),
         std::move(addresses), std::move(owned), connect_timeout_s,
         max_reconnects, use_slots, std::move(slot_reset),
-        std::move(slot_read), max_frame_bytes, fault_hooks != 0);
+        std::move(slot_read), max_frame_bytes, fault_hooks != 0,
+        record_policy_lag != 0);
     return 0;
   } catch (...) {
     set_py_error();
@@ -1635,6 +1886,32 @@ PyObject* py_adaptive_recheck_sim(PyObject*, PyObject* arg) {
   return out;
 }
 
+// Routing-hash pins (ISSUE 16): the C++ splitmix64 finalizer and the
+// slot->slice map, exposed so tests/test_native_routing.py can assert
+// bit-identity against runtime/placement.py _mix64 in ANGER (beastlint
+// ROUTE-PARITY pins the constants textually).
+PyObject* py_splitmix64(PyObject*, PyObject* arg) {
+  // Mask conversion wraps negatives mod 2^64 — Python's `& (2**64-1)`.
+  unsigned long long x = PyLong_AsUnsignedLongLongMask(arg);
+  if (PyErr_Occurred()) return nullptr;
+  return PyLong_FromUnsignedLongLong(tbt::splitmix64(x));
+}
+
+PyObject* py_slice_for_slot(PyObject*, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {"slot", "n_slices", nullptr};
+  long long slot = 0, n_slices = 0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "LL",
+                                   const_cast<char**>(kwlist), &slot,
+                                   &n_slices))
+    return nullptr;
+  try {
+    return PyLong_FromLongLong(tbt::slice_for_slot(slot, n_slices));
+  } catch (...) {
+    set_py_error();
+    return nullptr;
+  }
+}
+
 // ---------------------------------------------------------------- module
 PyMethodDef module_functions[] = {
     {"wire_encode", reinterpret_cast<PyCFunction>(py_wire_encode), METH_O,
@@ -1647,6 +1924,12 @@ PyMethodDef module_functions[] = {
     {"bench_client_rtt",
      reinterpret_cast<PyCFunction>(
          reinterpret_cast<void (*)()>(py_bench_client_rtt)),
+     METH_VARARGS | METH_KEYWORDS, nullptr},
+    {"splitmix64", reinterpret_cast<PyCFunction>(py_splitmix64), METH_O,
+     nullptr},
+    {"slice_for_slot",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)()>(py_slice_for_slot)),
      METH_VARARGS | METH_KEYWORDS, nullptr},
     {nullptr, nullptr, 0, nullptr}};
 
@@ -1692,6 +1975,16 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
             reinterpret_cast<destructor>(batcher_dealloc), batcher_methods,
             queue_iter, reinterpret_cast<iternextfunc>(batcher_iternext),
             nullptr);
+  init_type(&PySliceRouterType, "_tbt_core.SliceRouter",
+            sizeof(PySliceRouter), slice_router_new,
+            reinterpret_cast<initproc>(slice_router_init),
+            reinterpret_cast<destructor>(slice_router_dealloc),
+            slice_router_methods, nullptr, nullptr, nullptr);
+  init_type(&PyReplicaRouterType, "_tbt_core.ReplicaRouter",
+            sizeof(PyReplicaRouter), replica_router_new,
+            reinterpret_cast<initproc>(replica_router_init),
+            reinterpret_cast<destructor>(replica_router_dealloc),
+            replica_router_methods, nullptr, nullptr, nullptr);
   init_type(&PyActorPoolType, "_tbt_core.ActorPool", sizeof(PyActorPool),
             pool_new, reinterpret_cast<initproc>(pool_init),
             reinterpret_cast<destructor>(pool_dealloc), pool_methods, nullptr,
@@ -1705,6 +1998,8 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
   if (PyType_Ready(&PyBatchingQueueType) < 0 ||
       PyType_Ready(&PyBatchType) < 0 ||
       PyType_Ready(&PyDynamicBatcherType) < 0 ||
+      PyType_Ready(&PySliceRouterType) < 0 ||
+      PyType_Ready(&PyReplicaRouterType) < 0 ||
       PyType_Ready(&PyActorPoolType) < 0 ||
       PyType_Ready(&PyEnvServerType) < 0)
     return nullptr;
@@ -1744,6 +2039,8 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
   Py_INCREF(&PyBatchingQueueType);
   Py_INCREF(&PyBatchType);
   Py_INCREF(&PyDynamicBatcherType);
+  Py_INCREF(&PySliceRouterType);
+  Py_INCREF(&PyReplicaRouterType);
   Py_INCREF(&PyActorPoolType);
   PyModule_AddObject(module, "BatchingQueue",
                      reinterpret_cast<PyObject*>(&PyBatchingQueueType));
@@ -1751,6 +2048,10 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
                      reinterpret_cast<PyObject*>(&PyBatchType));
   PyModule_AddObject(module, "DynamicBatcher",
                      reinterpret_cast<PyObject*>(&PyDynamicBatcherType));
+  PyModule_AddObject(module, "SliceRouter",
+                     reinterpret_cast<PyObject*>(&PySliceRouterType));
+  PyModule_AddObject(module, "ReplicaRouter",
+                     reinterpret_cast<PyObject*>(&PyReplicaRouterType));
   PyModule_AddObject(module, "ActorPool",
                      reinterpret_cast<PyObject*>(&PyActorPoolType));
   Py_INCREF(&PyEnvServerType);
@@ -1760,9 +2061,10 @@ PyMODINIT_FUNC PyInit__tbt_core(void) {
   PyModule_AddObject(module, "AsyncError", AsyncErrorError);
   PyModule_AddObject(module, "ShedError", ShedErrorError);
   // Extension API generation (runtime/native.py REQUIRED_API_VERSION):
-  // 1 = the ISSUE 14 shed protocol. The default-on native runtime
-  // refuses stale builds instead of silently serving without
-  // admission control.
-  PyModule_AddIntConstant(module, "API_VERSION", 1);
+  // 1 = the ISSUE 14 shed protocol; 2 = the ISSUE 16 serving plane
+  // (routers, continuous batching, record_policy_lag). The default-on
+  // native runtime refuses stale builds instead of silently serving
+  // central-only without admission control.
+  PyModule_AddIntConstant(module, "API_VERSION", 2);
   return module;
 }

@@ -108,6 +108,11 @@ struct BatcherTelemetry {
   std::atomic<int64_t> shed{0};
   std::atomic<int64_t> expired{0};
   std::atomic<int64_t> slo_breaches{0};
+  // Continuous-batching accounting (ISSUE 16): requests rolled into an
+  // already-forming dispatch window by the top-up pass. Pure
+  // observability — rolled requests are ordinary admitted requests and
+  // take no part in the shed/expired audit.
+  std::atomic<int64_t> rolled{0};
   HistAccum queue_delay_s;  // enqueue -> dequeue, served AND expired
   // Sampled per-request spans (ISSUE 12): 1-in-kTraceEvery computes
   // records its (enqueued, batched, replied) steady-clock stamps here;
@@ -150,6 +155,20 @@ inline ArrayNest batch_nests(const std::vector<ArrayNest>& nests,
         return concatenate(leaves, batch_dim);
       });
 }
+
+// What the actor pool's request path sees (ISSUE 16): a DynamicBatcher,
+// or a routing facade over several (csrc/routing.h SliceRouter /
+// ReplicaRouter). The pool only ever computes, polls closure, and
+// closes — keeping the seam this narrow is what lets the routers drop
+// in without the pool knowing the serving topology.
+class InferenceClient {
+ public:
+  virtual ~InferenceClient() = default;
+  virtual ArrayNest compute(ArrayNest inputs, int64_t timeout_s = 600) = 0;
+  virtual int64_t size() const = 0;
+  virtual bool is_closed() const = 0;
+  virtual void close() = 0;
+};
 
 template <typename Payload>
 class BatchingQueue {
@@ -306,6 +325,23 @@ class BatchingQueue {
     return {std::move(item.inputs), item.rows};
   }
 
+  // Non-blocking drain of whole items that fit under `max_rows` — the
+  // continuous-batching top-up (ISSUE 16): a forming dispatch window
+  // rolls in requests that arrived after dequeue_many released the
+  // lock. Returns possibly-empty; never waits.
+  std::vector<Item> try_dequeue_upto(int64_t max_rows) {
+    std::vector<Item> items;
+    std::unique_lock<std::mutex> lock(mu_);
+    int64_t rows = 0;
+    while (!deque_.empty() && rows + deque_.front().rows <= max_rows) {
+      rows += deque_.front().rows;
+      items.push_back(std::move(deque_.front()));
+      deque_.pop_front();
+    }
+    if (!items.empty()) can_enqueue_.notify_all();
+    return items;
+  }
+
   int64_t num_enqueued() const {
     std::unique_lock<std::mutex> lock(mu_);
     return num_enqueued_;
@@ -349,7 +385,7 @@ class BatchingQueue {
   HistAccum batch_size_;
 };
 
-class DynamicBatcher {
+class DynamicBatcher : public InferenceClient {
  public:
   struct Request {
     std::shared_ptr<std::promise<ArrayNest>> promise;
@@ -463,30 +499,43 @@ class DynamicBatcher {
   // caller), `deadline_ms` arms the dequeue-side expiry, and
   // `slo_target_ms` arms served-RTT breach counting. All optional —
   // disarmed, the batcher behaves exactly as before.
+  //
+  // `continuous` (ISSUE 16) switches the overload posture from
+  // depth-gating to continuous batching: the caller passes the FALLBACK
+  // hard bound as shed_max_queue_depth (a multiple of the old
+  // depth-factor gate — polybeast keeps --admission_depth_factor as
+  // that bound) and get_batch() rolls requests that arrive while a
+  // dispatch window is forming into that window (try_dequeue_upto)
+  // instead of leaving them for the next batch. Latency stays guarded
+  // by the dequeue-side deadline expiry, which runs AFTER the top-up
+  // merge so rolled requests face exactly the same gate — the
+  // resubmitted == shed + expired audit is unchanged.
   DynamicBatcher(int64_t batch_dim, int64_t min_batch_size,
                  int64_t max_batch_size, std::optional<int64_t> timeout_ms,
                  std::optional<int64_t> shed_max_queue_depth = std::nullopt,
                  std::optional<double> deadline_ms = std::nullopt,
-                 std::optional<double> slo_target_ms = std::nullopt)
+                 std::optional<double> slo_target_ms = std::nullopt,
+                 bool continuous = false)
       : batch_dim_(batch_dim),
         queue_(batch_dim, min_batch_size, max_batch_size, timeout_ms,
                std::nullopt, /*check_inputs=*/true),
         telemetry_(std::make_shared<BatcherTelemetry>()),
         shed_max_queue_depth_(shed_max_queue_depth),
         deadline_ms_(deadline_ms),
-        slo_target_ms_(slo_target_ms) {
+        slo_target_ms_(slo_target_ms),
+        continuous_(continuous) {
     if (shed_max_queue_depth_ && *shed_max_queue_depth_ < 1)
       throw std::invalid_argument("shed_max_queue_depth must be >= 1");
   }
 
-  int64_t size() const { return queue_.size(); }
-  bool is_closed() const { return queue_.is_closed(); }
+  int64_t size() const override { return queue_.size(); }
+  bool is_closed() const override { return queue_.is_closed(); }
 
   // Interval snapshot for the Python driver's native-telemetry fold.
   std::shared_ptr<BatcherTelemetry> telemetry() { return telemetry_; }
 
   ArrayNest compute(ArrayNest inputs,
-                    int64_t timeout_s = 600 /* reference: 10 min */) {
+                    int64_t timeout_s = 600 /* reference: 10 min */) override {
     int64_t rows = inputs.front().dim(batch_dim_);
     if (rows > queue_.max_batch_size())
       throw std::invalid_argument("compute() exceeds maximum_batch_size");
@@ -529,6 +578,31 @@ class DynamicBatcher {
   std::unique_ptr<Batch> get_batch() {
     while (true) {
       auto [inputs, requests] = queue_.dequeue_many();
+      // Continuous batching (ISSUE 16): roll requests that landed
+      // between dequeue_many's drain and now into THIS dispatch window
+      // (up to max batch size) instead of parking them for the next
+      // one. The merge happens BEFORE the deadline pass below, so a
+      // rolled request meets the exact same expiry gate as any other.
+      if (continuous_) {
+        int64_t have = 0;
+        for (const Request& r : requests) have += r.rows;
+        int64_t room = queue_.max_batch_size() - have;
+        if (room > 0) {
+          auto extra = queue_.try_dequeue_upto(room);
+          if (!extra.empty()) {
+            std::vector<ArrayNest> pieces;
+            pieces.reserve(extra.size() + 1);
+            pieces.push_back(std::move(inputs));
+            for (auto& it : extra) {
+              pieces.push_back(std::move(it.inputs));
+              requests.push_back(std::move(it.payload));
+            }
+            inputs = batch_nests(pieces, batch_dim_);
+            telemetry_->rolled.fetch_add(
+                static_cast<int64_t>(extra.size()));
+          }
+        }
+      }
       auto now = std::chrono::steady_clock::now();
       if (deadline_ms_) {
         // Dequeue-side deadline gate (ISSUE 14): fail requests that
@@ -611,7 +685,7 @@ class DynamicBatcher {
     }
   }
 
-  void close() {
+  void close() override {
     std::vector<Request> pending = queue_.close();
     for (Request& r : pending) {
       r.promise->set_exception(std::make_exception_ptr(
@@ -626,6 +700,7 @@ class DynamicBatcher {
   const std::optional<int64_t> shed_max_queue_depth_;
   const std::optional<double> deadline_ms_;
   const std::optional<double> slo_target_ms_;
+  const bool continuous_;
 };
 
 }  // namespace tbt

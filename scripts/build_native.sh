@@ -69,7 +69,7 @@ if [[ "$SMOKE" == 1 ]]; then
     # which some sandboxes lack), plain + TSan, then the extension.
     # "batcher" (not "atch"): strstr filtering makes "atch" also match
     # the batching_queue tests, which "queue" already runs.
-    FILTERS=(queue batcher ring wire array nest)
+    FILTERS=(queue batcher ring wire array nest routing)
     echo "== C++ core tests (smoke)"
     g++ -std=c++17 -O2 -Wall -pthread csrc/test_core.cc -o build/test_core \
         "${LIBS[@]}"

@@ -1,7 +1,7 @@
 #!/bin/bash
 # The one-command merge gate (ISSUE 10): native build + C++ test suites
 # (plain AND under TSan) + the Python extension, then the full static
-# analysis lane — repo-wide beastlint in CI mode (14 rules incl. the
+# analysis lane — repo-wide beastlint in CI mode (15 rules incl. the
 # C++ frontend), the rule-fixture selftest, and the exhaustive
 # shm-protocol model check (shipped spec verifies; seeded mutants must
 # produce counterexample traces).
@@ -71,6 +71,25 @@ assert snap["device_split"]["inference_slices"] == 1, snap["device_split"]
 assert snap["learner.mesh_shape"] == {"data": 1, "model": 1}
 assert "inference.slice.0.depth" in snap["gauges"]
 print("sebulba-smoke: PASS (steady sps", summary["steady_sps_mean"], ")")
+EOF
+
+    echo "== check: native capacity smoke (C++ slice+replica routing, admission armed)"
+    # The NATIVE serving plane end to end, scaled down (ISSUE 16): one
+    # tiny split+replica run per admission family (continuous vs
+    # depth-gated) over shm rings, with the capacity-row schema —
+    # per-slice request counters on BOTH slices, live admitted
+    # accounting, ring-wait counters, policy-lag stamps — asserted by
+    # the bench's own selftest verdict.
+    JAX_PLATFORMS=cpu python benchmarks/capacity_bench.py --selftest \
+        > /tmp/tbt_capacity_smoke.json
+    python - <<'EOF'
+import json
+out = json.loads(open("/tmp/tbt_capacity_smoke.json").read().strip().splitlines()[-1])
+assert out["selftest"]["ok"] is True, out["selftest"]
+rows = {r["family"]: r for r in out["rows"]}
+print("capacity-smoke: PASS (admitted/s continuous",
+      rows["continuous"]["admitted_per_s"],
+      "depth_gated", rows["depth_gated"]["admitted_per_s"], ")")
 EOF
 fi
 

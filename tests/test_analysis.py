@@ -26,6 +26,7 @@ from torchbeast_tpu.analysis.parity import (
     WireParityRule,
     check_flag_parity,
     check_ring_parity,
+    check_route_parity,
     check_wire_parity,
 )
 from torchbeast_tpu.analysis.selftest import run_selftest
@@ -733,6 +734,158 @@ class TestRingParity:
         assert ring_py["eligibility_slack"] == 4
 
 
+class TestRouteParity:
+    """ROUTE-PARITY (ISSUE 16): the splitmix64 slot->slice hash and the
+    per-slice telemetry namespace pinned Python<->C++ against the
+    ground-truth spec, drift injected in BOTH directions."""
+
+    PLACEMENT_PY = (
+        "def _mix64(x):\n"
+        "    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF\n"
+        "    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9)"
+        " & 0xFFFFFFFFFFFFFFFF\n"
+        "    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB)"
+        " & 0xFFFFFFFFFFFFFFFF\n"
+        "    return x ^ (x >> 31)\n"
+    )
+    ROUTING_H = (
+        "constexpr uint64_t kSplitMix64Gamma = 0x9E3779B97F4A7C15ULL;\n"
+        "constexpr uint64_t kSplitMix64Mul1 = 0xBF58476D1CE4E5B9ULL;\n"
+        "constexpr uint64_t kSplitMix64Mul2 = 0x94D049BB133111EBULL;\n"
+        "constexpr int kSplitMix64Shift1 = 30;\n"
+        "constexpr int kSplitMix64Shift2 = 27;\n"
+        "constexpr int kSplitMix64Shift3 = 31;\n"
+        'constexpr const char kSliceSeriesPrefix[] = "inference.slice.";\n'
+    )
+    SERIES_PY = (
+        "def series(i):\n"
+        '    return f"inference.slice.{i}.requests"\n'
+    )
+
+    def _ctx(self, src, path=lint_config.PLACEMENT_PY):
+        return FileContext(path, src)
+
+    def _series(self, src=None):
+        return [self._ctx(src or self.SERIES_PY,
+                          lint_config.SLICE_SERIES_FILES[0])]
+
+    def test_matched_sides_clean(self):
+        assert not check_route_parity(
+            self._ctx(self.PLACEMENT_PY), self.ROUTING_H, self._series()
+        )
+
+    def test_cpp_constant_drift_flagged(self):
+        drifted = self.ROUTING_H.replace(
+            "kSplitMix64Mul1 = 0xBF58476D1CE4E5B9ULL",
+            "kSplitMix64Mul1 = 0xBF58476D1CE4E5B8ULL",
+        )
+        found = check_route_parity(
+            self._ctx(self.PLACEMENT_PY), drifted, self._series()
+        )
+        assert any(
+            "first multiplier" in f.message and "routing.h" in f.path
+            for f in found
+        )
+        assert all(f.rule == "ROUTE-PARITY" for f in found)
+
+    def test_py_shift_drift_flagged(self):
+        drifted = self.PLACEMENT_PY.replace("x >> 30", "x >> 29")
+        found = check_route_parity(
+            self._ctx(drifted), self.ROUTING_H, self._series()
+        )
+        assert any(
+            "first xor-shift" in f.message
+            and f.path == lint_config.PLACEMENT_PY
+            for f in found
+        )
+
+    def test_py_gamma_drift_flagged(self):
+        drifted = self.PLACEMENT_PY.replace(
+            "x + 0x9E3779B97F4A7C15", "x + 0x9E3779B97F4A7C16"
+        )
+        found = check_route_parity(
+            self._ctx(drifted), self.ROUTING_H, self._series()
+        )
+        assert any("gamma" in f.message for f in found)
+
+    def test_lockstep_drift_still_flagged(self):
+        """Both sides drifting TOGETHER is still a finding: the check
+        is against the pinned spec, not mutual agreement (a lockstep
+        rewrite silently remaps every deployed slot assignment)."""
+        py = self.PLACEMENT_PY.replace("x >> 27", "x >> 26")
+        cpp = self.ROUTING_H.replace("Shift2 = 27", "Shift2 = 26")
+        found = check_route_parity(self._ctx(py), cpp, self._series())
+        assert any(f.path == lint_config.PLACEMENT_PY for f in found)
+        assert any(f.path == lint_config.ROUTING_H for f in found)
+
+    def test_cpp_series_prefix_drift_flagged(self):
+        drifted = self.ROUTING_H.replace(
+            '"inference.slice."', '"inference.slices."'
+        )
+        found = check_route_parity(
+            self._ctx(self.PLACEMENT_PY), drifted, self._series()
+        )
+        assert any("kSliceSeriesPrefix" in f.message for f in found)
+
+    def test_py_series_rename_flagged(self):
+        renamed = self.SERIES_PY.replace("inference.slice.", "infer.sl.")
+        found = check_route_parity(
+            self._ctx(self.PLACEMENT_PY), self.ROUTING_H,
+            self._series(renamed),
+        )
+        assert any("pinned per-slice prefix" in f.message for f in found)
+
+    def test_unparseable_side_is_a_finding_not_silence(self):
+        found = check_route_parity(
+            self._ctx("x = 1\n"), self.ROUTING_H, self._series()
+        )
+        assert found and any("cannot verify" in f.message for f in found)
+        found = check_route_parity(
+            self._ctx(self.PLACEMENT_PY), "// nothing\n", self._series()
+        )
+        assert found and any("cannot verify" in f.message for f in found)
+
+    def test_real_repo_in_anger(self):
+        """placement.py, csrc/routing.h, and both per-slice series
+        emitters agree RIGHT NOW — and the parse saw every field (no
+        vacuous None==None matches)."""
+        report = analysis.analyze_paths(
+            [lint_config.PLACEMENT_PY, *lint_config.SLICE_SERIES_FILES],
+            root=REPO,
+        )
+        found = _rules(report, "ROUTE-PARITY")
+        assert not found, [f.render() for f in found]
+        from torchbeast_tpu.analysis.parity import (
+            parse_cpp_routing,
+            parse_py_splitmix,
+        )
+
+        ctx = analysis.load_context(
+            os.path.join(REPO, lint_config.PLACEMENT_PY), REPO
+        )
+        mix_py = parse_py_splitmix(ctx.tree)
+        with open(os.path.join(REPO, lint_config.ROUTING_H)) as f:
+            mix_cpp, prefix = parse_cpp_routing(f.read())
+        assert None not in mix_py.values(), mix_py
+        assert mix_py == mix_cpp == lint_config.SPLITMIX64_SPEC
+        assert prefix == lint_config.SLICE_SERIES_PREFIX
+
+    def test_native_hash_matches_python_in_anger(self):
+        """The executable ground truth behind the textual pin: the C++
+        extension's splitmix64 IS placement._mix64 (when the native
+        runtime is built)."""
+        core = pytest.importorskip("_tbt_core")
+        from torchbeast_tpu.runtime.placement import _mix64
+
+        for slot in (0, 1, 7, 63, 255, 2**31, -1):
+            assert core.splitmix64(slot) == _mix64(slot & (2**64 - 1))
+        for n in (1, 2, 3, 8):
+            for slot in range(64):
+                assert core.slice_for_slot(
+                    slot=slot, n_slices=n
+                ) == _mix64(slot) % n
+
+
 class TestFlagParity:
     def test_default_drift_flagged_at_second_file(self):
         a = FileContext(
@@ -919,17 +1072,19 @@ class TestSelftestAndGate:
         assert set(verdict["rules"]) == {
             "HOTPATH-SYNC", "JIT-HAZARD", "DONATE-USE", "IMPORT-PURITY",
             "LOCK-DISCIPLINE", "EXCEPT-SWALLOW", "WIRE-PARITY",
-            "FLAG-PARITY", "RACE", "LOCK-ORDER", "HOTPATH-SYNC-XPROC",
-            "GIL-DISCIPLINE", "ATOMIC-ORDER", "CXX-LOCK-DISCIPLINE",
+            "ROUTE-PARITY", "FLAG-PARITY", "RACE", "LOCK-ORDER",
+            "HOTPATH-SYNC-XPROC", "GIL-DISCIPLINE", "ATOMIC-ORDER",
+            "CXX-LOCK-DISCIPLINE",
         }
         for name, checks in verdict["rules"].items():
             assert checks["positive"] and checks["clean"], (name, checks)
             assert checks["isolated"], (name, checks)
 
-    def test_list_rules_shows_all_fourteen(self):
-        """The 11 -> 14 rule invariant (ISSUE 10): every registered rule
-        appears in --list-rules, and every listed rule has a selftest
-        fixture pair (the selftest set and the registry agree)."""
+    def test_list_rules_shows_all_fifteen(self):
+        """The 11 -> 14 -> 15 rule invariant (ISSUE 10; ROUTE-PARITY
+        joined in ISSUE 16): every registered rule appears in
+        --list-rules, and every listed rule has a selftest fixture pair
+        (the selftest set and the registry agree)."""
         proc = subprocess.run(
             [sys.executable, "-m", "torchbeast_tpu.analysis",
              "--list-rules"],
@@ -940,7 +1095,7 @@ class TestSelftestAndGate:
         listed = {
             line.split()[0] for line in proc.stdout.splitlines() if line
         }
-        assert len(listed) == 14, sorted(listed)
+        assert len(listed) == 15, sorted(listed)
         verdict = run_selftest()
         assert listed == set(verdict["rules"]), (
             listed ^ set(verdict["rules"])

@@ -417,6 +417,53 @@ def test_dryrun_multichip_selftest(tmp_path):
     assert out["acceptance"]["required_min_ratio"] == 0.9
 
 
+def test_capacity_bench_selftest():
+    """capacity_bench --selftest (ISSUE 16): one tiny NATIVE
+    split+replica run per admission family with the capacity-row schema
+    pinned — every row must carry the shm scheduler-health counters
+    (`ring.doorbell_waits`/`ring.recheck_wakeups`), live admission
+    accounting (the armed deadline makes admitted-requests/s real, not
+    zero), BOTH per-slice request counters, and the provenance block —
+    so the committed capacity_curve.json can't silently lose a column
+    between capture rounds."""
+    proc = _run(["benchmarks/capacity_bench.py", "--selftest"],
+                timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "capacity_curve"
+    assert out["selftest"]["ok"] is True
+    assert out["selftest"]["schema_ok"] is True
+    assert out["device_split"] == "inf=2,learn=rest"
+    assert out["workload"]["request_deadline_ms"] > 0
+    families = {r["family"] for r in out["rows"]}
+    assert families == {"continuous", "depth_gated"}
+    for row in out["rows"]:
+        prov = row["provenance"]
+        assert prov["fresh"] is True
+        assert prov["topology"]["device_count"] == 3
+        assert prov["jax"]
+        assert row["steady_sps"] > 0
+        assert row["admitted_per_s"] > 0
+        assert row["request_p99_ms"] > 0
+        assert set(row["ring"]) == {
+            "ring.doorbell_waits", "ring.recheck_wakeups"
+        }
+        # Both pinned inference slices took traffic (the native
+        # SliceRouter fanned the slot hash over slice 0 AND 1).
+        slices = row["slices"]
+        assert set(slices) == {
+            "inference.slice.0.requests", "inference.slice.1.requests"
+        }
+        assert all(v > 0 for v in slices.values())
+        assert row["serving"]["serving.admitted"] > 0
+        # Selftest rows run unloaded; the pressure row is full-curve.
+        assert row["scheduler_pressure"] is False
+    # The acceptance block carries the admitted-SPS gate the full
+    # curve enforces (or documents the measured ceiling for).
+    assert out["acceptance"]["required_min_ratio"] == 1.1
+    assert out["acceptance"]["saturation_actors"] == 2
+
+
 def test_chaos_run_plan_scaling_rule():
     """The --scale plan-scaling rule, pinned WITHOUT a full run: scale
     N plans N SIGKILLs on servers 0..N-1 and N severs on actors

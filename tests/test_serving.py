@@ -600,3 +600,196 @@ def test_native_batcher_sheds_at_depth_and_expires():
     assert tm["expired"] == 2
     assert tm["queue_delay_s"]["count"] >= 3
     batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Remote replica serving (ISSUE 16): the replica tier over the wire/shm
+# transport stack — same hooks, same stamps, other side of a socket.
+
+
+class TestReplicaServer:
+    @staticmethod
+    def _act_fn(params, inputs):
+        """Toy policy: action = round(w) per row, logits carry w so the
+        reply proves WHICH snapshot served it."""
+        n = np.asarray(inputs["env"]).shape[1]
+        w = float(np.asarray(params["w"]).reshape(-1)[0])
+        return {
+            "action": np.full((1, n), int(w), np.int32),
+            "policy_logits": np.full((1, n, 2), w, np.float32),
+        }
+
+    def _server(self, address, **kwargs):
+        from torchbeast_tpu.serving.replica_server import ReplicaServer
+        from torchbeast_tpu.telemetry.metrics import MetricsRegistry
+
+        server = ReplicaServer(
+            self._act_fn, address,
+            max_policy_lag=5, batch_dim=1, timeout_ms=5,
+            registry=MetricsRegistry(), **kwargs,
+        )
+        server.start()
+        return server
+
+    @staticmethod
+    def _request(i=0):
+        return {"env": np.full((1, 1, 3), i, np.float32)}
+
+    @pytest.mark.parametrize("transport", ["unix", "shm"])
+    def test_publish_then_serve_stamps_lag(self, transport):
+        """Round-trip over a REAL transport (socket and shm ring): the
+        reply carries the serving snapshot's outputs and the true
+        policy_lag stamp from the server-side store."""
+        from torchbeast_tpu.serving.replica_server import (
+            RemoteReplicaBatcher,
+            RemoteSnapshotPublisher,
+        )
+
+        path = os.path.join(tempfile.mkdtemp(), f"rs_{transport}")
+        address = f"{transport}:{path}"
+        server = self._server(address)
+        publisher = RemoteSnapshotPublisher(address, timeout_s=10)
+        client = RemoteReplicaBatcher(address, timeout_s=10)
+        try:
+            publisher.publish(0, {"w": np.full((1,), 7.0, np.float32)})
+            for v in (1, 2, 3):
+                publisher.note_update(v)  # head runs 3 past the snapshot
+            out = client.compute(self._request())
+            assert int(np.asarray(out["action"]).reshape(-1)[0]) == 7
+            stamp = np.asarray(out["policy_lag"])
+            assert stamp.dtype == np.int32
+            assert int(stamp.reshape(-1)[0]) == 3
+            # A fresh publish drops the stamp back to zero.
+            publisher.publish(3, {"w": np.full((1,), 9.0, np.float32)})
+            out = client.compute(self._request())
+            assert int(np.asarray(out["action"]).reshape(-1)[0]) == 9
+            assert int(np.asarray(out["policy_lag"]).reshape(-1)[0]) == 0
+        finally:
+            client.close()
+            publisher.close()
+            server.stop()
+
+    def test_remote_leg_in_replica_router(self):
+        """The remote batcher drops into serving.ReplicaRouter as the
+        replica leg: healthy -> served remotely with stamps; the local
+        hooks' lag budget still gates the route to central."""
+        from torchbeast_tpu.serving.replica_server import (
+            RemoteReplicaBatcher,
+            RemoteSnapshotPublisher,
+        )
+        from torchbeast_tpu.telemetry.metrics import MetricsRegistry
+
+        path = os.path.join(tempfile.mkdtemp(), "rs_router")
+        address = f"unix:{path}"
+        server = self._server(address)
+        registry = MetricsRegistry()
+        # The learner-side store: publishes mirror to the remote host.
+        store = PolicySnapshotStore(refresh_updates=1, registry=registry)
+        hooks = ReplicaServingHooks(
+            store, max_policy_lag=2, batch_dim=1, registry=registry
+        )
+        publisher = RemoteSnapshotPublisher(address, timeout_s=10)
+        remote = RemoteReplicaBatcher(address, timeout_s=10)
+        central = DynamicBatcher(batch_dim=1, timeout_ms=5)
+
+        def serve_central():
+            for batch in iter(central):
+                batch.set_outputs({
+                    "action": np.full((1, len(batch)), -1, np.int32),
+                })
+
+        central_thread = threading.Thread(
+            target=serve_central, daemon=True
+        )
+        central_thread.start()
+        router = ReplicaRouter(central, remote, hooks, registry=registry)
+        try:
+            store.publish(0, {"w": np.full((1,), 4.0, np.float32)})
+            publisher.publish(0, {"w": np.full((1,), 4.0, np.float32)})
+            out = router.compute(self._request())
+            assert int(np.asarray(out["action"]).reshape(-1)[0]) == 4
+            assert (
+                registry.counter("serving.replica_requests").value() == 1
+            )
+            # Blow the local lag budget: the router degrades to central
+            # without touching the remote host.
+            for v in range(1, 5):
+                store.note_update(v)
+            out = router.compute(self._request())
+            assert int(np.asarray(out["action"]).reshape(-1)[0]) == -1
+            assert (
+                registry.counter("serving.central_requests").value() == 1
+            )
+        finally:
+            remote.close()
+            publisher.close()
+            central.close()
+            central_thread.join(2)
+            server.stop()
+
+    def test_unpublished_store_fails_loud_not_silent(self):
+        """A request before the first publish is an error reply (the
+        hooks refuse to serve nothing), surfaced as a raised error on
+        the client — never a hang or an unstamped reply."""
+        from torchbeast_tpu.serving.replica_server import (
+            RemoteReplicaBatcher,
+        )
+
+        path = os.path.join(tempfile.mkdtemp(), "rs_empty")
+        address = f"unix:{path}"
+        server = self._server(address)
+        client = RemoteReplicaBatcher(address, timeout_s=10)
+        try:
+            with pytest.raises((RuntimeError, ConnectionError)):
+                client.compute(self._request())
+        finally:
+            client.close()
+            server.stop()
+
+    def test_shed_propagates_as_typed_error(self):
+        """An admission-gated server sheds overload as a typed ShedError
+        on the CLIENT side, keeping the pool's shed/retry contract
+        across the wire."""
+        from torchbeast_tpu.serving.replica_server import (
+            RemoteReplicaBatcher,
+            RemoteSnapshotPublisher,
+        )
+
+        path = os.path.join(tempfile.mkdtemp(), "rs_shed")
+        address = f"unix:{path}"
+        server = self._server(
+            address, shed_max_queue_depth=1, max_batch_size=1
+        )
+        # Wedge the serving loop: grab the batcher's dispatch lock by
+        # never publishing — no, simpler: flood with concurrent
+        # requests so depth 1 must shed some.
+        publisher = RemoteSnapshotPublisher(address, timeout_s=10)
+        client = RemoteReplicaBatcher(address, timeout_s=10)
+        outcomes = {"served": 0, "shed": 0}
+        lock = threading.Lock()
+
+        def one(i):
+            try:
+                client.compute(self._request(i))
+                with lock:
+                    outcomes["served"] += 1
+            except ShedError:
+                with lock:
+                    outcomes["shed"] += 1
+
+        try:
+            publisher.publish(0, {"w": np.full((1,), 1.0, np.float32)})
+            threads = [
+                threading.Thread(target=one, args=(i,), daemon=True)
+                for i in range(16)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(20)
+            assert outcomes["served"] + outcomes["shed"] == 16
+            assert outcomes["served"] > 0
+        finally:
+            client.close()
+            publisher.close()
+            server.stop()

@@ -96,6 +96,41 @@ DTYPE_ITEMSIZE = {
     "float64": 8,
 }
 
+# ROUTE-PARITY anchors (ISSUE 16): the static slot->slice hash runs in
+# BOTH languages — runtime/placement.py `_mix64` for the Python pool and
+# csrc/routing.h `splitmix64` for the native one. The same slot MUST
+# land on the same slice either way (slot tables never migrate between
+# devices), so the splitmix64 finalizer constants are pinned against
+# the ground-truth spec below on both sides. The per-slice telemetry
+# namespace ("inference.slice.<i>.*") is part of the same contract:
+# dashboards and the capacity bench read one schema regardless of
+# which language routed the request.
+PLACEMENT_PY = "torchbeast_tpu/runtime/placement.py"
+ROUTING_H = "csrc/routing.h"
+# Python emitters of the per-slice series (both must build names under
+# SLICE_SERIES_PREFIX): the Python serving plane and the native
+# telemetry folder.
+SLICE_SERIES_FILES = (
+    "torchbeast_tpu/parallel/sebulba.py",
+    "torchbeast_tpu/runtime/native.py",
+)
+
+# splitmix64 finalizer ground truth (Vigna's constants): both languages
+# are checked against THIS, so a wrong constant on either side is a
+# finding even when the two sides agree with each other.
+SPLITMIX64_SPEC = {
+    "gamma": 0x9E3779B97F4A7C15,
+    "mul1": 0xBF58476D1CE4E5B9,
+    "mul2": 0x94D049BB133111EB,
+    "shift1": 30,
+    "shift2": 27,
+    "shift3": 31,
+}
+
+# The per-slice telemetry namespace: csrc/routing.h kSliceSeriesPrefix
+# and every Python series builder must use exactly this prefix.
+SLICE_SERIES_PREFIX = "inference.slice."
+
 # FLAG-PARITY anchors: drivers whose shared flags must agree on type and
 # default. Intentional divergences carry inline suppressions at the
 # add_argument site (with the reason), not entries here — the exemption
@@ -116,6 +151,11 @@ FLAG_PARITY_GROUPS = (
     # the driver's meaning (its deliberately scaled-down defaults carry
     # inline suppressions).
     ("torchbeast_tpu/polybeast.py", "scripts/chaos_run.py"),
+    # The capacity bench re-declares the driver flags its subprocess
+    # rows forward (ISSUE 16); its deliberately scaled-down / armed-by-
+    # default values carry inline suppressions at the add_argument
+    # sites.
+    ("torchbeast_tpu/polybeast.py", "benchmarks/capacity_bench.py"),
 )
 
 # Whole-program concurrency analysis scope (RACE / LOCK-ORDER /

@@ -543,6 +543,213 @@ class WireParityRule:
 
 
 # ---------------------------------------------------------------------------
+# ROUTE-PARITY
+
+
+# Human-readable labels for the splitmix64 contract fields.
+_SPLITMIX_FIELD_LABELS = {
+    "gamma": "splitmix64 gamma increment (kSplitMix64Gamma)",
+    "mul1": "splitmix64 first multiplier (kSplitMix64Mul1)",
+    "mul2": "splitmix64 second multiplier (kSplitMix64Mul2)",
+    "shift1": "splitmix64 first xor-shift (kSplitMix64Shift1)",
+    "shift2": "splitmix64 second xor-shift (kSplitMix64Shift2)",
+    "shift3": "splitmix64 final xor-shift (kSplitMix64Shift3)",
+}
+
+
+def parse_py_splitmix(tree: ast.Module) -> Dict[str, Optional[int]]:
+    """runtime/placement.py `_mix64` -> canonical splitmix64 fields.
+
+    Constants are classified by operator context, not position: the Add
+    operand is the gamma increment, RShift operands are the xor-shifts
+    in statement order, Mult operands the multipliers. The
+    `& 0xFFFFFFFFFFFFFFFF` masks are Python-only wrap emulation (C++
+    uint64_t wraps natively) and are ignored (BitAnd)."""
+    out: Dict[str, Optional[int]] = {
+        key: None for key in _SPLITMIX_FIELD_LABELS
+    }
+    fn = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.FunctionDef) and n.name == "_mix64"),
+        None,
+    )
+    if fn is None:
+        return out
+    shifts: List[int] = []
+    muls: List[int] = []
+    for stmt in fn.body:  # statement order == finalizer stage order
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.BinOp):
+                continue
+            value = _fold_py_int(node.right)
+            if value is None:
+                continue
+            if isinstance(node.op, ast.Add) and out["gamma"] is None:
+                out["gamma"] = value
+            elif isinstance(node.op, ast.RShift):
+                shifts.append(value)
+            elif isinstance(node.op, ast.Mult):
+                muls.append(value)
+    for i, value in enumerate(shifts[:3]):
+        out[f"shift{i + 1}"] = value
+    for i, value in enumerate(muls[:2]):
+        out[f"mul{i + 1}"] = value
+    return out
+
+
+def parse_cpp_routing(
+    routing_h: str,
+) -> Tuple[Dict[str, Optional[int]], Optional[str]]:
+    """csrc/routing.h -> (splitmix64 fields, slice-series prefix)."""
+    names = {
+        "Gamma": "gamma", "Mul1": "mul1", "Mul2": "mul2",
+        "Shift1": "shift1", "Shift2": "shift2", "Shift3": "shift3",
+    }
+    out: Dict[str, Optional[int]] = {
+        key: None for key in _SPLITMIX_FIELD_LABELS
+    }
+    for m in re.finditer(
+        r"constexpr\s+(?:uint64_t|int)\s+kSplitMix64(\w+)\s*=\s*"
+        r"(0[xX][0-9a-fA-F]+|\d+)(?:[uU]?[lL]{0,2})\s*;",
+        routing_h,
+    ):
+        key = names.get(m.group(1))
+        if key is not None:
+            out[key] = int(m.group(2), 0)
+    prefix_m = re.search(
+        r"constexpr\s+const\s+char\s+kSliceSeriesPrefix\[\]\s*=\s*"
+        r'"([^"]*)"',
+        routing_h,
+    )
+    return out, (prefix_m.group(1) if prefix_m else None)
+
+
+def _py_string_prefixes(tree: ast.Module) -> List[str]:
+    """Every literal string prefix in the module: plain str constants
+    verbatim, f-strings contribute their leading constant fragment
+    (`f"inference.slice.{i}.depth"` -> "inference.slice.")."""
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append(node.value)
+        elif isinstance(node, ast.JoinedStr) and node.values:
+            head = node.values[0]
+            if isinstance(head, ast.Constant) and isinstance(
+                head.value, str
+            ):
+                out.append(head.value)
+    return out
+
+
+def check_route_parity(
+    placement_ctx: FileContext,
+    routing_h: str,
+    series_ctxs: Sequence[FileContext],
+) -> List[Finding]:
+    """ROUTE-PARITY: the slot->slice hash and the per-slice telemetry
+    namespace agree across languages. Both sides check against the
+    SPLITMIX64_SPEC ground truth (a wrong constant on either side is a
+    finding even if the other side drifted in lockstep); the series
+    prefix pins csrc/routing.h kSliceSeriesPrefix AND every Python
+    emitter to config.SLICE_SERIES_PREFIX. Unparseable side = finding,
+    not silence."""
+    findings: List[Finding] = []
+
+    def finding(path: str, msg: str):
+        findings.append(Finding("ROUTE-PARITY", path, 1, msg))
+
+    mix_py = parse_py_splitmix(placement_ctx.tree)
+    mix_cpp, prefix_cpp = parse_cpp_routing(routing_h)
+    if all(v is None for v in mix_py.values()):
+        finding(placement_ctx.path,
+                "could not parse the _mix64 splitmix64 finalizer from "
+                "runtime/placement.py — ROUTE-PARITY cannot verify the "
+                "slot->slice hash")
+        return findings
+    if all(v is None for v in mix_cpp.values()):
+        finding(config.ROUTING_H,
+                "could not parse the kSplitMix64* constants from "
+                "csrc/routing.h — ROUTE-PARITY cannot verify the "
+                "slot->slice hash")
+        return findings
+    for key, label in _SPLITMIX_FIELD_LABELS.items():
+        spec = config.SPLITMIX64_SPEC[key]
+        py_v, cpp_v = mix_py.get(key), mix_cpp.get(key)
+        if py_v is None:
+            finding(placement_ctx.path,
+                    f"{label}: missing/unparseable in placement._mix64 "
+                    f"(spec says {spec:#x})")
+        elif py_v != spec:
+            finding(placement_ctx.path,
+                    f"{label}: placement._mix64 uses {py_v:#x}, the "
+                    f"pinned spec (analysis/config.py) says {spec:#x} — "
+                    "a drifted hash remaps every slot's slice")
+        if cpp_v is None:
+            finding(config.ROUTING_H,
+                    f"{label}: missing/unparseable in csrc/routing.h "
+                    f"(spec says {spec:#x})")
+        elif cpp_v != spec:
+            finding(config.ROUTING_H,
+                    f"{label}: csrc/routing.h says {cpp_v:#x}, the "
+                    f"pinned spec (analysis/config.py) says {spec:#x} — "
+                    "native and Python pools would route the same slot "
+                    "to different slices")
+    # The per-slice telemetry namespace.
+    want = config.SLICE_SERIES_PREFIX
+    if prefix_cpp is None:
+        finding(config.ROUTING_H,
+                "could not parse kSliceSeriesPrefix from csrc/routing.h "
+                f"— expected the pinned prefix {want!r}")
+    elif prefix_cpp != want:
+        finding(config.ROUTING_H,
+                f"kSliceSeriesPrefix is {prefix_cpp!r}, the pinned "
+                f"per-slice series prefix is {want!r}")
+    for ctx in series_ctxs:
+        strings = _py_string_prefixes(ctx.tree)
+        if not any(s.startswith(want) for s in strings):
+            finding(ctx.path,
+                    f"no telemetry series under the pinned per-slice "
+                    f"prefix {want!r} — the per-slice schema emitter "
+                    "moved or renamed its series")
+    return findings
+
+
+class RouteParityRule:
+    """ROUTE-PARITY: runtime/placement.py == csrc/routing.h on the
+    splitmix64 slot->slice hash, and every per-slice telemetry emitter
+    uses the pinned `inference.slice.` namespace."""
+
+    name = "ROUTE-PARITY"
+
+    def check_repo(
+        self, root: str, contexts: Sequence[FileContext]
+    ) -> List[Finding]:
+        by_path = {ctx.path: ctx for ctx in contexts}
+        placement_ctx = by_path.get(config.PLACEMENT_PY)
+        if placement_ctx is None:
+            return []  # partial scan (explicit paths): parity not in scope
+        routing_path = os.path.join(root, config.ROUTING_H)
+        try:
+            with open(routing_path, encoding="utf-8",
+                      errors="replace") as f:
+                routing_h = f.read()
+        except OSError:
+            routing_h = ""
+        if not routing_h:
+            return [
+                Finding(
+                    self.name, config.PLACEMENT_PY, 1,
+                    "csrc/routing.h missing — the C++ side of the "
+                    "slot->slice routing contract is gone",
+                )
+            ]
+        series_ctxs = [
+            by_path[p] for p in config.SLICE_SERIES_FILES if p in by_path
+        ]
+        return check_route_parity(placement_ctx, routing_h, series_ctxs)
+
+
+# ---------------------------------------------------------------------------
 # FLAG-PARITY
 
 
@@ -620,4 +827,4 @@ class FlagParityRule:
         return findings
 
 
-REPO_RULES = [WireParityRule(), FlagParityRule()]
+REPO_RULES = [WireParityRule(), RouteParityRule(), FlagParityRule()]

@@ -15,7 +15,11 @@ from . import (
     analyze_sources,
 )
 from .engine import FileContext, run_rules
-from .parity import check_flag_parity, check_wire_parity
+from .parity import (
+    check_flag_parity,
+    check_route_parity,
+    check_wire_parity,
+)
 from .rules import FILE_RULES
 
 # --------------------------------------------------------------------------
@@ -574,6 +578,41 @@ def parse(parser):
     parser.add_argument("--learning_rate", type=str, default=0.1)
 '''
 
+_ROUTE_PLACEMENT = '''
+def _mix64(x):
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+'''
+
+_ROUTE_SERIES = '''
+def series(i):
+    return f"inference.slice.{i}.requests"
+'''
+
+_ROUTING_H_CLEAN = '''
+constexpr uint64_t kSplitMix64Gamma = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t kSplitMix64Mul1 = 0xBF58476D1CE4E5B9ULL;
+constexpr uint64_t kSplitMix64Mul2 = 0x94D049BB133111EBULL;
+constexpr int kSplitMix64Shift1 = 30;
+constexpr int kSplitMix64Shift2 = 27;
+constexpr int kSplitMix64Shift3 = 31;
+constexpr const char kSliceSeriesPrefix[] = "inference.slice.";
+'''
+
+# Two seeded drifts: a finalizer multiplier off by one nibble AND a
+# renamed per-slice series prefix.
+_ROUTING_H_DRIFTED = '''
+constexpr uint64_t kSplitMix64Gamma = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t kSplitMix64Mul1 = 0xBF58476D1CE4E5B8ULL;
+constexpr uint64_t kSplitMix64Mul2 = 0x94D049BB133111EBULL;
+constexpr int kSplitMix64Shift1 = 30;
+constexpr int kSplitMix64Shift2 = 27;
+constexpr int kSplitMix64Shift3 = 31;
+constexpr const char kSliceSeriesPrefix[] = "serving.slice.";
+'''
+
 
 def run_selftest() -> dict:
     t0 = time.perf_counter()
@@ -685,6 +724,24 @@ def run_selftest() -> dict:
         "positive": len(drifted) == 2,  # one default drift + one type drift
         "clean": not clean,
         "isolated": all(f.rule == "FLAG-PARITY" for f in drifted),
+    }
+
+    placement_ctx = FileContext(
+        "torchbeast_tpu/runtime/placement.py", _ROUTE_PLACEMENT
+    )
+    series_ctxs = [FileContext(
+        "torchbeast_tpu/parallel/sebulba.py", _ROUTE_SERIES
+    )]
+    drifted = check_route_parity(
+        placement_ctx, _ROUTING_H_DRIFTED, series_ctxs
+    )
+    clean = check_route_parity(
+        placement_ctx, _ROUTING_H_CLEAN, series_ctxs
+    )
+    rules["ROUTE-PARITY"] = {
+        "positive": len(drifted) == 2,  # hash drift + series-prefix drift
+        "clean": not clean,
+        "isolated": all(f.rule == "ROUTE-PARITY" for f in drifted),
     }
 
     # -- mechanics ---------------------------------------------------------

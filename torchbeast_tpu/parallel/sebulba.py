@@ -235,8 +235,16 @@ def build_sebulba_serving(
     throttle_fn: Optional[Callable] = None,
     pipelined: bool = False,
     batch_dim: int = 1,
+    batcher_factory: Optional[Callable] = None,
 ) -> SebulbaServing:
     """Assemble one serving stack per inference slice.
+
+    `batcher_factory(i, name)` overrides per-slice batcher
+    construction — the native serving plane (ISSUE 16) passes a
+    factory returning C++ `_tbt_core.DynamicBatcher`s so the actor
+    pool's C++ SliceRouter fans out without touching Python, while
+    the Python serving loops (and the state tables, hooks, and
+    telemetry prefixes built here) stay identical.
 
     `initial_state` + `table_act_fn`: the device-resident path — one
     pinned DeviceStateTable per slice, context (snapshot params, rng)
@@ -271,14 +279,17 @@ def build_sebulba_serving(
     tables = []
     for i, device in enumerate(split.inference_devices):
         name = f"inference.slice.{i}"
-        batcher = DynamicBatcher(
-            batch_dim=batch_dim,
-            minimum_batch_size=1,
-            maximum_batch_size=max_batch_size,
-            timeout_ms=timeout_ms,
-            telemetry_name=name,
-            admission=admission,
-        )
+        if batcher_factory is not None:
+            batcher = batcher_factory(i, name)
+        else:
+            batcher = DynamicBatcher(
+                batch_dim=batch_dim,
+                minimum_batch_size=1,
+                maximum_batch_size=max_batch_size,
+                timeout_ms=timeout_ms,
+                telemetry_name=name,
+                admission=admission,
+            )
         hooks = None
         if store is not None:
             from torchbeast_tpu.serving import ReplicaServingHooks

@@ -271,7 +271,9 @@ def make_parser():
                              "device count). Empty = today's "
                              "time-shared path; a single-device "
                              "process degrades to it with a warning. "
-                             "Python runtime only today.")
+                             "Both runtimes: under --native_runtime "
+                             "the slot-hash routing runs in the C++ "
+                             "pool (csrc/routing.h), GIL-free.")
     parser.add_argument("--admission_depth_factor", type=int, default=4,
                         help="Admission-gate queue-depth bound as a "
                              "multiple of --max_inference_batch_size "
@@ -283,6 +285,24 @@ def make_parser():
                              "keeps the formation pipeline fed under "
                              "bursts; shallower sheds earlier instead "
                              "of manufacturing deadline expiries.")
+    parser.add_argument("--continuous_batching", dest="continuous_batching",
+                        action="store_true", default=True,
+                        help="Native runtime: roll late-arriving "
+                             "admitted requests into the next dispatch "
+                             "window when the forming batch has room, "
+                             "instead of leaving them queued behind the "
+                             "admission depth bound (default on; "
+                             "--admission_depth_factor stays armed as "
+                             "the fallback hard bound). The shed/expiry "
+                             "audit is unchanged: rolled requests face "
+                             "the same deadline gate at dispatch. "
+                             "Ignored by the Python batcher.")
+    parser.add_argument("--no_continuous_batching",
+                        dest="continuous_batching", action="store_false",
+                        help="Depth-gated dispatch only (the ISSUE 14 "
+                             "admission behavior): requests wait for "
+                             "the next batch formation cycle even when "
+                             "the in-flight window has room.")
     parser.add_argument("--num_learner_devices", type=int, default=1,
                         help="Width of the DATA-parallel axis: params "
                              "replicated, batch sharded over it, ICI "
@@ -344,9 +364,11 @@ def make_parser():
                              "into the rollout (V-trace sees the real "
                              "behavior policy either way — the logits "
                              "ARE the stale policy's). 0 = central "
-                             "serving only. Python runtime only today "
-                             "(ignored with a warning under "
-                             "--native_runtime).")
+                             "serving only. Both runtimes: under "
+                             "--native_runtime the replica/central "
+                             "routing runs in the C++ pool with the "
+                             "lag-budget health gate pushed from the "
+                             "Python serving hooks.")
     parser.add_argument("--max_policy_lag", type=int, default=20,
                         help="Replica staleness budget, in updates: "
                              "when the latest snapshot trails the "
@@ -528,12 +550,6 @@ def train(flags):
                 "--device_split is single-host today (the multi-host "
                 "Sebulba composes the split per host over DCN — a "
                 "follow-up; see ROADMAP)"
-            )
-        if flags.native_runtime is True:
-            raise RuntimeError(
-                "--device_split is a Python-runtime feature today (the "
-                "slice router sits in the Python actor pool's request "
-                "path, like replica serving); drop --native_runtime"
             )
     if getattr(flags, "admission_depth_factor", 4) < 1:
         # Pure flag predicate — rejected BEFORE any side effects, like
@@ -976,15 +992,6 @@ def train(flags):
         # publish Python-pool numbers as native ones).
         native_pref = flags.native_runtime  # None=auto, True/False=forced
         use_native = native_pref is not False
-        if split is not None and use_native:
-            # Explicit --native_runtime was already rejected at flag
-            # validation; the native-first default falls back to the
-            # Python pool, where the slice router lives.
-            use_native = False
-            log.info(
-                "Device split active: serving through the Python pool "
-                "(the slice router sits in its request path)"
-            )
         if use_native:
             from torchbeast_tpu.runtime.native import (
                 gap_reason,
@@ -1043,14 +1050,19 @@ def train(flags):
         if use_native:
             # The C++ batcher gates admission in-process (actor threads
             # never touch Python on a shed); counters fold back into
-            # the serving.* series each monitor tick.
-            batcher_tm = {}
+            # the serving.* series each monitor tick. Continuous
+            # batching (ISSUE 16) rolls admitted late arrivals into the
+            # forming dispatch window; --admission_depth_factor stays
+            # armed as the fallback hard bound.
+            batcher_tm = {
+                "continuous": getattr(flags, "continuous_batching", True),
+            }
             if deadline_ms > 0:
-                batcher_tm = {
+                batcher_tm.update({
                     "request_deadline_ms": deadline_ms,
                     "shed_max_queue_depth": shed_depth,
                     "slo_target_ms": deadline_ms,
-                }
+                })
         else:
             batcher_tm = {
                 "telemetry_name": "inference", "admission": admission,
@@ -1179,6 +1191,7 @@ def train(flags):
         # chaos) unchanged.
         sebulba = None
         snapshot_store = None
+        native_slice_router = None
         refresh_updates = getattr(flags, "replica_refresh_updates", 0) or 0
         if split is not None:
             from torchbeast_tpu.parallel.sebulba import (
@@ -1199,6 +1212,25 @@ def train(flags):
                 params_now, key = ctx
                 return _act_with(params_now, key, env_outputs,
                                  agent_state)
+
+            # Native serving plane (ISSUE 16): each slice gets a C++
+            # DynamicBatcher (admission + continuous batching gated
+            # in-process) so the pool's C++ SliceRouter fans out
+            # GIL-free; the Python serving loops, hooks, and pinned
+            # state tables built by build_sebulba_serving are
+            # unchanged.
+            native_slice_factory = None
+            if use_native:
+                def native_slice_factory(i, name):
+                    return queue_mod.DynamicBatcher(
+                        batch_dim=1,
+                        minimum_batch_size=1,
+                        maximum_batch_size=(
+                            flags.max_inference_batch_size
+                        ),
+                        timeout_ms=flags.inference_timeout_ms,
+                        **batcher_tm,
+                    )
 
             sebulba = build_sebulba_serving(
                 split,
@@ -1223,16 +1255,37 @@ def train(flags):
                 registry=reg,
                 admission=admission,
                 throttle_fn=throttle,
+                batcher_factory=native_slice_factory,
             )
             state_table = sebulba.state_tables
+            if use_native:
+                # The C++ router the pool serves through: slot-hash
+                # fan-out over the slices' native batchers, bit-
+                # identical to the Python SliceRouter's assignment
+                # (splitmix64, pinned by beastlint ROUTE-PARITY).
+                native_slice_router = queue_mod.SliceRouter(
+                    slices=[s.batcher for s in sebulba.stacks]
+                )
+                if telemetry_on:
+                    # Per-request serving_ok() pokes live in the Python
+                    # router; on the native path the monitor tick
+                    # drives each slice's keyed lag degrade/recover
+                    # transitions instead.
+                    def _slice_health_tick():
+                        for _stack in sebulba.stacks:
+                            if _stack.hooks is not None:
+                                _stack.hooks.serving_ok()
+
+                    tele.add_tick_callback(_slice_health_tick)
             tele.set_static("device_split", split.describe())
             if telemetry_on:
                 tele.add_tick_callback(sebulba.gauge_tick(reg))
             log.info(
                 "Sebulba serving: %d slice(s), snapshot refresh every "
-                "%d update(s), max policy lag %d",
+                "%d update(s), max policy lag %d (%s routing)",
                 split.n_slices, max(1, refresh_updates),
                 flags.max_policy_lag,
+                "native" if use_native else "python",
             )
 
         if chaos is not None:
@@ -1344,13 +1397,6 @@ def train(flags):
             # --replica_refresh_updates already set the publish cadence
             # above, so a separate replica tier would be redundant.
             pass
-        elif refresh_updates > 0 and use_native:
-            log.warning(
-                "--replica_refresh_updates is a Python-runtime feature "
-                "today (the routing sits in the Python actor pool); "
-                "ignored under the native runtime — central serving "
-                "only. Pass --no_native_runtime to serve from replicas."
-            )
         elif refresh_updates > 0:
             from torchbeast_tpu.serving import (
                 PolicySnapshotStore,
@@ -1373,22 +1419,75 @@ def train(flags):
                 batch_dim=1,
                 registry=reg,
             )
-            replica_batcher = DynamicBatcher(
-                batch_dim=1,
-                minimum_batch_size=1,
-                maximum_batch_size=flags.max_inference_batch_size,
-                timeout_ms=flags.inference_timeout_ms,
-                telemetry_name="replica",
-                admission=admission,
-            )
+            loop_hooks = replica_hooks
+            if use_native:
+                # Native replica routing (ISSUE 16): the C++
+                # ReplicaRouter answers replica-first with central
+                # fallback, gated by an atomic flag the Python hooks
+                # PUSH (per served batch + per monitor tick) instead
+                # of a GIL round-trip per request. Degradation flips
+                # routing at batch granularity; recovery rides the
+                # monitor tick — a degraded replica sees no batches,
+                # so only the tick can re-arm it.
+                replica_batcher = queue_mod.DynamicBatcher(
+                    batch_dim=1,
+                    minimum_batch_size=1,
+                    maximum_batch_size=flags.max_inference_batch_size,
+                    timeout_ms=flags.inference_timeout_ms,
+                    **batcher_tm,
+                )
+                native_replica_router = queue_mod.ReplicaRouter(
+                    central=inference_batcher, replica=replica_batcher,
+                )
+                native_replica_router.set_serving(
+                    replica_hooks.serving_ok()
+                )
+                if telemetry_on:
+                    tele.add_tick_callback(
+                        lambda: native_replica_router.set_serving(
+                            replica_hooks.serving_ok()
+                        )
+                    )
+
+                class _FlagSyncHooks:
+                    """The replica serving loop's hook twin: every
+                    begin_batch refreshes the router's serving flag
+                    before picking the snapshot ctx, keeping the C++
+                    routing decision one batch behind the lag budget
+                    at most."""
+
+                    def __init__(self, hooks, router):
+                        self._hooks = hooks
+                        self._router = router
+
+                    def begin_batch(self):
+                        self._router.set_serving(
+                            self._hooks.serving_ok()
+                        )
+                        return self._hooks.begin_batch()
+
+                loop_hooks = _FlagSyncHooks(
+                    replica_hooks, native_replica_router
+                )
+                replica_router = native_replica_router
+            else:
+                replica_batcher = DynamicBatcher(
+                    batch_dim=1,
+                    minimum_batch_size=1,
+                    maximum_batch_size=flags.max_inference_batch_size,
+                    timeout_ms=flags.inference_timeout_ms,
+                    telemetry_name="replica",
+                    admission=admission,
+                )
+                replica_router = ReplicaRouter(
+                    inference_batcher, replica_batcher, replica_hooks,
+                    registry=reg,
+                )
             replica_parts = {
                 "store": snapshot_store,
                 "hooks": replica_hooks,
                 "batcher": replica_batcher,
-                "router": ReplicaRouter(
-                    inference_batcher, replica_batcher, replica_hooks,
-                    registry=reg,
-                ),
+                "router": replica_router,
             }
 
             def _replica_act_fn(env_outputs, agent_state, batch_size, ctx):
@@ -1407,13 +1506,16 @@ def train(flags):
                     lock=None,
                     pipelined=False,
                     state_table=state_table,
-                    serving_hooks=replica_hooks,
+                    serving_hooks=loop_hooks,
                     throttle_fn=throttle,
+                    telemetry_prefix="replica",
                 )
 
             log.info(
                 "Replica serving armed: refresh every %d updates, "
-                "max policy lag %d", refresh_updates, flags.max_policy_lag,
+                "max policy lag %d (%s routing)",
+                refresh_updates, flags.max_policy_lag,
+                "native" if use_native else "python",
             )
 
         # Supervised serving threads (ISSUE 6): a poisoned state table
@@ -1477,11 +1579,15 @@ def train(flags):
             )
 
         # The batcher-shaped surface the pool (and the monitor's depth
-        # series) talks to: the slice router under the split, the
-        # replica router when replicas are armed, else the central
+        # series) talks to: the slice router under the split (the C++
+        # one when the native pool serves — same slot hash, zero GIL),
+        # the replica router when replicas are armed, else the central
         # batcher itself.
         if sebulba is not None:
-            serving_frontend = sebulba.router
+            serving_frontend = (
+                native_slice_router if native_slice_router is not None
+                else sebulba.router
+            )
         elif replica_parts is not None:
             serving_frontend = replica_parts["router"]
         else:
@@ -1491,7 +1597,7 @@ def train(flags):
         # the router's summed slice depths under the split.
         serving_depth_fn = (
             inference_batcher.size if inference_batcher is not None
-            else sebulba.router.size
+            else serving_frontend.size
         )
 
         pool_cls = queue_mod.ActorPool if use_native else ActorPool
@@ -1499,12 +1605,15 @@ def train(flags):
         if state_table is not None:
             pool_kwargs["state_table"] = state_table
         if not use_native:
-            # SLO breach accounting + replica/slice routing live
-            # actor-side in the Python pool (the C++ pool counts
-            # breaches batcher-side and retries sheds in its own loops).
+            # SLO breach accounting lives actor-side in the Python
+            # pool (the C++ pool counts breaches batcher-side and
+            # retries sheds in its own loops).
             pool_kwargs["slo_target_s"] = slo_target_s
-            if replica_parts is not None or sebulba is not None:
-                pool_kwargs["record_policy_lag"] = True
+        if replica_parts is not None or sebulba is not None:
+            # Both pools normalize a missing policy_lag leaf to zeros
+            # when lag-stamped serving is armed, so rollouts mixing
+            # replica/slice and central replies stay well-formed.
+            pool_kwargs["record_policy_lag"] = True
         # Chaos interposition (ISSUE 6/12) on EITHER runtime: the Python
         # pool wraps each fresh transport in a FaultingTransport; the
         # C++ pool builds its FaultHooks (csrc/chaos.h) and the
@@ -1532,10 +1641,26 @@ def train(flags):
             # Python runtime writes, on every exported line.
             from torchbeast_tpu.runtime.native import NativeTelemetryFolder
 
+            folder_kwargs = {}
+            if native_slice_router is not None:
+                # Per-slice fold (ISSUE 16): slice batcher admission
+                # counters aggregate into serving.*, slice depths +
+                # routed counts land on the same inference.slice.<i>.*
+                # series the Python router/gauge-tick publish.
+                folder_kwargs.update(
+                    slice_batchers=[s.batcher for s in sebulba.stacks],
+                    slice_router=native_slice_router,
+                )
+            if replica_parts is not None:
+                folder_kwargs.update(
+                    replica_batcher=replica_parts["batcher"],
+                    replica_router=replica_parts["router"],
+                )
             tele.add_tick_callback(
                 NativeTelemetryFolder(
                     reg, pool=actors, batcher=inference_batcher,
                     queue=learner_queue, slo_target_s=slo_target_s,
+                    **folder_kwargs,
                 ).tick
             )
         actor_thread = threading.Thread(

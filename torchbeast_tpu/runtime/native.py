@@ -57,10 +57,12 @@ def available() -> bool:
 # The extension API generation this tree requires. Bumped when the
 # Python side starts DEPENDING on a C++ surface (not merely tolerating
 # its absence): 1 = the ISSUE 14 shed protocol (ShedError type,
-# admission kwargs on DynamicBatcher, shed counters in telemetry) —
-# an older .so would silently serve without admission control, so the
-# default-on runtime falls back to Python instead.
-REQUIRED_API_VERSION = 1
+# admission kwargs on DynamicBatcher, shed counters in telemetry);
+# 2 = the ISSUE 16 serving plane (SliceRouter/ReplicaRouter types,
+# continuous batching + rolled counter, ActorPool record_policy_lag) —
+# an older .so would silently serve central-only, so the default-on
+# runtime falls back to Python instead.
+REQUIRED_API_VERSION = 2
 
 
 def gap_reason(core=None) -> Optional[str]:
@@ -94,11 +96,47 @@ class NativeTelemetryFolder:
     """
 
     def __init__(self, registry, pool=None, batcher=None, queue=None,
-                 tracer=None, slo_target_s=None):
+                 tracer=None, slo_target_s=None, slice_batchers=None,
+                 slice_router=None, replica_router=None,
+                 replica_batcher=None):
         self._pool = pool
         self._batcher = batcher
         self._queue = queue
         self._slo_target_s = slo_target_s
+        # ISSUE 16 per-slice fold: native per-slice batchers' admission
+        # counters aggregate into the same serving.* series the central
+        # fold uses (one audit schema either topology), while their
+        # depths land on the per-slice "inference.slice.<i>.depth"
+        # gauges — the exact series the Python SebulbaServing
+        # gauge_tick publishes, so dashboards cannot tell the runtimes
+        # apart. The native SliceRouter's routed counts fold onto
+        # "inference.slice.<i>.requests" (the Python SliceRouter's
+        # series), the ReplicaRouter's onto serving.replica_requests/
+        # serving.central_requests (serving/replica.py's series).
+        self._slice_batchers = list(slice_batchers or [])
+        self._slice_router = slice_router
+        self._replica_router = replica_router
+        self._replica_batcher = replica_batcher
+        self._g_slice_depth = [
+            registry.gauge(f"inference.slice.{i}.depth")
+            for i in range(len(self._slice_batchers))
+        ]
+        self._c_slice_requests = []
+        if slice_router is not None:
+            self._c_slice_requests = [
+                registry.counter(f"inference.slice.{i}.requests")
+                for i in range(slice_router.n_slices())
+            ]
+        if replica_router is not None:
+            self._c_replica_requests = registry.counter(
+                "serving.replica_requests"
+            )
+            self._c_central_requests = registry.counter(
+                "serving.central_requests"
+            )
+        # Continuous-batching roll-ins (native only; the Python batcher
+        # has no dispatch-window top-up).
+        self._c_rolled = registry.counter("serving.rolled")
         # Sampled C++ request spans (ISSUE 12) land in the process
         # tracer as the same actor.request.* stage spans the Python
         # pool's StageTraces emit, so a native run's trace export is
@@ -158,7 +196,7 @@ class NativeTelemetryFolder:
         )
 
     # beastlint: holds self._lock
-    def _fold_traces(self) -> None:
+    def _fold_traces(self, batcher) -> None:
         """Drain the batcher's sampled (enqueued, batched, replied)
         stamp triples (csrc/queues.h, 1-in-256 computes like the Python
         pool) into tracer spans. Stamps are steady-clock; the payload's
@@ -166,7 +204,7 @@ class NativeTelemetryFolder:
         (both CLOCK_MONOTONIC on Linux — the offset absorbs any epoch
         difference). Always drained, even with tracing disabled, so
         the C++ buffer never sits full."""
-        spans_fn = getattr(self._batcher, "trace_spans", None)
+        spans_fn = getattr(batcher, "trace_spans", None)
         if spans_fn is None:  # extension built before ISSUE 12
             return
         payload = spans_fn()
@@ -186,6 +224,62 @@ class NativeTelemetryFolder:
                 "actor.request", "actor.request",
                 enqueued + offset, replied - enqueued,
             )
+
+    # beastlint: holds self._lock
+    def _batcher_sources(self):
+        """Every native batcher feeding the serving-tier fold, keyed
+        uniquely so _inc_delta's per-source cursors never collide."""
+        sources = []
+        if self._batcher is not None:
+            sources.append(("central", self._batcher))
+        if self._replica_batcher is not None:
+            sources.append(("replica", self._replica_batcher))
+        sources.extend(
+            (f"slice{i}", b)
+            for i, b in enumerate(self._slice_batchers)
+        )
+        return sources
+
+    # beastlint: holds self._lock
+    def _fold_batcher(self, key: str, batcher) -> bool:
+        """Fold one native batcher's interval telemetry. Returns True
+        when a queue-delay snapshot was folded (the caller refreshes
+        the p99/SLO gauges once, after every source folded)."""
+        b = batcher.telemetry()
+        # batches/rows/batch_size stay with the Python serving
+        # loop's own inference.* instruments (inference.py
+        # observes them for un-instrumented batchers) — folding
+        # them here would double-count.
+        self._fold_hist(self._h_request_wait, b["request_wait_s"])
+        self._fold_hist(self._h_rtt, b["request_rtt_s"])
+        # .get: an extension built before ISSUE 14 reports no
+        # admission accounting (and the stale gate keeps such a
+        # build off the default path anyway).
+        self._inc_delta(
+            self._c_admitted, f"{key}_serving_admitted",
+            b.get("admitted", 0),
+        )
+        self._inc_delta(
+            self._c_shed, f"{key}_serving_shed", b.get("shed", 0)
+        )
+        self._inc_delta(
+            self._c_expired, f"{key}_serving_expired",
+            b.get("expired", 0),
+        )
+        self._inc_delta(
+            self._c_slo_breaches, f"{key}_slo_breaches",
+            b.get("slo_breaches", 0),
+        )
+        self._inc_delta(
+            self._c_rolled, f"{key}_serving_rolled",
+            b.get("rolled", 0),
+        )
+        self._fold_traces(batcher)
+        delay = b.get("queue_delay_s")
+        if delay is None:
+            return False
+        self._fold_hist(self._h_queue_delay, delay)
+        return True
 
     def tick(self) -> None:
         with self._lock:
@@ -219,43 +313,39 @@ class NativeTelemetryFolder:
                     self._c_resubmits, "shed_resubmits",
                     p.get("shed_resubmits", 0),
                 )
-            if self._batcher is not None:
-                b = self._batcher.telemetry()
-                # batches/rows/batch_size stay with the Python serving
-                # loop's own inference.* instruments (inference.py
-                # observes them for un-instrumented batchers) — folding
-                # them here would double-count.
-                self._fold_hist(self._h_request_wait, b["request_wait_s"])
-                self._fold_hist(self._h_rtt, b["request_rtt_s"])
-                # .get: an extension built before ISSUE 14 reports no
-                # admission accounting (and the stale gate keeps such a
-                # build off the default path anyway).
+            folded_delay = False
+            for key, b_obj in self._batcher_sources():
+                folded_delay |= self._fold_batcher(key, b_obj)
+            if folded_delay:
+                # The p99/SLO gauges the Python AdmissionController
+                # refreshes inline are refolded here per tick from
+                # the registry's cumulative histogram (which aggregates
+                # every batcher source under one serving-tier view).
+                p99 = self._h_queue_delay.percentile(0.99)
+                self._g_delay_p99.set(p99)
+                if self._slo_target_s:
+                    self._g_slo_ratio.set(p99 / self._slo_target_s)
+            for gauge, b_obj in zip(
+                self._g_slice_depth, self._slice_batchers
+            ):
+                gauge.set(b_obj.size())
+            if self._slice_router is not None:
+                counts = self._slice_router.telemetry()["requests"]
+                for i, count in enumerate(counts):
+                    self._inc_delta(
+                        self._c_slice_requests[i],
+                        f"slice{i}_requests", count,
+                    )
+            if self._replica_router is not None:
+                r = self._replica_router.telemetry()
                 self._inc_delta(
-                    self._c_admitted, "serving_admitted",
-                    b.get("admitted", 0),
+                    self._c_replica_requests, "replica_requests",
+                    r["replica_requests"],
                 )
                 self._inc_delta(
-                    self._c_shed, "serving_shed", b.get("shed", 0)
+                    self._c_central_requests, "central_requests",
+                    r["central_requests"],
                 )
-                self._inc_delta(
-                    self._c_expired, "serving_expired",
-                    b.get("expired", 0),
-                )
-                self._inc_delta(
-                    self._c_slo_breaches, "slo_breaches",
-                    b.get("slo_breaches", 0),
-                )
-                delay = b.get("queue_delay_s")
-                if delay is not None:
-                    self._fold_hist(self._h_queue_delay, delay)
-                    # The p99/SLO gauges the Python AdmissionController
-                    # refreshes inline are refolded here per tick from
-                    # the registry's cumulative histogram.
-                    p99 = self._h_queue_delay.percentile(0.99)
-                    self._g_delay_p99.set(p99)
-                    if self._slo_target_s:
-                        self._g_slo_ratio.set(p99 / self._slo_target_s)
-                self._fold_traces()
             if self._queue is not None:
                 q = self._queue.telemetry()
                 self._inc_delta(self._c_queue_in, "queue_items_in",
