@@ -9,6 +9,13 @@ row families at an identical workload —
 - `inference_pinned`:  `--device_split` pins dedicated inference slices
                        and compiles the learner superstep over the rest
                        (runtime/placement.py + parallel/sebulba.py).
+- `fleet`:             the per-host topology held fixed (2 devices,
+                       inf=1,learn=rest) while the HOST count scales:
+                       each host is a whole polybeast process composed
+                       through the --fleet control plane (ISSUE 17;
+                       wire strategy on forced-CPU hosts). updates/s vs
+                       host count pins the composition overhead the
+                       DCN deployment must beat on real chips.
 
 Each row runs the FULL polybeast stack (env servers, actor loops,
 per-slice batchers, snapshot publication) in a subprocess with
@@ -45,19 +52,25 @@ sys.path.insert(0, _HERE)
 
 _ARTIFACT = os.path.join(_HERE, "artifacts", "dryrun_multichip.json")
 
-# (family, device count, split spec). Splits keep the learner-device
-# count a divisor of the batch size; surplus-idle specs (learn=M) keep
-# the comparison at matched learner widths where it matters.
+# (family, device count, split spec, host count). Splits keep the
+# learner-device count a divisor of the batch size; surplus-idle specs
+# (learn=M) keep the comparison at matched learner widths where it
+# matters. The `fleet` family (ISSUE 17) holds the PER-HOST topology
+# fixed (2 forced devices, inf=1,learn=rest) and scales the host count:
+# each extra host is a whole extra polybeast process composed through
+# the --fleet control plane (wire strategy on forced-CPU hosts).
 CURVE = (
-    ("time_shared", 1, ""),
-    ("time_shared", 2, ""),
-    ("time_shared", 4, ""),
-    ("inference_pinned", 2, "inf=1,learn=1"),
-    ("inference_pinned", 4, "inf=2,learn=2"),
+    ("time_shared", 1, "", 1),
+    ("time_shared", 2, "", 1),
+    ("time_shared", 4, "", 1),
+    ("inference_pinned", 2, "inf=1,learn=1", 1),
+    ("inference_pinned", 4, "inf=2,learn=2", 1),
+    ("fleet", 2, "inf=1,learn=rest", 1),
+    ("fleet", 2, "inf=1,learn=rest", 2),
 )
 
 
-def _provenance(n_devices: int) -> dict:
+def _provenance(n_devices: int, n_hosts: int = 1) -> dict:
     import jax
 
     return {
@@ -70,6 +83,7 @@ def _provenance(n_devices: int) -> dict:
         "topology": {
             "platform": "cpu",
             "device_count": n_devices,
+            "hosts": n_hosts,
             "forced": (
                 f"--xla_force_host_platform_device_count={n_devices}"
             ),
@@ -78,7 +92,8 @@ def _provenance(n_devices: int) -> dict:
     }
 
 
-def run_row(args, family: str, n_devices: int, split_spec: str) -> dict:
+def run_row(args, family: str, n_devices: int, split_spec: str,
+            n_hosts: int = 1) -> dict:
     import tpu_e2e_async
 
     row_args = argparse.Namespace(
@@ -96,11 +111,14 @@ def run_row(args, family: str, n_devices: int, split_spec: str) -> dict:
         timeout_s=args.timeout_s,
         device_split=split_spec,
         xla_device_count=n_devices,
+        # Fleet rows: n_hosts whole polybeast processes, each over its
+        # OWN n_devices forced host devices (tpu_e2e_async --fleet_hosts).
+        fleet_hosts=(n_hosts if n_hosts > 1 else 0),
         # Learner width on the time-shared family tracks the device
         # count so both families consume the same topology.
         num_learner_devices=(n_devices if not split_spec else 1),
     )
-    tag = f"curve-{family}-{n_devices}dev"
+    tag = f"curve-{family}-{n_devices}dev-{n_hosts}host"
     log_path = f"/tmp/tbt_multichip_{tag}.log"
     summary = tpu_e2e_async.run_config(
         row_args, native=False, shm=False, log_path=log_path, tag=tag
@@ -108,8 +126,9 @@ def run_row(args, family: str, n_devices: int, split_spec: str) -> dict:
     row = {
         "family": family,
         "n_devices": n_devices,
+        "n_hosts": n_hosts,
         "device_split": split_spec or None,
-        "provenance": _provenance(n_devices),
+        "provenance": _provenance(n_devices, n_hosts),
     }
     if "error" in summary:
         row["error"] = summary["error"]
@@ -162,17 +181,21 @@ def main():
         args.batch_size = 4
         args.unroll_length = 10
         curve = (
-            ("time_shared", 1, ""),
-            ("inference_pinned", 2, "inf=1,learn=1"),
+            ("time_shared", 1, "", 1),
+            ("inference_pinned", 2, "inf=1,learn=1", 1),
         )
     else:
         curve = CURVE
 
     rows = [run_row(args, *spec) for spec in curve]
 
-    def updates(family, n):
+    def updates(family, n, hosts=1):
         for row in rows:
-            if row["family"] == family and row["n_devices"] == n:
+            if (
+                row["family"] == family
+                and row["n_devices"] == n
+                and row.get("n_hosts", 1) == hosts
+            ):
                 return row.get("updates_per_s")
         return None
 
@@ -180,6 +203,15 @@ def main():
     split2 = updates("inference_pinned", 2)
     ratio = (
         round(split2 / base, 3) if base and split2 else None
+    )
+    # Informational, not gated: forced-CPU hosts share the same cores
+    # AND pay the wire param-sync barrier, so 2 hosts cannot beat 1
+    # here — the row pair pins the overhead the DCN deployment must
+    # beat on real chips.
+    fleet1 = updates("fleet", 2, 1)
+    fleet2 = updates("fleet", 2, 2)
+    fleet_ratio = (
+        round(fleet2 / fleet1, 3) if fleet1 and fleet2 else None
     )
     out = {
         "bench": "dryrun_multichip_scaling",
@@ -197,6 +229,7 @@ def main():
             # predicted on real chips. >= 0.9x guards against the split
             # COSTING throughput.
             "split_2dev_vs_1dev_updates_ratio": ratio,
+            "fleet_2host_vs_1host_updates_ratio": fleet_ratio,
             "required_min_ratio": 0.9,
             "ok": bool(
                 ratio is not None

@@ -49,6 +49,28 @@ LOG_RE = re.compile(
 )
 
 
+def _free_port_pair():
+    """A port P with P+1 also free: the fleet's coord= endpoint needs
+    both (rendezvous at P, control plane at P+1 — fleet/topology.py)."""
+    import socket as socketlib
+
+    for _ in range(50):
+        s1 = socketlib.socket()
+        s2 = socketlib.socket()
+        try:
+            s1.bind(("127.0.0.1", 0))
+            port = s1.getsockname()[1]
+            try:
+                s2.bind(("127.0.0.1", port + 1))
+            except OSError:
+                continue
+            return port
+        finally:
+            s1.close()
+            s2.close()
+    raise RuntimeError("no free adjacent port pair for --fleet coord")
+
+
 def run_config(args, native, shm, log_path, tag):
     """One full polybeast run; returns the summary dict (None SPS rows
     -> error dict)."""
@@ -116,6 +138,30 @@ def run_config(args, native, shm, log_path, tag):
             f"--xla_force_host_platform_device_count={n_forced}"
         ).strip()
         env["JAX_PLATFORMS"] = "cpu"
+    # Multi-host fleet lane (ISSUE 17): N polybeast processes, each a
+    # fleet host over the SAME workload flags, composed through the
+    # coord= control plane. Remotes launch first (they Backoff-dial the
+    # lead), the lead last; rank 0's log/telemetry remain the parsed
+    # "main" run and the remotes' final snapshots ride the summary.
+    fleet_hosts = getattr(args, "fleet_hosts", 0) or 0
+    remote_procs = []  # (rank, Popen, logfile)
+    if fleet_hosts >= 2:
+        coord = f"127.0.0.1:{_free_port_pair()}"
+        base_cmd = list(cmd)
+        cmd = base_cmd + ["--fleet", f"host=0/{fleet_hosts},coord={coord}"]
+        for rank in range(1, fleet_hosts):
+            rcmd = base_cmd + [
+                "--fleet", f"host={rank}/{fleet_hosts},coord={coord}",
+            ]
+            rlogf = open(f"{log_path}.host{rank}", "w")
+            remote_procs.append((
+                rank,
+                subprocess.Popen(
+                    rcmd, env=env, stdout=rlogf, stderr=subprocess.STDOUT,
+                    cwd=_REPO, start_new_session=True,
+                ),
+                rlogf,
+            ))
     # Each leg runs in its own process group and the WHOLE group is
     # killed on timeout: the driver's spawned env-server children
     # otherwise outlive the timeout kill and poison the next leg's
@@ -125,6 +171,7 @@ def run_config(args, native, shm, log_path, tag):
     t0 = time.time()
     timed_out = False
     rc = None
+    remote_rcs = {}
     with open(log_path, "w") as logf:
         proc = subprocess.Popen(
             cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
@@ -132,11 +179,25 @@ def run_config(args, native, shm, log_path, tag):
         )
         try:
             rc = proc.wait(timeout=args.timeout_s)
+            # Remotes finish their own --total_steps around the same
+            # time; a short grace covers their checkpoint/teardown.
+            for rank, rproc, _ in remote_procs:
+                try:
+                    remote_rcs[rank] = rproc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    timed_out = True
         except subprocess.TimeoutExpired:
             # The log up to the kill still holds steady-state telemetry
             # — summarize it rather than dying without the JSON line.
             timed_out = True
         finally:
+            for _, rproc, rlogf in remote_procs:
+                try:
+                    os.killpg(rproc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                rproc.wait()
+                rlogf.close()
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
@@ -178,6 +239,24 @@ def run_config(args, native, shm, log_path, tag):
         os.path.join(savedir, xpid, "telemetry.jsonl")
     )
     final_snap = snaps[-1] if snaps else None
+    # Remote fleet hosts write their own streams at {xpid}-host<r> (the
+    # driver's per-host FileWriter suffix); their final snapshots carry
+    # the wire-delivery evidence (serving.snapshot_version > 0 with no
+    # local publishes, non-zero serving.policy_lag).
+    remote_hosts = None
+    if fleet_hosts >= 2:
+        remote_hosts = {}
+        for rank in range(1, fleet_hosts):
+            rsnaps = telemetry.read_jsonl(
+                os.path.join(savedir, f"{xpid}-host{rank}",
+                             "telemetry.jsonl")
+            )
+            remote_hosts[str(rank)] = {
+                "rc": remote_rcs.get(rank),
+                "telemetry_lines": len(rsnaps),
+                "snapshot": rsnaps[-1] if rsnaps else None,
+                "log": f"{log_path}.host{rank}",
+            }
     acting = final_snap.get("acting_path") if final_snap else None
     # Steady SPS from the snapshot timestamps (learner step delta over
     # wall time, first third discarded as warmup) — the per-tick log SPS
@@ -227,6 +306,7 @@ def run_config(args, native, shm, log_path, tag):
             },
             "native": native,
             "transport": "shm" if shm else "socket",
+            "fleet_hosts": fleet_hosts or None,
         },
         "rc": rc,
         "timed_out": timed_out,
@@ -254,6 +334,9 @@ def run_config(args, native, shm, log_path, tag):
         },
         "telemetry_lines": len(snaps),
         "n_telemetry_rows": len(rows),
+        # Per-remote-host final snapshots (fleet runs only, None
+        # otherwise): the cross-host acceptance evidence.
+        "remote_hosts": remote_hosts,
         "log": log_path,
     }
 
@@ -297,6 +380,13 @@ def main():
                          "Python runtime). Combine with "
                          "--xla_device_count for a forced-host-device "
                          "CPU lane.")
+    ap.add_argument("--fleet_hosts", type=int, default=0,
+                    help="Run N polybeast processes as a multi-host "
+                         "fleet (--fleet host=<r>/N over a free "
+                         "127.0.0.1 coord port; ISSUE 17). Rank 0 is "
+                         "the parsed run; remote hosts' final "
+                         "telemetry snapshots ride the summary under "
+                         "remote_hosts. 0/1 = single process.")
     ap.add_argument("--xla_device_count", type=int, default=0,
                     help="Run the child with JAX_PLATFORMS=cpu and N "
                          "forced host devices (XLA_FLAGS "

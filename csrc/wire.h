@@ -26,6 +26,13 @@ constexpr uint8_t kTagInt = 0x05;
 constexpr uint8_t kTagFloat = 0x06;
 constexpr uint8_t kTagBool = 0x07;
 constexpr uint8_t kTagString = 0x08;
+// Versioned policy snapshot (fleet control plane, ISSUE 17). Python is
+// the only publisher (fleet/snapshot_wire.py builds wire.PolicySnapshot
+// messages); the C++ side decodes the frame into a reserved-key dict —
+// {"__snapshot__": version, "params": nest, "dtypes": nest} — so a
+// native observer on a control-plane socket never trips "unknown tag"
+// on fleet traffic. Mirrors wire.py TAG_SNAPSHOT (WIRE-PARITY).
+constexpr uint8_t kTagSnapshot = 0x09;
 
 class WireError : public std::runtime_error {
  public:
@@ -273,6 +280,20 @@ inline ValueNest decode_value(detail::Reader* r) {
         std::string key(reinterpret_cast<const char*>(p), klen);
         out.emplace(std::move(key), decode_value(r));
       }
+      return ValueNest(std::move(out));
+    }
+    case kTagSnapshot: {
+      // u64le version + params value + dtypes value (wire.py layout).
+      // Reuse the i64 reader: snapshot versions are update counts and
+      // never approach the sign bit.
+      int64_t version = r->i64();
+      if (version < 0) throw WireError("wire: negative snapshot version");
+      ValueNest params = decode_value(r);
+      ValueNest dtypes = decode_value(r);
+      ValueNest::Dict out;
+      out.emplace("__snapshot__", ValueNest(Value::of_int(version)));
+      out.emplace("params", std::move(params));
+      out.emplace("dtypes", std::move(dtypes));
       return ValueNest(std::move(out));
     }
     default:

@@ -168,6 +168,27 @@ def parse_args(argv=None):
                    help="FaultPlan seed + --env_seed for both runs.")
     p.add_argument("--return_tol", type=float, default=0.2,
                    help="Allowed |chaos - baseline| final-return gap.")
+    # Multi-host fleet lane (ISSUE 17): --hosts 2 runs ONE fleet
+    # (in-process lead + subprocess remote) instead of the
+    # baseline/chaos pair, SIGKILLs the remote's whole env-server
+    # fleet mid-run, and asserts the remote's exact reconnect
+    # accounting plus the STICKY fleet.host1 degradation folded on the
+    # surviving lead.
+    p.add_argument("--hosts", type=int, default=1,
+                   help="2 = the fleet chaos lane (lead in-process, "
+                        "host 1 a polybeast subprocess joined via "
+                        "--fleet over a free loopback port). 1 = the "
+                        "classic baseline/chaos pair.")
+    p.add_argument("--fleet", default=None,
+                   help="Declared for driver parity and rejected when "
+                        "set: the harness composes the fleet spec "
+                        "itself from --hosts.")
+    p.add_argument("--min_live_hosts", type=int, default=1,
+                   help="Fleet degradation floor (--fleet runs): "
+                        "losing a host marks the fleet DEGRADED "
+                        "(sticky fleet.host<r>_lost) while at "
+                        "least this many hosts stay live; "
+                        "forwarded to both fleet hosts.")
     # beastlint: disable=FLAG-PARITY  None means "fresh temp dir per run": chaos artifacts must never land in the training logdir
     p.add_argument("--savedir", default=None,
                    help="Default: a fresh temp dir.")
@@ -210,9 +231,8 @@ def build_plan(args) -> dict:
     return {"seed": args.seed, "faults": faults}
 
 
-def make_flags(args, savedir, xpid, chaos_plan_path=None):
-    from torchbeast_tpu import polybeast
-
+def make_argv(args, savedir, xpid, chaos_plan_path=None,
+              fleet_spec=None):
     argv = [
         "--env", args.env,
         "--model", "mlp",
@@ -251,7 +271,19 @@ def make_flags(args, savedir, xpid, chaos_plan_path=None):
         argv += ["--no_native_runtime"]
     if chaos_plan_path is not None:
         argv += ["--chaos_plan", chaos_plan_path]
-    return polybeast.make_parser().parse_args(argv)
+    if fleet_spec is not None:
+        argv += ["--fleet", fleet_spec,
+                 "--min_live_hosts", str(args.min_live_hosts)]
+    return argv
+
+
+def make_flags(args, savedir, xpid, chaos_plan_path=None,
+               fleet_spec=None):
+    from torchbeast_tpu import polybeast
+
+    return polybeast.make_parser().parse_args(
+        make_argv(args, savedir, xpid, chaos_plan_path, fleet_spec)
+    )
 
 
 def final_return(savedir, xpid):
@@ -280,7 +312,7 @@ def _live_children():
     return {p.pid for p in mp.active_children() if p.is_alive()}
 
 
-def run_one(args, savedir, xpid, chaos_plan_path=None):
+def run_one(args, savedir, xpid, chaos_plan_path=None, fleet_spec=None):
     """One polybeast run with leak accounting and a counter delta."""
     from torchbeast_tpu import polybeast, telemetry
 
@@ -288,7 +320,7 @@ def run_one(args, savedir, xpid, chaos_plan_path=None):
     procs_before = _live_children()
     snap_before = telemetry.snapshot()
     t0 = time.monotonic()
-    flags = make_flags(args, savedir, xpid, chaos_plan_path)
+    flags = make_flags(args, savedir, xpid, chaos_plan_path, fleet_spec)
     stats = polybeast.train(flags)
     elapsed = time.monotonic() - t0
     counters = telemetry.delta(telemetry.snapshot(), snap_before).get(
@@ -303,11 +335,201 @@ def run_one(args, savedir, xpid, chaos_plan_path=None):
         "server_restarts": stats.get("server_restarts", 0),
         "actor_reconnects": stats.get("actor_reconnects", 0),
         "inference_restarts": stats.get("inference_restarts", 0),
+        "health_reasons": stats.get("health_reasons"),
         "chaos": stats.get("chaos"),
         "counters": counters,
         "leaked_processes": sorted(_live_children() - procs_before),
         "leaked_shm": sorted(_shm_entries() - shm_before),
     }
+
+
+def _free_coord_port():
+    """A loopback port P with P+1 also free (rendezvous + control
+    plane, fleet/topology.py CONTROL_PORT_OFFSET)."""
+    import socket as socketlib
+
+    for _ in range(50):
+        s1 = socketlib.socket()
+        s2 = socketlib.socket()
+        try:
+            s1.bind(("127.0.0.1", 0))
+            port = s1.getsockname()[1]
+            try:
+                s2.bind(("127.0.0.1", port + 1))
+            except OSError:
+                continue
+            return port
+        finally:
+            s1.close()
+            s2.close()
+    raise RuntimeError("no free adjacent port pair for --fleet coord")
+
+
+def build_fleet_plan(args) -> dict:
+    """The remote host's plan: SIGKILL its ENTIRE env-server fleet,
+    staggered across [0.15, 0.55] of the run — one whole host's
+    serving substrate churns while the lead host rides through
+    untouched. Each kill maps to exactly actors-per-server reconnects
+    on THAT host (the same accounting rule as the single-host plan)."""
+    t, n = args.total_steps, args.num_servers
+    faults = [
+        {
+            "kind": "env_server_sigkill",
+            "at_step": int(t * (0.15 + 0.4 * i / n)),
+            "target": i,
+        }
+        for i in range(n)
+    ]
+    return {"seed": args.seed, "faults": faults}
+
+
+def run_fleet(args, savedir) -> int:
+    """--hosts 2 lane (ISSUE 17): one fleet run — in-process lead +
+    subprocess remote joined over a free loopback coord port — with the
+    remote's whole env-server fleet SIGKILLed mid-run. Asserts the
+    remote recovered with EXACT accounting, the lead folded a STICKY
+    fleet.host1 degradation, and nobody halted."""
+    import signal
+    import subprocess
+
+    from torchbeast_tpu import telemetry
+    from torchbeast_tpu.resilience.chaos import FaultPlan
+
+    xpid = "chaos-fleet"
+    n_hosts = args.hosts
+    plan_dict = build_fleet_plan(args)
+    plan = FaultPlan.from_dict(plan_dict)
+    plan_path = os.path.join(savedir, "fault_plan_host1.json")
+    with open(plan_path, "w") as f:
+        json.dump(plan_dict, f, indent=2)
+
+    coord = f"127.0.0.1:{_free_coord_port()}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Remote host 1 launches first (it Backoff-dials the lead's control
+    # plane) and carries the fault plan; its own process group so a
+    # timeout kill also reaps its env-server children.
+    remote_log = os.path.join(savedir, "host1.log")
+    remote_argv = make_argv(
+        args, savedir, xpid, plan_path,
+        fleet_spec=f"host=1/{n_hosts},coord={coord}",
+    )
+    with open(remote_log, "w") as logf:
+        remote = subprocess.Popen(
+            [sys.executable, "-m", "torchbeast_tpu.polybeast"]
+            + remote_argv,
+            env=env, stdout=logf, stderr=subprocess.STDOUT, cwd=repo,
+            start_new_session=True,
+        )
+        try:
+            lead = run_one(
+                args, savedir, xpid,
+                fleet_spec=f"host=0/{n_hosts},coord={coord}",
+            )
+            try:
+                remote_rc = remote.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                remote_rc = None  # killed below; fails the rc check
+        finally:
+            try:
+                os.killpg(remote.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            remote.wait()
+
+    remote_snaps = telemetry.read_jsonl(
+        os.path.join(savedir, f"{xpid}-host1", "telemetry.jsonl")
+    )
+    remote_snap = remote_snaps[-1] if remote_snaps else {}
+    remote_counters = remote_snap.get("counters", {})
+
+    failures = []
+    # -- completion on BOTH hosts (degraded, never halted) ----------------
+    if lead["step"] < args.total_steps:
+        failures.append(
+            f"lead stopped at step {lead['step']} < {args.total_steps} "
+            f"(health {lead['health']})"
+        )
+    if lead["health"] == "HALTED":
+        failures.append("lead ended HALTED (floor is 1: the surviving "
+                        "host must degrade, not abort)")
+    if remote_rc != 0:
+        failures.append(f"remote host exited rc={remote_rc} "
+                        f"(log {remote_log})")
+    # -- remote host identity on its telemetry stream ---------------------
+    if remote_snap.get("host_rank") != 1:
+        failures.append(
+            f"remote host_rank static: got {remote_snap.get('host_rank')}"
+            ", want 1"
+        )
+    if remote_snap.get("fleet_size") != n_hosts:
+        failures.append(
+            f"remote fleet_size static: got "
+            f"{remote_snap.get('fleet_size')}, want {n_hosts}"
+        )
+    # -- exact recovery accounting on the faulted host --------------------
+    n_kill = plan.counts().get("env_server_sigkill", 0)
+    actors_per_server = args.num_actors // args.num_servers
+    expected = {
+        "chaos.env_server_sigkill.injected": n_kill,
+        "recovery.server_restarts": n_kill,
+        "recovery.actor_reconnects": n_kill * actors_per_server,
+    }
+    for name, want in expected.items():
+        got = int(remote_counters.get(name, 0))
+        if got != want:
+            failures.append(
+                f"remote counter {name}: got {got}, want {want}"
+            )
+    # -- the lead folded the incident as a STICKY degradation -------------
+    reasons = lead.get("health_reasons") or []
+    if not any(r.startswith("fleet.host1") for _, r in reasons):
+        failures.append(
+            "no fleet.host1 degradation folded on the lead "
+            f"(reasons: {reasons})"
+        )
+    if lead["health"] != "DEGRADED":
+        failures.append(
+            f"lead health {lead['health']}: the remote's recovered "
+            "SIGKILLs must leave a sticky DEGRADED mark"
+        )
+
+    verdict = {
+        "bench": "chaos_run",
+        "selftest": bool(args.selftest),
+        "native": bool(args.native),
+        "hosts": n_hosts,
+        "scale": args.scale,
+        "num_actors": args.num_actors,
+        "num_servers": args.num_servers,
+        "ok": not failures,
+        "failures": failures,
+        "env": args.env,
+        "total_steps": args.total_steps,
+        "plan": plan_dict,
+        "expected_counters": expected,
+        "results": {
+            "lead": lead,
+            "remote": {
+                "rc": remote_rc,
+                "telemetry_lines": len(remote_snaps),
+                "counters": {
+                    k: v for k, v in remote_counters.items()
+                    if k.startswith(("chaos.", "recovery.", "fleet."))
+                },
+                "log": remote_log,
+            },
+        },
+        "telemetry": telemetry.telemetry_block(),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+            f.write("\n")
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -327,6 +549,24 @@ def main(argv=None) -> int:
 
     if args.scale < 1:
         print("--scale must be >= 1", file=sys.stderr)
+        return 2
+    if args.fleet:
+        print(
+            "--fleet is composed internally from --hosts; do not set "
+            "it on the harness",
+            file=sys.stderr,
+        )
+        return 2
+    if args.hosts not in (1, 2):
+        print("--hosts must be 1 or 2 (the fleet lane pins one remote "
+              "host)", file=sys.stderr)
+        return 2
+    if args.hosts > 1 and args.batch_size % args.hosts != 0:
+        print(
+            f"--batch_size {args.batch_size} (global) must be "
+            f"divisible by --hosts {args.hosts}",
+            file=sys.stderr,
+        )
         return 2
     # The scale knob multiplies the fleet AND the plan together.
     args.num_servers *= args.scale
@@ -389,6 +629,9 @@ def main(argv=None) -> int:
         import tempfile
 
         savedir = tempfile.mkdtemp(prefix="chaos_run_")
+    if args.hosts >= 2:
+        return run_fleet(args, savedir)
+
     plan_dict = build_plan(args)
     plan = FaultPlan.from_dict(plan_dict)  # validates kinds/triggers
     plan_path = os.path.join(savedir, "fault_plan.json")

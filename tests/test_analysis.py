@@ -600,7 +600,8 @@ class TestWireParity:
         found = _rules(report, "WIRE-PARITY")
         assert not found, [f.render() for f in found]
         # And the parse actually saw the full table (13 dtypes incl.
-        # bfloat16=12), not an empty dict vacuously matching.
+        # bfloat16=12; 9 tags incl. SNAPSHOT=9), not an empty dict
+        # vacuously matching.
         from torchbeast_tpu.analysis.parity import parse_py_wire
 
         ctx = analysis.load_context(
@@ -609,7 +610,8 @@ class TestWireParity:
         tags, max_frame, codes = parse_py_wire(ctx.tree)
         assert codes.get("bfloat16") == 12 and len(codes) == 13
         assert max_frame == 256 * 1024 * 1024
-        assert tags["ARRAY"] == 1 and len(tags) == 8
+        assert tags["ARRAY"] == 1 and tags["SNAPSHOT"] == 9
+        assert len(tags) == 9
 
 
 class TestRingParity:

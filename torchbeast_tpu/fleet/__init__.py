@@ -1,0 +1,36 @@
+"""Multi-host fleet coordination (ISSUE 17, ROADMAP item 1).
+
+The Podracer paper's Sebulba is a whole-pod design: every host runs its
+own env servers, actors, and pinned inference slices, and the learner's
+data-parallel axis spans the pod — DP across hosts over DCN, ICI within
+a host. This package composes the single-host pieces that already exist
+(runtime/placement.py device splits, parallel/dp.py DP learner,
+serving/snapshot.py versioned policy snapshots, resilience/supervisor.py
+health) into one fleet:
+
+- `topology`    — jax-free `FleetSpec` (`--fleet host=<rank>/<n>,
+                  coord=<addr>`), per-host split composition, and the
+                  static actor -> (host, slice) assignment.
+- `coordinator` — rendezvous (bounded-retry via resilience.Backoff),
+                  the cross-host health plane (per-host state folded
+                  into one fleet verdict through PipelineHealth), the
+                  DCN parameter composition for the wire DP strategy,
+                  and policy-snapshot publication to remote hosts.
+- `snapshot_wire` — the versioned-bf16 snapshot message helpers riding
+                  the TAG_SNAPSHOT wire class (runtime/wire.py +
+                  csrc/wire.h, WIRE-PARITY-pinned).
+"""
+
+from torchbeast_tpu.fleet.topology import (  # noqa: F401
+    FleetSpec,
+    compose_fleet_mesh_devices,
+    parse_fleet_spec,
+)
+from torchbeast_tpu.fleet.coordinator import (  # noqa: F401
+    FleetCoordinator,
+    fleet_rendezvous,
+)
+from torchbeast_tpu.fleet.snapshot_wire import (  # noqa: F401
+    apply_snapshot,
+    build_snapshot,
+)

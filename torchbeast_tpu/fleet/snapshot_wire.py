@@ -1,0 +1,134 @@
+"""Policy snapshots over the wire (ISSUE 17 tentpole, part 3).
+
+The single-host Sebulba already publishes versioned bf16 snapshots into
+a `PolicySnapshotStore` and replicas serve from `latest_on(device)`
+(serving/snapshot.py, ISSUE 14). A fleet has inference slices on hosts
+the learner never touches — those stores must be fed over DCN. This
+module is the bridge: `build_snapshot` turns the lead's live param tree
+into a `wire.PolicySnapshot` message (the TAG_SNAPSHOT class shared by
+runtime/wire.py and csrc/wire.h, WIRE-PARITY-pinned), and
+`apply_snapshot` feeds a received one into a remote host's store.
+
+The payload carries FLATTENED leaves (jax.tree_util order), not the
+tree: the wire codec canonicalizes tuples to lists, so round-tripping a
+structured tree could silently change its pytree type. Every host builds
+the identical model from the identical seed, so the receiver unflattens
+against its own param template — structure never crosses the wire, only
+leaves and dtype names.
+
+Bit-exactness is the invariant the tests pin (tests/test_shm_transport
+style): the wire carries the SAME bf16 leaves `serving.snapshot.bf16_cast`
+would publish locally, plus the original dtype names. On the remote,
+the restore (bf16 -> original dtype) then the store's own publish cast
+(original dtype -> bf16) round-trip every value exactly — bf16 is a
+subset of every wider float — so `latest_on` on a remote slice serves
+bit-identical bytes to a local replica at the same version.
+
+Version skew: wire delivery is asynchronous and a slow control plane can
+deliver snapshots out of order or re-deliver after a local catch-up. A
+stale publish (version <= the store's current snapshot) is REJECTED —
+counted and dropped — never applied; policy versions on a serving host
+move strictly forward.
+"""
+
+import logging
+from typing import Any
+
+import numpy as np
+
+from torchbeast_tpu.runtime.wire import PolicySnapshot, WireError
+from torchbeast_tpu.serving.snapshot import PolicySnapshotStore, bf16_cast
+
+log = logging.getLogger(__name__)
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Dtype-name string -> numpy dtype. bfloat16 (and friends) need
+    ml_dtypes to exist as numpy dtypes — same extension wire.py's array
+    codec uses, so it is present wherever TAG_SNAPSHOT decodes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise WireError(
+                f"snapshot carries unknown dtype {name!r}"
+            ) from None
+
+
+def build_snapshot(version: int, params: Any) -> PolicySnapshot:
+    """Lead side: live param tree -> wire message.
+
+    Applies THE publication cast (serving.snapshot.bf16_cast — the same
+    function the local store's publish uses), pulls the bf16 leaves to
+    host numpy (the only host copy in the chain; the wire encoder
+    scatter-gathers straight from these buffers), and flattens: the
+    message is `[leaf...]` + `[dtype name...]` in jax.tree_util order.
+    """
+    import jax
+
+    bf16, dtypes = bf16_cast(params)
+    leaves = [np.asarray(a) for a in jax.tree_util.tree_leaves(bf16)]
+    names = [
+        np.dtype(dt).name for dt in jax.tree_util.tree_leaves(dtypes)
+    ]
+    return PolicySnapshot(int(version), leaves, names)
+
+
+def apply_snapshot(
+    store: PolicySnapshotStore,
+    snap: PolicySnapshot,
+    template: Any,
+    stale_counter=None,
+) -> bool:
+    """Remote side: feed a wire-delivered snapshot into the local store.
+
+    `template` is any tree with the model's param structure (the host's
+    own initial params — identical across the fleet by construction);
+    the flat wire leaves are restored to their recorded dtypes and
+    unflattened against it. Returns True when the snapshot was
+    published; False when it was rejected as stale (snap.version <= the
+    store's current version — counted on `stale_counter` when given).
+
+    Decoded wire arrays are zero-copy views into the transport's
+    receive buffer; the device upload here copies them out, so the
+    store never aliases transport memory — but callers must still apply
+    before their next recv on the same transport, per the buffer-reuse
+    lifetime rule.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(snap, PolicySnapshot):
+        raise WireError(
+            f"apply_snapshot needs a PolicySnapshot, "
+            f"got {type(snap).__name__}"
+        )
+    if snap.version <= store.version:
+        if stale_counter is not None:
+            stale_counter.inc()
+        log.warning(
+            "Dropping stale policy snapshot v%d (store at v%d)",
+            snap.version, store.version,
+        )
+        return False
+    treedef = jax.tree_util.tree_structure(template)
+    if len(snap.params) != treedef.num_leaves or (
+        len(snap.dtypes) != treedef.num_leaves
+    ):
+        raise WireError(
+            f"snapshot v{snap.version} carries {len(snap.params)} leaves "
+            f"/ {len(snap.dtypes)} dtypes for a {treedef.num_leaves}-leaf "
+            "param template (model mismatch across the fleet?)"
+        )
+    restored = jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            jnp.asarray(np.asarray(a)).astype(_dtype_from_name(name))
+            for a, name in zip(snap.params, snap.dtypes)
+        ],
+    )
+    return store.publish(snap.version, restored)

@@ -200,6 +200,18 @@ def make_parser():
                              "warning. The full per-slice serving "
                              "split (pinned slot tables, snapshot "
                              "publication) lives in the async driver.")
+    parser.add_argument("--fleet", default=None,
+                        help="Multi-host Sebulba fleet membership "
+                             "(fleet/topology.py): 'host=<rank>/<n>,"
+                             "coord=<host:port>'. The sync trainer is "
+                             "single-host by design — the flag is "
+                             "declared for driver parity and rejected "
+                             "when set; fleet runs live in the async "
+                             "driver (polybeast --fleet).")
+    parser.add_argument("--min_live_hosts", type=int, default=1,
+                        help="Fleet degradation floor (--fleet runs; "
+                             "async driver). Declared for driver "
+                             "parity; no effect in the sync trainer.")
     parser.add_argument("--transformer_remat", action="store_true",
                         help="DEPRECATED spelling of --remat with the "
                              "transformer blocks stage at 'all' "
@@ -762,6 +774,11 @@ def train(flags):
     superstep_k = getattr(flags, "superstep_k", 1)
     if superstep_k < 1:
         raise ValueError(f"--superstep_k must be >= 1, got {superstep_k}")
+    if getattr(flags, "fleet", None):
+        raise ValueError(
+            "--fleet needs the async driver (polybeast): the sync "
+            "trainer is single-host by design"
+        )
     if (flags.num_actors // flags.batch_size) % superstep_k != 0:
         # Each collect's sub-batches must split into whole supersteps —
         # a fixed-K scan cannot consume a partial group, and carrying

@@ -56,6 +56,33 @@ def initialize_distributed(
     )
 
 
+def fleet_strategy(backend: Optional[str] = None) -> str:
+    """How a multi-host fleet composes its learner (ISSUE 17).
+
+    "xla": the backend executes cross-process computations — TPU (DCN
+    collectives) and GPU (NCCL). jax.distributed rendezvous, one global
+    mesh whose `data` axis spans hosts, `make_parallel_update_step`
+    compiles over it unchanged, `shard_batch` takes its
+    make_array_from_process_local_data branch.
+
+    "wire": CPU — XLA has no multiprocess CPU runtime (a jitted
+    computation over a cross-host mesh fails at dispatch with
+    "Multiprocess computations aren't implemented on the CPU backend"),
+    so jax.distributed is never initialized; each host compiles over
+    its LOCAL learner devices and the fleet coordinator's control plane
+    composes parameters by synchronous averaging
+    (fleet.FleetCoordinator.sync_params). This is the CI strategy: it
+    exercises every fleet control surface (rendezvous, health folding,
+    snapshot wire, telemetry) on forced-CPU hosts.
+
+    Selection is by BACKEND, not a runtime probe: probing would require
+    an irreversible jax.distributed.initialize before knowing whether
+    the backend can use it.
+    """
+    backend = backend if backend is not None else jax.default_backend()
+    return "xla" if backend in ("tpu", "gpu") else "wire"
+
+
 def make_parallel_update_step(
     model, optimizer, hp: learner_lib.HParams, mesh, donate=True,
     param_shardings: Optional[Any] = None,

@@ -216,6 +216,24 @@ class SebulbaServing:
         return tick
 
 
+def slice_gauge_snapshot(registry=None, prefix: str = "inference.slice."):
+    """{name: value} of the per-slice serving instruments — the fleet
+    heartbeat payload (fleet/coordinator.py `set_gauges_source`): a
+    remote host ships its `inference.slice.<i>.*` gauges and counters
+    to the lead every heartbeat, where NativeTelemetryFolder re-exports
+    them as `host<r>.inference.slice.<i>.*`. Histograms are skipped —
+    heartbeats carry scalars, not bucket dicts."""
+    reg = registry if registry is not None else telemetry.get_registry()
+    out = {}
+    for name, inst in reg.instruments().items():
+        if not name.startswith(prefix):
+            continue
+        value = getattr(inst, "value", None)
+        if callable(value):  # Counter / Gauge; Histogram has no value()
+            out[name] = float(value())
+    return out
+
+
 def build_sebulba_serving(
     split: DeviceSplit,
     store,

@@ -315,6 +315,37 @@ def make_parser():
                              "(host:port); also reads "
                              "TORCHBEAST_COORDINATOR / _NUM_PROCESSES / "
                              "_PROCESS_ID env vars.")
+    parser.add_argument("--fleet", default=None,
+                        help="Multi-host Sebulba fleet membership "
+                             "(fleet/topology.py): 'host=<rank>/<n>,"
+                             "coord=<host:port>' names this host's "
+                             "rank, the fleet size, and the shared "
+                             "coordination endpoint (jax.distributed "
+                             "rendezvous on TPU/GPU; port+1 carries "
+                             "the fleet control plane — health "
+                             "heartbeats, policy snapshots, param "
+                             "sync — on every backend). Composes with "
+                             "--device_split: each host pins its OWN "
+                             "inference slices and the learner's data "
+                             "axis spans every host's learner devices "
+                             "over DCN; forced-CPU hosts compose by "
+                             "synchronous parameter averaging instead "
+                             "(the CI strategy — parallel/dp.py "
+                             "fleet_strategy). Remote hosts' slices "
+                             "serve versioned bf16 snapshots the lead "
+                             "publishes over the wire (TAG_SNAPSHOT). "
+                             "Unset = single-host, today's paths "
+                             "unchanged.")
+    parser.add_argument("--min_live_hosts", type=int, default=1,
+                        help="Fleet degradation floor (--fleet runs): "
+                             "losing a host marks the fleet DEGRADED "
+                             "(sticky fleet.host<r>_lost) while at "
+                             "least this many hosts stay live; "
+                             "crossing below it halts the WHOLE fleet "
+                             "cleanly (checkpoint-and-exit on every "
+                             "host, via the broadcast verdict) instead "
+                             "of wedging the survivors' param-"
+                             "composition plane.")
     parser.add_argument("--device_agent_state", dest="device_agent_state",
                         action="store_true", default=True,
                         help="Keep recurrent agent state in a device-"
@@ -487,13 +518,67 @@ def train(flags):
         raise ValueError(
             f"--superstep_k must be >= 1, got {superstep_k}"
         )
-    # No-ops (with a log line) when no coordinator is configured by flag
-    # or TORCHBEAST_COORDINATOR env.
-    initialize_distributed(flags.coordinator_address)
+    # Fleet membership (ISSUE 17, fleet/): parsed BEFORE any side
+    # effects. "xla" strategy (TPU/GPU) brings up jax.distributed under
+    # a bounded-retry Backoff; "wire" (forced-CPU CI) composes
+    # independent per-host runtimes over the control plane instead and
+    # never initializes jax.distributed.
+    from torchbeast_tpu.fleet import (
+        FleetCoordinator,
+        compose_fleet_mesh_devices,
+        fleet_rendezvous,
+        parse_fleet_spec,
+    )
+    from torchbeast_tpu.parallel.dp import fleet_strategy
+
+    fleet = parse_fleet_spec(getattr(flags, "fleet", None))
+    strategy = None
+    if fleet is not None:
+        if flags.coordinator_address:
+            raise ValueError(
+                "--fleet and --coordinator_address are exclusive: the "
+                "fleet's coord= endpoint IS the rendezvous address"
+            )
+        strategy = fleet_strategy()
+        fleet_rendezvous(fleet, strategy)
+    else:
+        # No-ops (with a log line) when no coordinator is configured by
+        # flag or TORCHBEAST_COORDINATOR env.
+        initialize_distributed(flags.coordinator_address)
     proc_count = jax.process_count()
     proc_id = jax.process_index()
-    is_lead = proc_id == 0
-    if proc_count > 1:
+    # ONE host identity for every host-scoped convention below (xpid
+    # suffix, pipe namespaces, env-seed streams, acting rng): the fleet
+    # rank when --fleet names one, else the jax process index. They
+    # coincide under the xla strategy; the wire strategy keeps
+    # proc_count == 1 while the fleet spans n_hosts runtimes.
+    n_hosts = fleet.num_hosts if fleet is not None else proc_count
+    host_rank = fleet.host_rank if fleet is not None else proc_id
+    is_lead = host_rank == 0
+    if fleet is not None and fleet.num_hosts > 1:
+        if flags.xpid is None:
+            raise ValueError(
+                "multi-host runs need an explicit --xpid (the timestamp "
+                "default would differ per host and break checkpoint "
+                "resume)"
+            )
+        if flags.batch_size % fleet.num_hosts != 0:
+            raise ValueError(
+                f"--batch_size {flags.batch_size} (global) must be "
+                f"divisible by the fleet's {fleet.num_hosts} hosts"
+            )
+        if (
+            getattr(flags, "expert_parallel", 0) > 1
+            or flags.sequence_parallel > 1
+            or getattr(flags, "tensor_parallel", 0) > 1
+            or getattr(flags, "pipeline_parallel", 0) > 1
+        ):
+            raise ValueError(
+                "--fleet composes a data-only learner mesh; it does "
+                "not compose with --expert_parallel/--sequence_"
+                "parallel/--tensor_parallel/--pipeline_parallel yet"
+            )
+    elif proc_count > 1:
         # Multi-host topology (the reference's per-machine deployment,
         # polybeast_learner.py:436-444): every host runs its own env
         # servers + actors + inference, all hosts run the SAME number of
@@ -526,7 +611,7 @@ def train(flags):
                 f"--batch_size {flags.batch_size} (global) must be "
                 f"divisible by the {proc_count} processes"
             )
-    local_rows = flags.batch_size // proc_count
+    local_rows = flags.batch_size // n_hosts
     # Sebulba device split (ISSUE 15, runtime/placement.py): resolved —
     # and its composition rules rejected — BEFORE any side effects
     # (FileWriter dir, server spawns). None = time-shared path, incl.
@@ -536,20 +621,33 @@ def train(flags):
         validate_split_composition,
     )
 
-    split = resolve_device_split(
-        getattr(flags, "device_split", ""), jax.devices()
-    )
+    fleet_learner_devices = None
+    if fleet is not None and strategy == "xla":
+        # xla-strategy fleet: each host resolves its OWN split over its
+        # local devices, and the global learner group (host-major) is
+        # what the DCN-spanning mesh compiles over.
+        split, fleet_learner_devices = compose_fleet_mesh_devices(
+            fleet, getattr(flags, "device_split", ""), jax.devices()
+        )
+    else:
+        # Single-host and wire-strategy fleets: jax.devices() IS the
+        # local device group (the wire strategy never initializes
+        # jax.distributed), so the plain resolve is the per-host split.
+        split = resolve_device_split(
+            getattr(flags, "device_split", ""), jax.devices()
+        )
     validate_split_composition(
         flags, split,
         parallel_flags=("expert_parallel", "sequence_parallel",
                         "pipeline_parallel", "tensor_parallel"),
     )
     if split is not None:
-        if proc_count > 1:
+        if proc_count > 1 and fleet is None:
             raise ValueError(
-                "--device_split is single-host today (the multi-host "
-                "Sebulba composes the split per host over DCN — a "
-                "follow-up; see ROADMAP)"
+                "--device_split with bare --coordinator_address "
+                "multi-host is not supported: use --fleet host=<rank>/"
+                "<n>,coord=<addr> — the fleet plane composes the split "
+                "per host over DCN (fleet/topology.py)"
             )
     if getattr(flags, "admission_depth_factor", 4) < 1:
         # Pure flag predicate — rejected BEFORE any side effects, like
@@ -562,7 +660,7 @@ def train(flags):
     if flags.xpid is None:
         flags.xpid = "polybeast-tpu-%s" % time.strftime("%Y%m%d-%H%M%S")
     plogger = FileWriter(
-        xpid=flags.xpid if is_lead else f"{flags.xpid}-host{proc_id}",
+        xpid=flags.xpid if is_lead else f"{flags.xpid}-host{host_rank}",
         xp_args=vars(flags), rootdir=flags.savedir,
     )
     # Telemetry (ISSUE 2): one process-wide registry every runtime
@@ -574,6 +672,15 @@ def train(flags):
     )
     telemetry_on = tele.enabled
     reg = tele.registry
+    # Host identity on EVERY telemetry line (single-host runs stamp
+    # host_rank=0 / fleet_size=1): multi-host analyses join the
+    # per-host telemetry.jsonl files on these two statics.
+    tele.set_static("host_rank", host_rank)
+    tele.set_static("fleet_size", n_hosts)
+    if fleet is not None:
+        tele.set_static(
+            "fleet", dict(fleet.describe(), strategy=strategy)
+        )
     # Pipeline health (ISSUE 6): HEALTHY/DEGRADED/HALTED as the
     # `health.state` gauge. Actor attrition degrades the run until the
     # --min_live_actors floor; a halt (floor crossed, or the inference
@@ -593,6 +700,20 @@ def train(flags):
         chaos = ChaosController(
             FaultPlan.from_json(flags.chaos_plan), registry=reg
         )
+    # Fleet control plane (fleet/coordinator.py): heartbeats + health
+    # folding, the TAG_SNAPSHOT publication path, and (wire strategy)
+    # the param-composition rounds. start() blocks until every host is
+    # connected — BEFORE server spawns, so a host that cannot join
+    # fails without leaking processes. A 1-host fleet degrades to
+    # today's single-host path (no control plane to run).
+    fleet_coord = None
+    if fleet is not None and fleet.num_hosts > 1:
+        fleet_coord = FleetCoordinator(
+            fleet, health, strategy,
+            min_live_hosts=getattr(flags, "min_live_hosts", 1),
+            registry=reg,
+        )
+        fleet_coord.start()
     # All hosts resume from the LEAD's checkpoint (shared filesystem, as
     # with the reference's savedir convention).
     checkpoint_path = os.path.join(
@@ -600,7 +721,7 @@ def train(flags):
     )
 
     pipes_basename = polybeast_env.host_scoped_basename(
-        flags.pipes_basename, proc_id, flags.num_servers
+        flags.pipes_basename, host_rank, flags.num_servers
     )
     num_actors = flags.num_actors or flags.num_servers
     addresses = [
@@ -623,7 +744,7 @@ def train(flags):
                 # Per-host offset past every seed server i on one host
                 # can derive (i*1000 + stream): hosts share --env_seed
                 # but never a stream.
-                env_seed += proc_id * flags.num_servers * 1000
+                env_seed += host_rank * flags.num_servers * 1000
             server_supervisor = polybeast_env.ServerSupervisor(
                 flags, pipes_basename=pipes_basename, env_seed=env_seed,
                 max_restarts=getattr(flags, "max_server_restarts", 10),
@@ -674,7 +795,17 @@ def train(flags):
         pipe_par = getattr(flags, "pipeline_parallel", 0)
         learner_mesh = None
         learner_device = None
-        if split is not None:
+        if fleet_learner_devices is not None:
+            # xla-strategy fleet: ONE mesh whose data axis runs
+            # host-major over every host's learner devices — ICI within
+            # a host, DCN between them. (num_hosts >= 2 makes a
+            # single-device fleet group impossible.)
+            from torchbeast_tpu.parallel import create_mesh
+
+            learner_mesh = create_mesh(
+                devices=list(fleet_learner_devices)
+            )
+        elif split is not None:
             if len(split.learner_devices) > 1:
                 # The split's learner mesh: plain DP over exactly the
                 # learner devices (data=N, model=1).
@@ -727,11 +858,18 @@ def train(flags):
         # convention as acting_path): {"data": N, "model": 1, ...} for
         # meshed learners, the 1x1 placeholder for the single-device
         # update step.
-        tele.set_static(
-            "learner.mesh_shape",
+        mesh_shape = (
             {k: int(v) for k, v in learner_mesh.shape.items()}
-            if learner_mesh is not None else {"data": 1, "model": 1},
+            if learner_mesh is not None else {"data": 1, "model": 1}
         )
+        if fleet is not None and strategy == "wire" and n_hosts > 1:
+            # Wire-strategy fleets compose DP across hosts OUTSIDE the
+            # mesh (synchronous param averaging over the control
+            # plane), so the LOGICAL data width the fleet trains at is
+            # per-host width x hosts — what the xla strategy's one
+            # global mesh would report.
+            mesh_shape["data"] *= n_hosts
+        tele.set_static("learner.mesh_shape", mesh_shape)
         if (
             getattr(flags, "opt_impl", "xla") == "pallas"
             and learner_mesh is not None
@@ -792,7 +930,17 @@ def train(flags):
             )
 
             data_size = int(learner_mesh.shape["data"])
-            if flags.batch_size % data_size != 0:
+            if fleet is not None and strategy == "wire":
+                # The wire strategy's mesh is host-local: the rows it
+                # shards per dispatch are this host's local_rows, not
+                # the fleet-global batch.
+                if local_rows % data_size != 0:
+                    raise ValueError(
+                        f"per-host batch rows {local_rows} not "
+                        f"divisible by the local learner mesh's data "
+                        f"axis ({data_size})"
+                    )
+            elif flags.batch_size % data_size != 0:
                 raise ValueError(
                     f"batch_size {flags.batch_size} not divisible by "
                     f"the learner mesh's data axis ({data_size})"
@@ -975,7 +1123,7 @@ def train(flags):
             "opt_state": opt_state,
             "step": step,
             "stats": dict(stats),
-            "rng": jax.random.PRNGKey(flags.seed + proc_id),
+            "rng": jax.random.PRNGKey(flags.seed + host_rank),
             "done": False,
         }
         state_lock = threading.Lock()
@@ -1414,7 +1562,7 @@ def train(flags):
             replica_hooks = ReplicaServingHooks(
                 snapshot_store,
                 max_policy_lag=flags.max_policy_lag,
-                rng_seed=flags.seed + 7919 * (proc_id + 1),
+                rng_seed=flags.seed + 7919 * (host_rank + 1),
                 health=health,
                 batch_dim=1,
                 registry=reg,
@@ -1656,12 +1804,26 @@ def train(flags):
                     replica_batcher=replica_parts["batcher"],
                     replica_router=replica_parts["router"],
                 )
+            if fleet_coord is not None:
+                # Remote hosts' heartbeat gauges land as
+                # host<r>.inference.slice.<i>.* on this host's lines
+                # (only the lead receives heartbeats; the fold no-ops
+                # elsewhere).
+                folder_kwargs.update(fleet=fleet_coord)
             tele.add_tick_callback(
                 NativeTelemetryFolder(
                     reg, pool=actors, batcher=inference_batcher,
                     queue=learner_queue, slo_target_s=slo_target_s,
                     **folder_kwargs,
                 ).tick
+            )
+        elif fleet_coord is not None and telemetry_on:
+            # Python runtime: the folder runs for the fleet fold alone
+            # (every native source None).
+            from torchbeast_tpu.runtime.native import NativeTelemetryFolder
+
+            tele.add_tick_callback(
+                NativeTelemetryFolder(reg, fleet=fleet_coord).tick
             )
         actor_thread = threading.Thread(
             target=actors.run, daemon=True, name="actorpool"
@@ -1686,6 +1848,44 @@ def train(flags):
             dump_fn=_stall_diagnostics,
             registry=reg,
         )
+
+        if fleet_coord is not None:
+            if not is_lead and snapshot_store is not None:
+                # Remote stores consume the lead's TAG_SNAPSHOT stream
+                # (applied on the coordinator's reader thread); the
+                # local params pin the pytree structure the wire's
+                # flattened leaves rebuild against.
+                fleet_coord.attach_snapshot_store(
+                    snapshot_store, state["infer_params"]
+                )
+            from torchbeast_tpu.parallel.sebulba import (
+                slice_gauge_snapshot,
+            )
+
+            def _fleet_stats():
+                # Heartbeat recovery counters: what the lead folds into
+                # the fleet verdict (a supervised env-server restart or
+                # actor reconnect on THIS host becomes a sticky
+                # fleet.host<r> mark on the lead).
+                with state_lock:
+                    at_step = state["step"]
+                reconnect_fn = getattr(actors, "reconnect_count", None)
+                return {
+                    "updates": int(at_step),
+                    "restarts": int(
+                        server_supervisor.restarts
+                        if server_supervisor is not None else 0
+                    ),
+                    "reconnects": int(
+                        reconnect_fn() if reconnect_fn is not None
+                        else 0
+                    ),
+                }
+
+            fleet_coord.set_stats_source(_fleet_stats)
+            fleet_coord.set_gauges_source(
+                lambda: slice_gauge_snapshot(reg)
+            )
 
         # Fresh health/liveness gauges on every exported line, the
         # final shutdown write included.
@@ -1778,6 +1978,10 @@ def train(flags):
             try:
                 _learner_loop_body()
             finally:
+                if fleet_coord is not None:
+                    # Leave the fleet's param-sync rendezvous set so
+                    # slower hosts stop waiting on this learner.
+                    fleet_coord.learner_done()
                 # Always mark done — an async XLA error surfacing in the
                 # delayed flush must stop the monitor loop, not wedge it.
                 with state_lock:
@@ -1860,6 +2064,32 @@ def train(flags):
                         now_step = state["step"]
                 watchdog.ping()
                 updates_done += superstep_k
+                if fleet_coord is not None and strategy == "wire":
+                    # DCN param composition (wire strategy): one
+                    # synchronous fleet-mean round per dispatch — the
+                    # CPU-CI equivalent of the xla strategy's in-mesh
+                    # grad all-reduce (averaging post-update params
+                    # from equal starts IS gradient averaging for the
+                    # SGD step; per-host RMSprop state stays local, the
+                    # documented approximation — fleet/coordinator.py).
+                    # None = the round degraded (timeout / fleet
+                    # shutting down): keep this host's params.
+                    with state_lock:
+                        params_now = state["params"]
+                    synced = fleet_coord.sync_params(params_now)
+                    if synced is not None:
+                        if learner_device is not None:
+                            synced = jax.device_put(
+                                synced, learner_device
+                            )
+                        elif mesh is not None:
+                            synced = replicate(mesh, synced)
+                        infer_view = local_view(
+                            synced, device=infer_device
+                        )
+                        with state_lock:
+                            state["params"] = synced
+                            state["infer_params"] = infer_view
                 if snapshot_store is not None:
                     # Versioned snapshot publish (serving/snapshot.py):
                     # due when the head has run >= refresh_updates past
@@ -1872,7 +2102,24 @@ def train(flags):
                     # its device copy d2d via latest_on — zero host
                     # round-trips (tests/test_sebulba.py pins it).
                     if snapshot_store.note_update(updates_done):
-                        snapshot_store.publish(updates_done, infer_view)
+                        if fleet_coord is not None and not is_lead:
+                            # Remote fleet hosts serve the LEAD's
+                            # policy: the wire (TAG_SNAPSHOT) feeds
+                            # this store; a local publish would fork
+                            # the fleet's serving policy. note_update
+                            # keeps advancing the head, so the stamped
+                            # policy_lag is the TRUE wire delay.
+                            pass
+                        elif snapshot_store.publish(
+                            updates_done, infer_view
+                        ) and fleet_coord is not None:
+                            # Cross-host publication (fleet/
+                            # snapshot_wire.py): same bf16 cast,
+                            # flattened leaves + dtype names riding
+                            # TAG_SNAPSHOT to every remote store.
+                            fleet_coord.publish_snapshot(
+                                updates_done, infer_view
+                            )
                 if pending is not None:
                     flush(pending)
                 pending = (train_stats, now_step, release)
@@ -1889,6 +2136,8 @@ def train(flags):
         if server_supervisor is not None:
             server_supervisor.stop()  # before terminate: no resurrect-mid-reap
         _reap_servers(server_procs)
+        if fleet_coord is not None:
+            fleet_coord.shutdown()
         raise
     # From the first thread start onward, the main try/finally below owns
     # ALL cleanup (queues closed, threads joined, logger closed, servers
@@ -2077,6 +2326,12 @@ def train(flags):
         if server_supervisor is not None:
             server_supervisor.stop()  # before terminate: no resurrect-mid-reap
         _reap_servers(server_procs)
+        if fleet_coord is not None:
+            # After the final telemetry write (the folder's last fold
+            # reads remote gauges) and the server reap: a clean "bye"
+            # to the fleet, so departure is accounted as done, not
+            # lost.
+            fleet_coord.shutdown()
     log.info(
         "Learning finished after %d steps (health %s).",
         state["step"], health.state_name,

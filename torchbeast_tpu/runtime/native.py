@@ -98,7 +98,17 @@ class NativeTelemetryFolder:
     def __init__(self, registry, pool=None, batcher=None, queue=None,
                  tracer=None, slo_target_s=None, slice_batchers=None,
                  slice_router=None, replica_router=None,
-                 replica_batcher=None):
+                 replica_batcher=None, fleet=None):
+        # ISSUE 17 fleet fold: with a FleetCoordinator attached, the
+        # lead re-exports every remote host's heartbeat gauges
+        # (inference.slice.<i>.* by construction — parallel.sebulba
+        # .slice_gauge_snapshot feeds the remote end) prefixed
+        # `host<r>.`, so one telemetry.jsonl shows every slice in the
+        # fleet. Works with all native sources None — Python-runtime
+        # fleet runs construct this folder for the fleet fold alone.
+        self._fleet = fleet
+        self._registry = registry
+        self._fleet_gauges = {}  # name -> Gauge  # guarded-by: self._lock
         self._pool = pool
         self._batcher = batcher
         self._queue = queue
@@ -352,3 +362,12 @@ class NativeTelemetryFolder:
                                 q["items_in"])
                 self._fold_hist(self._h_queue_wait, q["dequeue_wait_s"])
                 self._fold_hist(self._h_queue_batch, q["batch_size"])
+            if self._fleet is not None:
+                for rank, gauges in self._fleet.remote_gauges().items():
+                    for name, value in gauges.items():
+                        full = f"host{rank}.{name}"
+                        gauge = self._fleet_gauges.get(full)
+                        if gauge is None:
+                            gauge = self._registry.gauge(full)
+                            self._fleet_gauges[full] = gauge
+                        gauge.set(value)

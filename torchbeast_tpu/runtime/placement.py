@@ -57,6 +57,28 @@ def _mix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
+# Salt for the SECOND splitmix64 stage of the fleet's actor->host
+# assignment (fleet/topology.py): host = _mix64(slot ^ salt) % n_hosts
+# while slice = _mix64(slot) % n_slices. The salt decorrelates the two
+# draws — without it every slot on host h would also share slice
+# h % n_slices whenever n_hosts == n_slices. Any fixed odd constant
+# works; this one is the splitmix64 gamma rotated left by 1 (documented
+# so nobody "fixes" it to the gamma itself, which would correlate the
+# host draw with the slice draw's first addition).
+FLEET_HOST_SALT = 0x3C6EF372FE94F82B
+
+
+def fleet_host_for_slot(slot: int, num_hosts: int) -> int:
+    """STATIC slot -> host assignment for multi-host fleets
+    (fleet/topology.py): the same process-stable splitmix64 family as
+    `DeviceSplit.slice_for_slot`, salted so the host draw and the
+    slice draw are uncorrelated. A slot's (host, slice) pair therefore
+    never migrates across actor reconnects or host restarts."""
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    return _mix64(int(slot) ^ FLEET_HOST_SALT) % num_hosts
+
+
 @dataclasses.dataclass(frozen=True)
 class DeviceSplit:
     """A resolved device partition: N single-device inference slices +

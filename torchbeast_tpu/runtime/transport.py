@@ -861,6 +861,43 @@ def connect_transport(address: str, timeout_s: float = 600,
     )
 
 
+def dial_transport(address: str, deadline_s: float,
+                   attempt_timeout_s: float = 2.0,
+                   base_s: float = 0.2, cap_s: float = 2.0,
+                   rng=None, **transport_kwargs):
+    """Bounded-retry dial under jittered exponential backoff (the fleet
+    control plane's rendezvous discipline, fleet/coordinator.py).
+
+    `connect_transport` already retries on a FIXED 0.1s cadence — right
+    for an env server known to be coming up on the same box, wrong for
+    a peer HOST that may be seconds behind in its own startup: a fleet
+    of remotes hammering the lead's listen queue in lockstep is exactly
+    the thundering herd `Backoff`'s jitter exists to break up. Each
+    attempt gets `attempt_timeout_s`; attempts repeat under backoff
+    until `deadline_s` total, then the last error surfaces as
+    TimeoutError. `transport_kwargs` pass through to the per-attempt
+    `connect_transport` (max_frame_bytes, recv_timeout_s).
+    """
+    from torchbeast_tpu.resilience.backoff import Backoff, BackoffDeadline
+
+    backoff = Backoff(
+        base_s=base_s, cap_s=cap_s, deadline_s=deadline_s, rng=rng
+    )
+    while True:
+        try:
+            return connect_transport(
+                address, timeout_s=attempt_timeout_s, **transport_kwargs
+            )
+        except (OSError, TimeoutError) as e:
+            try:
+                backoff.sleep()
+            except BackoffDeadline:
+                raise TimeoutError(
+                    f"dial_transport: could not reach {address} within "
+                    f"{deadline_s}s ({backoff.attempts} attempts): {e}"
+                ) from e
+
+
 def shm_pipe(obs_ring_bytes: int = DEFAULT_OBS_RING_BYTES,
              act_ring_bytes: int = DEFAULT_ACT_RING_BYTES,
              max_frame_bytes: Optional[int] = None):

@@ -74,6 +74,12 @@ TAG_INT = 0x05
 TAG_FLOAT = 0x06
 TAG_BOOL = 0x07
 TAG_STRING = 0x08
+# Versioned policy snapshot (ISSUE 17, fleet/snapshot_wire.py): the
+# fleet control plane's lead->remote publication of bf16-cast policy
+# params. A DISTINCT tag (not a convention-keyed dict) so a snapshot
+# frame can never be mistaken for actor traffic and the C++ observer
+# (csrc/wire.h kTagSnapshot) stays WIRE-PARITY-pinned to it.
+TAG_SNAPSHOT = 0x09
 
 # Reject frames whose header demands more than this before allocating
 # (csrc/wire.h kMaxFrameBytes must match).
@@ -121,6 +127,35 @@ _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 class WireError(Exception):
     pass
+
+
+class PolicySnapshot:
+    """The TAG_SNAPSHOT message: one versioned bf16 policy snapshot.
+
+    `params` is the bf16-cast param nest (what travels), `dtypes` the
+    original-dtype nest (leaf dtype names, so the receiving host can
+    restore exactly what `PolicySnapshotStore.publish` records — the
+    restore is bit-exact because every leaf was bf16-cast before
+    encoding, see fleet/snapshot_wire.py). The class lives here, next
+    to the codec, because both encoders and the decoder must agree on
+    it; the cast/restore POLICY lives with the snapshot store.
+
+    Wire layout after the tag byte: u64le version, then the params
+    value, then the dtypes value (both in the standard recursive
+    encoding).
+    """
+
+    __slots__ = ("version", "params", "dtypes")
+
+    def __init__(self, version: int, params: Any, dtypes: Any):
+        if version < 0:
+            raise WireError(f"snapshot version {version} must be >= 0")
+        self.version = int(version)
+        self.params = params
+        self.dtypes = dtypes
+
+    def __repr__(self):
+        return f"PolicySnapshot(version={self.version})"
 
 
 # wire.encode_s / wire.decode_s histograms (ISSUE 3 measurement): resolved
@@ -188,6 +223,11 @@ def _encode_value(buf: io.BytesIO, value: Any) -> None:
             buf.write(struct.pack("<H", len(raw)))
             buf.write(raw)
             _encode_value(buf, v)
+    elif isinstance(value, PolicySnapshot):
+        buf.write(bytes([TAG_SNAPSHOT]))
+        buf.write(struct.pack("<Q", value.version))
+        _encode_value(buf, value.params)
+        _encode_value(buf, value.dtypes)
     else:
         raise WireError(f"Cannot serialize {type(value)!r}")
 
@@ -347,6 +387,16 @@ def _write_list(enc: _Encoder, value) -> None:
         _write_value(enc, v)
 
 
+def _write_snapshot(enc: _Encoder, value: "PolicySnapshot") -> None:
+    enc.need(9)
+    pos = enc.pos
+    enc.scratch[pos] = TAG_SNAPSHOT
+    struct.pack_into("<Q", enc.scratch, pos + 1, value.version)
+    enc.pos = pos + 9
+    _write_value(enc, value.params)
+    _write_value(enc, value.dtypes)
+
+
 def _write_value(enc: _Encoder, value: Any) -> None:
     # Exact-type dispatch first (isinstance chains dominated the encode
     # profile); numpy scalars and subclasses fall through to an
@@ -387,6 +437,8 @@ def _write_value(enc: _Encoder, value: Any) -> None:
         _write_list(enc, value)
     elif isinstance(value, dict):
         _write_dict(enc, value)
+    elif isinstance(value, PolicySnapshot):
+        _write_snapshot(enc, value)
     else:
         raise WireError(f"Cannot serialize {type(value)!r}")
 
@@ -496,6 +548,15 @@ def _decode_value(view: memoryview, offset: int):
             v, offset = _decode_value(view, offset)
             out[key] = v
         return out, offset
+    if tag == TAG_SNAPSHOT:
+        (version,) = struct.unpack_from("<Q", view, offset)
+        offset += 8
+        params, offset = _decode_value(view, offset)
+        dtypes, offset = _decode_value(view, offset)
+        # Array leaves are zero-copy views like every decoded nest:
+        # the receiving host must consume (copy/publish) the snapshot
+        # before the next recv on the same buffer.
+        return PolicySnapshot(version, params, dtypes), offset
     raise WireError(f"Unknown tag {tag:#x}")
 
 

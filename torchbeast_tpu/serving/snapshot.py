@@ -27,6 +27,28 @@ from typing import Any, Optional, Tuple
 from torchbeast_tpu import telemetry
 
 
+def bf16_cast(params: Any) -> Tuple[Any, Any]:
+    """(bf16-cast tree, original-dtype tree) — THE publication cast.
+
+    One definition shared by the local publish path below and the
+    fleet's wire publication (fleet/snapshot_wire.py), so what travels
+    over DCN is bit-identical to what a local replica would serve:
+    float leaves go bfloat16, everything else passes through, and the
+    dtype tree records what `latest()` restores to. The restore is
+    bit-exact for the wire path because its input was already bf16
+    (bf16 -> f32 -> bf16 round-trips every value)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtypes = jax.tree_util.tree_map(lambda a: a.dtype, params)
+    bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        params,
+    )
+    return bf16, dtypes
+
+
 class PolicySnapshotStore:
     def __init__(self, refresh_updates: int, registry=None):
         if refresh_updates < 1:
@@ -74,9 +96,6 @@ class PolicySnapshotStore:
     def publish(self, version: int, params: Any) -> bool:
         """Stamp a bf16 snapshot at `version`. Returns False when the
         refresh was dropped (the injected-failure hook)."""
-        import jax
-        import jax.numpy as jnp
-
         with self._lock:
             if self._fail_next > 0:
                 self._fail_next -= 1
@@ -86,12 +105,7 @@ class PolicySnapshotStore:
         if drop:
             self._c_refresh_failures.inc()
             return False
-        dtypes = jax.tree_util.tree_map(lambda a: a.dtype, params)
-        bf16 = jax.tree_util.tree_map(
-            lambda a: a.astype(jnp.bfloat16)
-            if jnp.issubdtype(a.dtype, jnp.floating) else a,
-            params,
-        )
+        bf16, dtypes = bf16_cast(params)
         with self._lock:
             self._version = version
             self._head = max(self._head, version)
