@@ -168,6 +168,19 @@ def parse_args(argv=None):
                    help="FaultPlan seed + --env_seed for both runs.")
     p.add_argument("--return_tol", type=float, default=0.2,
                    help="Allowed |chaos - baseline| final-return gap.")
+    p.add_argument("--scheduler_pressure", type=int, default=0,
+                   help="Induced-scheduler-pressure mode (ROADMAP "
+                        "metastability debt): run the CHAOS leg with N "
+                        "spinner subprocesses competing for every core "
+                        "(capacity_bench's pressure trick) and record "
+                        "the ring.doorbell_waits / "
+                        "ring.recheck_wakeups contrast between the "
+                        "unpressured baseline leg and the pressured "
+                        "chaos leg in the verdict's \"ring\" block — "
+                        "the counter baseline needed to localize the "
+                        "doorbell root cause. 0 = off (both legs "
+                        "unpressured; the ring block is still "
+                        "recorded).")
     # Multi-host fleet lane (ISSUE 17): --hosts 2 runs ONE fleet
     # (in-process lead + subprocess remote) instead of the
     # baseline/chaos pair, SIGKILLs the remote's whole env-server
@@ -310,6 +323,43 @@ def _shm_entries():
 
 def _live_children():
     return {p.pid for p in mp.active_children() if p.is_alive()}
+
+
+class _SchedulerPressure:
+    """Spinner subprocesses competing for every core while the chaos
+    leg runs — the same induced-pressure contrast as
+    benchmarks/capacity_bench.py, here paired with the ring-wait
+    counters so the verdict carries a pressured-vs-unpressured
+    baseline for the doorbell metastability investigation. n=0 is a
+    no-op (spawns nothing), so the harness can wrap the leg
+    unconditionally."""
+
+    def __init__(self, n: int):
+        self._n = max(0, int(n))
+        self._procs = []
+
+    def __enter__(self):
+        import subprocess
+
+        for _ in range(self._n):
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-c", "while True: pass"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            ))
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        for proc in self._procs:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+        self._procs = []
+        return False
 
 
 def run_one(args, savedir, xpid, chaos_plan_path=None, fleet_spec=None):
@@ -561,6 +611,14 @@ def main(argv=None) -> int:
         print("--hosts must be 1 or 2 (the fleet lane pins one remote "
               "host)", file=sys.stderr)
         return 2
+    if args.hosts > 1 and args.scheduler_pressure:
+        print(
+            "--scheduler_pressure is a single-host mode: it wraps the "
+            "chaos leg of the baseline/chaos pair, which the fleet "
+            "lane replaces",
+            file=sys.stderr,
+        )
+        return 2
     if args.hosts > 1 and args.batch_size % args.hosts != 0:
         print(
             f"--batch_size {args.batch_size} (global) must be "
@@ -640,7 +698,10 @@ def main(argv=None) -> int:
 
     failures = []
     baseline = run_one(args, savedir, "chaos-baseline")
-    chaos = run_one(args, savedir, "chaos-faulted", plan_path)
+    # Only the chaos leg runs under induced scheduler pressure: the
+    # unpressured baseline leg is the contrast the ring block needs.
+    with _SchedulerPressure(args.scheduler_pressure):
+        chaos = run_one(args, savedir, "chaos-faulted", plan_path)
 
     # -- completion --------------------------------------------------------
     if chaos["step"] < args.total_steps:
@@ -735,6 +796,31 @@ def main(argv=None) -> int:
                 f"{run['leaked_shm']}"
             )
 
+    # -- ring-wait contrast (doorbell metastability baseline) --------------
+    # Per-leg ring.doorbell_waits / ring.recheck_wakeups, with only the
+    # chaos leg pressured when --scheduler_pressure > 0: a
+    # recheck-heavy pressured leg against a doorbell-quiet baseline is
+    # the signature the metastability investigation needs.
+    ring = {
+        "scheduler_pressure": args.scheduler_pressure,
+        "baseline": {
+            "doorbell_waits": int(
+                baseline["counters"].get("ring.doorbell_waits", 0)
+            ),
+            "recheck_wakeups": int(
+                baseline["counters"].get("ring.recheck_wakeups", 0)
+            ),
+        },
+        "chaos": {
+            "doorbell_waits": int(
+                counters.get("ring.doorbell_waits", 0)
+            ),
+            "recheck_wakeups": int(
+                counters.get("ring.recheck_wakeups", 0)
+            ),
+        },
+    }
+
     verdict = {
         "bench": "chaos_run",
         "selftest": bool(args.selftest),
@@ -750,6 +836,7 @@ def main(argv=None) -> int:
         "plan": plan_dict,
         "expected_counters": expected,
         "serving": serving,
+        "ring": ring,
         "results": {"baseline": baseline, "chaos": chaos},
         "telemetry": telemetry.telemetry_block(),
     }

@@ -52,6 +52,45 @@ if [[ "$FAST" -eq 0 ]]; then
     echo "== check: chaos selftest, scaled (x2 fleet + x2 fault plan, shed audit)"
     JAX_PLATFORMS=cpu python scripts/chaos_run.py --selftest --scale 2
 
+    echo "== check: IMPACT smoke (Catch, lag budget 10x, replay reuse 2)"
+    # The lag-tolerant learner end to end (ISSUE 18): --loss impact
+    # must LEARN Catch with the policy-lag budget at 10x the default
+    # (replicas on the impact-relaxed refresh-every-10 cadence) while
+    # reusing every batch twice — and the throughput/cadence accounting
+    # that justifies the mode must be in the telemetry: the
+    # env_sps/learn_sps split at the configured reuse factor, and the
+    # target-network store publishing on its own cadence.
+    JAX_PLATFORMS=cpu python -m torchbeast_tpu.polybeast \
+        --env Catch --total_steps 40000 --num_servers 2 --num_actors 4 \
+        --batch_size 4 --unroll_length 20 \
+        --learning_rate 2e-3 --entropy_cost 0.01 \
+        --loss impact --replay_reuse 2 --target_refresh_updates 8 \
+        --max_policy_lag 200 --env_seed 1 \
+        --xpid impact-smoke --savedir /tmp/tbt_impact_smoke \
+        > /tmp/tbt_impact_smoke.log 2>&1 \
+        || { tail -20 /tmp/tbt_impact_smoke.log; exit 1; }
+    python - <<'EOF'
+import csv, json
+run = "/tmp/tbt_impact_smoke/impact-smoke"
+ret = None
+for row in csv.DictReader(open(run + "/logs.csv")):
+    if row.get("mean_episode_return"):
+        ret = float(row["mean_episode_return"])
+assert ret is not None and ret >= 0.5, f"impact Catch final return {ret} < 0.5"
+snap = json.loads(open(run + "/telemetry.jsonl").read().strip().splitlines()[-1])
+g, c = snap["gauges"], snap["counters"]
+assert g.get("learner.sample_reuse") == 2.0, g.get("learner.sample_reuse")
+env_sps, learn_sps = g.get("learner.env_sps"), g.get("learner.learn_sps")
+assert env_sps and learn_sps and learn_sps > env_sps, (env_sps, learn_sps)
+assert c.get("learner.target.snapshots_published", 0) >= 1, \
+    c.get("learner.target.snapshots_published")
+assert c.get("learner.target.snapshot_bytes_published", 0) > 0
+assert c.get("serving.snapshots_published", 0) >= 1, \
+    c.get("serving.snapshots_published")
+print("impact-smoke: PASS (return", ret, "env_sps", round(env_sps, 1),
+      "learn_sps", round(learn_sps, 1), ")")
+EOF
+
     echo "== check: Sebulba split smoke (2 forced host devices, inf=1,learn=rest)"
     # The async driver end to end with the device split on a forced
     # 2-device CPU topology (ISSUE 15): per-slice serving + the

@@ -960,6 +960,46 @@ class TestFlagParity:
                 flag, [f.message for f in found],
             )
 
+    def test_issue18_flags_present_and_drift_caught(self):
+        """The three ISSUE 18 shared IMPACT flags (--impact_clip,
+        --replay_reuse, --target_refresh_updates) exist in BOTH
+        drivers, agree right now, and an injected default drift on each
+        is CAUGHT — the parity net covers the lag-tolerant learner's
+        knobs."""
+        with open(os.path.join(
+            REPO, "torchbeast_tpu", "monobeast.py"
+        )) as f:
+            mono_src = f.read()
+        with open(os.path.join(
+            REPO, "torchbeast_tpu", "polybeast.py"
+        )) as f:
+            poly_src = f.read()
+        drifts = {
+            "--impact_clip": (
+                '"--impact_clip", type=float, default=0.2',
+                '"--impact_clip", type=float, default=0.3',
+            ),
+            "--replay_reuse": (
+                '"--replay_reuse", type=int, default=1',
+                '"--replay_reuse", type=int, default=2',
+            ),
+            "--target_refresh_updates": (
+                '"--target_refresh_updates", type=int, default=8',
+                '"--target_refresh_updates", type=int, default=80',
+            ),
+        }
+        mono = FileContext("torchbeast_tpu/monobeast.py", mono_src)
+        for flag, (orig, drifted_frag) in drifts.items():
+            assert orig in mono_src and orig in poly_src, flag
+            drifted = FileContext(
+                "torchbeast_tpu/polybeast.py",
+                poly_src.replace(orig, drifted_frag),
+            )
+            found = check_flag_parity(mono, drifted)
+            assert any(flag in f.message for f in found), (
+                flag, [f.message for f in found],
+            )
+
     def test_real_drivers_in_anger(self):
         """Shared monobeast/polybeast flags agree on type+default; the
         two known-intentional divergences (--model, --num_actors) are

@@ -383,6 +383,20 @@ def test_chaos_run_selftest(tmp_path):
         == chaos["mean_episode_return"]
     )
 
+    # The ring-wait block (ISSUE 18, metastability baseline): per-leg
+    # doorbell counters plus the pressure level, present even at
+    # pressure 0 so every committed verdict carries the contrast.
+    ring = out["ring"]
+    assert ring["scheduler_pressure"] == 0
+    for leg in ("baseline", "chaos"):
+        assert set(ring[leg]) == {"doorbell_waits", "recheck_wakeups"}
+        # recheck wakeups are the subset of armed waits ended by the
+        # bounded recheck instead of the doorbell.
+        assert ring[leg]["doorbell_waits"] >= 0
+        assert 0 <= ring[leg]["recheck_wakeups"] <= (
+            ring[leg]["doorbell_waits"]
+        )
+
     _validate_telemetry_block(out["telemetry"])
     saved = json.loads(out_json.read_text())
     assert saved["bench"] == "chaos_run" and saved["ok"] is True
@@ -415,6 +429,44 @@ def test_dryrun_multichip_selftest(tmp_path):
     # The acceptance block is present with the CPU no-regression bar
     # (the verdict itself is the full curve's job, not the selftest's).
     assert out["acceptance"]["required_min_ratio"] == 0.9
+
+
+def test_impact_ablation_selftest():
+    """impact_ablation --selftest (ISSUE 18): two tiny Mock legs
+    (vtrace baseline + impact at the 10x lag budget with replay reuse
+    2) with the ablation row schema pinned — final return from the
+    tail-mean, the env_sps/learn_sps split, publish accounting
+    normalized per update, target-network publish counts, lag
+    compliance, and the fresh-provenance block — so the committed
+    ablation artifact can't silently lose the columns its acceptance
+    gates read."""
+    proc = _run(["benchmarks/impact_ablation.py", "--selftest"],
+                timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bench"] == "impact_ablation"
+    assert out["selftest"]["ok"] is True
+    assert out["selftest"]["schema_ok"] is True
+    by_loss = {r["loss"]: r for r in out["rows"]}
+    assert set(by_loss) == {"vtrace", "impact"}
+    for row in out["rows"]:
+        assert row["provenance"]["fresh"] is True
+        assert row["provenance"]["jax"]
+        assert row["final_return"] is not None
+        assert row["curve"], row
+        assert row["env_sps"] > 0 and row["learn_sps"] > 0
+        assert row["updates"] > 0
+        assert row["publishes_per_update"] is not None
+        assert row["lag_compliant"] is True
+    vt, imp = by_loss["vtrace"], by_loss["impact"]
+    # The impact leg reuses each batch twice: gradient frames outrun
+    # env frames, and its target-network store actually published.
+    assert imp["replay_reuse"] == 2 and imp["sample_reuse"] == 2.0
+    assert imp["learn_sps"] > imp["env_sps"]
+    assert imp["target_snapshots_published"] > 0
+    # vtrace publishes every update; impact rides the relaxed default
+    # — the per-update cadence gap is what the full run gates >= 5x.
+    assert vt["publishes_per_update"] > imp["publishes_per_update"]
 
 
 def test_capacity_bench_selftest():

@@ -500,6 +500,137 @@ class TestBatchArena:
         closer.join()
         assert all(slot.free for slot in arena._slots)
 
+    def test_replay_reuse_exact_accounting(self):
+        """--replay_reuse K': each fresh fill is served exactly K'
+        times (one fresh + K'-1 replays, release.fresh marking which),
+        the queue drains only on fresh fills, and the next fill starts
+        a new K'-fold cycle (ISSUE 18)."""
+        from torchbeast_tpu.runtime import BatchArena
+
+        rng = np.random.default_rng(5)
+        items = [self._item(rng) for _ in range(2)]
+        q = self._filled_queue(items)
+        arena = BatchArena(k=1, rows=1, pool=3, replay_reuse=3)
+
+        first = [arena.assemble_from(q) for _ in range(3)]
+        flags = [r.fresh for _, r in first]
+        assert flags == [True, False, False]
+        # Replays re-serve the SAME arena arrays — zero copies.
+        for stacked, _ in first[1:]:
+            assert stacked["batch"]["obs"] is first[0][0]["batch"]["obs"]
+        np.testing.assert_array_equal(
+            first[0][0]["batch"]["obs"][0], items[0]["batch"]["obs"]
+        )
+        # Quota spent: the 4th handout drains the queue again.
+        stacked2, release2 = arena.assemble_from(q)
+        assert release2.fresh
+        np.testing.assert_array_equal(
+            stacked2["batch"]["obs"][0], items[1]["batch"]["obs"]
+        )
+        for _, release in first:
+            release()
+        release2()
+        # The first cycle's slot is fully retired; the second still owes
+        # 2 replays, so it stays occupied (never rewritten mid-cycle).
+        assert sum(1 for s in arena._slots if not s.free) == 1
+        second = [arena.assemble_from(q) for _ in range(2)]
+        assert [r.fresh for _, r in second] == [False, False]
+        for _, release in second:
+            release()
+        assert sum(1 for s in arena._slots if not s.free) == 0
+
+    def test_replay_slot_not_rewritten_mid_reuse(self):
+        """The rewrite fence holds until EVERY handout of a slot is
+        released AND its replay quota is spent — releasing the fresh
+        handout alone (or the replay alone) must not free the slot, and
+        a new fill under pressure grows the pool instead of corrupting
+        the replayed data."""
+        from torchbeast_tpu.runtime import BatchArena
+
+        rng = np.random.default_rng(6)
+        items = [self._item(rng) for _ in range(3)]
+        q = self._filled_queue(items)
+        arena = BatchArena(
+            k=1, rows=1, pool=2, grow_timeout_s=0.2, replay_reuse=2
+        )
+        s_fresh, r_fresh = arena.assemble_from(q)
+        _, r_replay = arena.assemble_from(q)  # same slot, quota spent
+        before = s_fresh["batch"]["obs"].copy()
+
+        # Fresh release alone: replay handout still outstanding.
+        r_fresh()
+        assert sum(1 for s in arena._slots if not s.free) == 1
+        # A full second cycle (fresh + replay) takes the second slot;
+        # the third fresh fill then has no free slot — with the first
+        # slot's replay handout STILL outstanding it must grow, never
+        # rewrite.
+        _, r2f = arena.assemble_from(q)
+        _, r2r = arena.assemble_from(q)
+        _, r3 = arena.assemble_from(q)
+        assert len(arena._slots) == 3  # grew exactly once
+        np.testing.assert_array_equal(before, s_fresh["batch"]["obs"])
+
+        r_replay()  # last handout of slot 1 released -> it frees
+        # Its replay twin rides the grown slot's pending quota.
+        _, r3r = arena.assemble_from(q)
+        assert not r3r.fresh
+        q2 = self._filled_queue([self._item(rng)])
+        _, r4 = arena.assemble_from(q2)
+        assert r4.fresh
+        assert len(arena._slots) == 3  # reused the freed slot
+        for release in (r2f, r2r, r3, r3r, r4):
+            release()
+
+    def test_replay_reuse_one_is_single_release(self):
+        """replay_reuse=1 is the original arena contract bit-for-bit:
+        every handout is fresh, one release frees the slot."""
+        from torchbeast_tpu.runtime import BatchArena
+
+        rng = np.random.default_rng(7)
+        items = [self._item(rng) for _ in range(2)]
+        q = self._filled_queue(items)
+        arena = BatchArena(k=1, rows=1, pool=2, replay_reuse=1)
+        stacked, release = arena.assemble_from(q)
+        assert release.fresh
+        np.testing.assert_array_equal(
+            stacked["batch"]["obs"][0], items[0]["batch"]["obs"]
+        )
+        release()
+        assert sum(1 for s in arena._slots if not s.free) == 0
+        stacked2, release2 = arena.assemble_from(q)
+        assert release2.fresh
+        np.testing.assert_array_equal(
+            stacked2["batch"]["obs"][0], items[1]["batch"]["obs"]
+        )
+        release2()
+
+    def test_replay_aborted_fill_resets_quota(self):
+        """A fill that dies mid-assembly (source closed) must clear the
+        replay bookkeeping: nothing of the partial fill is ever
+        re-served."""
+        from torchbeast_tpu.runtime import BatchArena, BatchingQueue
+
+        rng = np.random.default_rng(8)
+        # First cycle completes and spends its quota, so _replay_slot
+        # bookkeeping has been exercised before the abort.
+        q = BatchingQueue(batch_dim=1, maximum_queue_size=4)
+        q.enqueue(self._item(rng))
+        q.enqueue(self._item(rng))
+        arena = BatchArena(k=2, rows=1, pool=2, replay_reuse=2)
+        _, r_fresh = arena.assemble_from(q)
+        _, r_replay = arena.assemble_from(q)
+        r_fresh()
+        r_replay()
+        # Second cycle aborts mid-fill: one item, then close.
+        q.enqueue(self._item(rng))
+        closer = threading.Timer(0.2, q.close)
+        closer.start()
+        with pytest.raises(StopIteration):
+            arena.assemble_from(q)
+        closer.join()
+        assert arena._replay_slot is None
+        assert all(slot.free for slot in arena._slots)
+
 
 class TestDevicePrefetcherSuperstepMode:
     def _queue_of(self, n_items, rng=None):

@@ -169,6 +169,7 @@ class FleetCoordinator:
         self._c_hb_rx = reg.counter("fleet.heartbeats_received")
         self._c_hb_tx = reg.counter("fleet.heartbeats_sent")
         self._c_snap_tx = reg.counter("fleet.snapshots_sent")
+        self._c_snap_bytes_tx = reg.counter("fleet.snapshot_bytes_sent")
         self._c_snap_rx = reg.counter("fleet.snapshots_received")
         self._c_snap_stale = reg.counter("fleet.snapshots_stale_dropped")
         self._c_syncs = reg.counter("fleet.param_syncs")
@@ -594,6 +595,11 @@ class FleetCoordinator:
         n = self._broadcast(snap)
         if n:
             self._c_snap_tx.inc()
+            # DCN bytes this fanout moved (payload x hosts reached) —
+            # the figure --loss impact's relaxed refresh cadence cuts.
+            self._c_snap_bytes_tx.inc(
+                n * sum(int(leaf.nbytes) for leaf in snap.params)
+            )
         return n
 
     def _on_snapshot(self, snap) -> None:

@@ -29,6 +29,7 @@ from torchbeast_tpu import telemetry
 
 from torchbeast_tpu.ops import (
     compute_entropy_loss,
+    impact_policy_losses,
     vtrace_policy_losses,
 )
 from torchbeast_tpu.ops.pallas_opt import FusedTailState
@@ -87,13 +88,30 @@ class HParams(NamedTuple):
     # bf16 narrowing cast in a single pass; TPU-compiled, interpreted
     # elsewhere). Identical semantics, pinned by tests/test_pallas_opt.
     opt_impl: str = "xla"
+    # Objective family (--loss): "vtrace" (IMPALA, the default) or
+    # "impact" — the clipped target-network surrogate (ops/impact.py)
+    # that tolerates 10x the policy lag and unlocks K'-fold sample
+    # reuse. Under "impact" the batch must carry the target network's
+    # forward outputs (make_target_forward merges them in).
+    loss: str = "vtrace"
+    # The IMPACT surrogate's PPO-style clip epsilon (--impact_clip).
+    impact_clip: float = 0.2
+    # K'-fold sample reuse (--replay_reuse): each collected batch is
+    # consumed this many times (BatchArena replay slots / repeated
+    # dispatch in the sync driver). 1 = the on-policy default.
+    replay_reuse: int = 1
 
 
 def updates_horizon(hp: HParams) -> int:
     """Optimizer updates in a run: total_steps env frames at T*B frames
-    per update. The ONE schedule clock — the LR decay and the entropy
-    anneal both divide by this, so they cannot drift apart."""
-    return max(1, hp.total_steps // (hp.unroll_length * hp.batch_size))
+    per update, times the replay reuse factor (each collected batch is
+    consumed replay_reuse times, so the run performs reuse-many more
+    optimizer updates than env frames alone imply). The ONE schedule
+    clock — the LR decay and the entropy anneal both divide by this, so
+    they cannot drift apart."""
+    return max(
+        1, hp.total_steps // (hp.unroll_length * hp.batch_size)
+    ) * max(1, hp.replay_reuse)
 
 
 def _scale_by_rms_torch(
@@ -443,6 +461,49 @@ def make_optimizer(hp: HParams) -> optax.GradientTransformation:
     return chain
 
 
+# Batch keys the IMPACT loss consumes (merged in by make_target_forward,
+# popped back out by compute_loss before the model forward). Full
+# [T+1, B, ...] shapes mirroring the learner outputs: slot T supplies
+# the target network's bootstrap value.
+TARGET_LOGITS_KEY = "impact_target_logits"
+TARGET_BASELINE_KEY = "impact_target_baseline"
+
+
+def make_target_forward(model, superstep_k: int = 1):
+    """Build the jitted target-network forward for --loss impact.
+
+    (target_params, batch, initial_agent_state) ->
+        (target_policy_logits, target_baseline)   # [T+1, B, ...]
+
+    The driver merges the outputs into the batch dict under
+    TARGET_LOGITS_KEY / TARGET_BASELINE_KEY before dispatching the
+    update step, so the 4-arg (params, opt_state, batch, state) update
+    signature — and everything built on it: supersteps, donation,
+    consume_staged_inputs, the DP mesh — is untouched. Mathematically
+    this equals threading target params into the loss (every target
+    output is a constant w.r.t. theta).
+
+    superstep_k > 1 vmaps over the leading [K] axis of a stacked
+    superstep batch. The outputs are returned separately (not as an
+    augmented batch) so jit never aliases the staged batch leaves into
+    its outputs — the update step is free to donate them.
+    """
+
+    def forward(target_params, batch, initial_agent_state):
+        (outs, _), _ = model.apply(
+            target_params,
+            batch,
+            initial_agent_state,
+            sample_action=False,
+            mutable=["losses"],
+        )
+        return outs.policy_logits, outs.baseline
+
+    if superstep_k > 1:
+        forward = jax.vmap(forward, in_axes=(None, 0, 0))
+    return jax.jit(forward)
+
+
 def compute_loss(
     model, params, batch: Dict[str, jnp.ndarray], initial_agent_state,
     hp: HParams, entropy_cost=None,
@@ -466,6 +527,13 @@ def compute_loss(
     evaluation serves the importance weights and the pg cross-entropy,
     and the advantages are consumed by their reductions in place.
     """
+    # --loss impact: the target network's forward outputs ride the
+    # batch (TARGET_LOGITS_KEY / TARGET_BASELINE_KEY, merged in by
+    # make_target_forward in the driver) — popped here so the model
+    # forward and the episode bookkeeping below see the stock batch.
+    batch = dict(batch)
+    target_net_logits_full = batch.pop(TARGET_LOGITS_KEY, None)
+    target_net_baseline_full = batch.pop(TARGET_BASELINE_KEY, None)
     (learner_outputs, _), variables = model.apply(
         params,
         batch,
@@ -494,16 +562,36 @@ def compute_loss(
         rewards = jnp.clip(rewards, -1.0, 1.0)
     discounts = (~done).astype(jnp.float32) * hp.discounting
 
-    pg_loss, baseline_loss = vtrace_policy_losses(
-        behavior_policy_logits=behavior_logits,
-        target_policy_logits=target_logits,
-        actions=actions,
-        discounts=discounts,
-        rewards=rewards,
-        values=values,
-        bootstrap_value=bootstrap_value,
-        scan_impl=hp.vtrace_impl,
-    )
+    if hp.loss == "impact":
+        if target_net_logits_full is None:
+            raise ValueError(
+                "--loss impact requires the target network's outputs on "
+                "the batch (make_target_forward merges them in)"
+            )
+        pg_loss, baseline_loss = impact_policy_losses(
+            behavior_policy_logits=behavior_logits,
+            target_net_policy_logits=target_net_logits_full[:-1],
+            learner_policy_logits=target_logits,
+            actions=actions,
+            discounts=discounts,
+            rewards=rewards,
+            target_net_values=target_net_baseline_full[:-1],
+            values=values,
+            target_net_bootstrap_value=target_net_baseline_full[-1],
+            clip_epsilon=hp.impact_clip,
+            scan_impl=hp.vtrace_impl,
+        )
+    else:
+        pg_loss, baseline_loss = vtrace_policy_losses(
+            behavior_policy_logits=behavior_logits,
+            target_policy_logits=target_logits,
+            actions=actions,
+            discounts=discounts,
+            rewards=rewards,
+            values=values,
+            bootstrap_value=bootstrap_value,
+            scan_impl=hp.vtrace_impl,
+        )
     baseline_loss = hp.baseline_cost * baseline_loss
     # entropy_cost may be a traced scalar (the annealed schedule from
     # make_update_step); None = the constant from hp.

@@ -320,6 +320,22 @@ def make_parser():
     parser.add_argument("--discounting", type=float, default=0.99)
     parser.add_argument("--reward_clipping", default="abs_one",
                         choices=["abs_one", "none"])
+    parser.add_argument("--loss", default="vtrace",
+                        choices=["vtrace", "impact"],
+                        help="Objective family: IMPALA V-trace (the "
+                             "default) or the IMPACT clipped "
+                             "target-network surrogate (ops/impact.py) "
+                             "— lag-tolerant, unlocks --replay_reuse.")
+    parser.add_argument("--impact_clip", type=float, default=0.2,
+                        help="IMPACT surrogate clip epsilon "
+                             "(--loss impact).")
+    parser.add_argument("--replay_reuse", type=int, default=1,
+                        help="Consume each collected batch K' times "
+                             "(--loss impact; 1 = on-policy). The "
+                             "schedule clock scales with it.")
+    parser.add_argument("--target_refresh_updates", type=int, default=8,
+                        help="Refresh the IMPACT target network every "
+                             "N optimizer updates (--loss impact).")
     # Optimizer settings.
     parser.add_argument("--learning_rate", type=float, default=4.8e-4)
     parser.add_argument("--alpha", type=float, default=0.99,
@@ -357,6 +373,9 @@ def hparams_from_flags(flags) -> learner_lib.HParams:
         param_dtype=policy.param_dtype,
         opt_factored=getattr(flags, "factored_opt_state", False),
         opt_impl=getattr(flags, "opt_impl", "xla"),
+        loss=getattr(flags, "loss", "vtrace"),
+        impact_clip=getattr(flags, "impact_clip", 0.2),
+        replay_reuse=max(1, getattr(flags, "replay_reuse", 1) or 1),
     )
 
 
@@ -903,6 +922,11 @@ def train(flags):
     donate = "opt_only" if flags.overlap_collect else True
     n_dev = getattr(flags, "num_learner_devices", 1)
     K = superstep_k
+    # --replay_reuse K': every staged batch is dispatched K' times
+    # (IMPACT's sample reuse). Reused batches cannot be donated — the
+    # second dispatch would read a donated buffer — so batch donation
+    # stays a K'=1 optimization.
+    reuse = max(1, hp.replay_reuse)
     # A split with ONE learner device takes the plain-jit path below
     # pinned by explicit placement — a 1-device mesh would pull the
     # update through the SPMD partitioner for nothing (measured ~1.7x
@@ -935,7 +959,7 @@ def train(flags):
         # enforcement applies.
         update_step = make_parallel_update_step(
             model, optimizer, hp, mesh, donate=donate,
-            superstep_k=K, donate_batch=K > 1,
+            superstep_k=K, donate_batch=K > 1 and reuse == 1,
         )
         place_sub = lambda b, s: shard_batch(  # noqa: E731
             mesh,
@@ -954,7 +978,8 @@ def train(flags):
             # fresh copy nothing re-reads, so donate it (consume-once
             # deletion — learner.consume_staged_inputs).
             update_step = learner_lib.make_update_superstep(
-                model, optimizer, hp, K, donate=donate, donate_batch=True
+                model, optimizer, hp, K, donate=donate,
+                donate_batch=reuse == 1,
             )
         else:
             # No donate_batch: update_body emits no batch-shaped outputs
@@ -1018,6 +1043,36 @@ def train(flags):
         if use_mesh else {"data": 1, "model": 1},
     )
 
+    # IMPACT target network (--loss impact): full-precision params
+    # stamped every --target_refresh_updates updates ride the same
+    # versioned store class as replica serving snapshots — the
+    # "learner.target" namespace keeps its cadence out of the serving
+    # counters, and cast_bf16=False because the target forward must
+    # equal a forward of the exact stamped params.
+    target_store = None
+    target_forward = None
+    updates_done = 0
+    if hp.loss == "impact":
+        from torchbeast_tpu.serving.snapshot import PolicySnapshotStore
+
+        target_store = PolicySnapshotStore(
+            max(1, getattr(flags, "target_refresh_updates", 8) or 1),
+            registry=reg,
+            namespace="learner.target",
+            cast_bf16=False,
+        )
+        target_forward = learner_lib.make_target_forward(
+            model, superstep_k=K
+        )
+        # v0 before any update: the first batches train against the
+        # init params (ratio == 1, the V-trace-equivalent point).
+        target_store.publish(0, params)
+        log.info(
+            "IMPACT loss: target network refresh every %d updates, "
+            "replay reuse %d",
+            target_store.refresh_updates, reuse,
+        )
+
     pool = _make_pool(flags, B)
     # A failure between the pool spawn and the main try/finally
     # (collector priming, closure setup) must not leak the env
@@ -1066,9 +1121,18 @@ def train(flags):
         h_batch_size = reg.histogram("learner.batch_size")
         g_dispatch_q = reg.gauge("dispatch_queue.depth")
         g_sps = reg.gauge("learner.sps")
+        # env vs learn throughput split (ISSUE 18): env_sps counts
+        # unique environment frames; learn_sps counts frames consumed
+        # by updates — env_sps x replay_reuse in steady state.
+        # learner.sps stays the env-frame rate (back-compat).
+        g_env_sps = reg.gauge("learner.env_sps")
+        g_learn_sps = reg.gauge("learner.learn_sps")
+        reg.gauge("learner.sample_reuse").set(reuse)
         last_checkpoint_time = time.time()
         last_log_time = time.time()
         last_log_step = step
+        learn_step = step * reuse  # resume: exact split not persisted
+        last_log_learn_step = learn_step
 
         if flags.profile_dir:
             jax.profiler.start_trace(flags.profile_dir)
@@ -1115,6 +1179,32 @@ def train(flags):
             plogger.log(out)
             return out
 
+        def merge_target(placed_batch, placed_state):
+            """Thread the lagged target network's forward outputs into
+            the staged batch (learner.TARGET_*_KEY) — computed once per
+            FRESH batch and shared by all K' reuse dispatches, so the
+            target is held fixed across the reuse epochs (IMPACT's
+            contract). Identity under --loss vtrace."""
+            if target_forward is None:
+                return placed_batch
+            _, tparams = target_store.latest()
+            t_logits, t_base = target_forward(
+                tparams, placed_batch, placed_state
+            )
+            return {
+                **placed_batch,
+                learner_lib.TARGET_LOGITS_KEY: t_logits,
+                learner_lib.TARGET_BASELINE_KEY: t_base,
+            }
+
+        def maybe_refresh_target():
+            # Between reuse groups only — never mid-reuse, so every
+            # batch trains against exactly one target version.
+            if target_store is not None and target_store.note_update(
+                updates_done
+            ):
+                target_store.publish(updates_done, latest_params)
+
     except BaseException:
         pool.close()
         raise
@@ -1156,16 +1246,26 @@ def train(flags):
                         stacked, stacked_state = place_sub(
                             stacked, stacked_state
                         )
-                        for _ in range(K):
-                            h_batch_size.observe(flags.batch_size)
-                        latest_params, opt_state, train_stats = (
-                            update_step(
-                                latest_params, opt_state, stacked,
-                                stacked_state,
+                        stacked = merge_target(stacked, stacked_state)
+                        # --replay_reuse: the SAME placed batch is
+                        # dispatched K' times (donation is off for
+                        # K' > 1, so nothing invalidates the buffers);
+                        # env frames advance on the first pass only.
+                        for r in range(reuse):
+                            for _ in range(K):
+                                h_batch_size.observe(flags.batch_size)
+                            latest_params, opt_state, train_stats = (
+                                update_step(
+                                    latest_params, opt_state, stacked,
+                                    stacked_state,
+                                )
                             )
-                        )
-                        device_stats.append(train_stats)
-                        step += K * T * flags.batch_size
+                            device_stats.append(train_stats)
+                            updates_done += K
+                            if r == 0:
+                                step += K * T * flags.batch_size
+                            learn_step += K * T * flags.batch_size
+                        maybe_refresh_target()
                 else:
                     for i in range(0, B, flags.batch_size):
                         sub = {
@@ -1177,16 +1277,24 @@ def train(flags):
                             initial_agent_state,
                         )
                         sub, sub_state = place_sub(sub, sub_state)
+                        sub = merge_target(sub, sub_state)
                         # Actual sub-batch columns, not the flag (honest
                         # even while train() enforces divisibility).
-                        h_batch_size.observe(
-                            min(i + flags.batch_size, B) - i
-                        )
-                        latest_params, opt_state, train_stats = update_step(
-                            latest_params, opt_state, sub, sub_state
-                        )
-                        device_stats.append(train_stats)
-                        step += T * flags.batch_size
+                        cols = min(i + flags.batch_size, B) - i
+                        for r in range(reuse):
+                            h_batch_size.observe(cols)
+                            latest_params, opt_state, train_stats = (
+                                update_step(
+                                    latest_params, opt_state, sub,
+                                    sub_state,
+                                )
+                            )
+                            device_stats.append(train_stats)
+                            updates_done += 1
+                            if r == 0:
+                                step += T * flags.batch_size
+                            learn_step += T * flags.batch_size
+                        maybe_refresh_target()
             if not flags.overlap_collect:
                 params_cell[0] = place_act(latest_params)  # zero policy lag
             if pending is not None:
@@ -1198,8 +1306,14 @@ def train(flags):
             now = time.time()
             if now - last_log_time > 5:
                 sps = (step - last_log_step) / (now - last_log_time)
+                learn_sps = (learn_step - last_log_learn_step) / (
+                    now - last_log_time
+                )
                 last_log_time, last_log_step = now, step
+                last_log_learn_step = learn_step
                 g_sps.set(sps)
+                g_env_sps.set(sps)
+                g_learn_sps.set(learn_sps)
                 # Dispatched-unflushed UPDATES at this instant (the
                 # delayed-stats pipeline's real occupancy; a superstep
                 # dispatch holds K updates, so count K per entry).

@@ -1,4 +1,5 @@
 from torchbeast_tpu.ops import vtrace  # noqa: F401
+from torchbeast_tpu.ops.impact import impact_policy_losses  # noqa: F401
 from torchbeast_tpu.ops.losses import (  # noqa: F401
     compute_baseline_loss,
     compute_entropy_loss,

@@ -494,6 +494,25 @@ def make_parser():
     parser.add_argument("--discounting", type=float, default=0.99)
     parser.add_argument("--reward_clipping", default="abs_one",
                         choices=["abs_one", "none"])
+    parser.add_argument("--loss", default="vtrace",
+                        choices=["vtrace", "impact"],
+                        help="Objective family: IMPALA V-trace (the "
+                             "default) or the IMPACT clipped "
+                             "target-network surrogate (ops/impact.py) "
+                             "— lag-tolerant, unlocks --replay_reuse. "
+                             "Under impact the default "
+                             "--replica_refresh_updates relaxes ~10x "
+                             "(the surrogate absorbs the extra lag).")
+    parser.add_argument("--impact_clip", type=float, default=0.2,
+                        help="IMPACT surrogate clip epsilon "
+                             "(--loss impact).")
+    parser.add_argument("--replay_reuse", type=int, default=1,
+                        help="Consume each collected batch K' times "
+                             "(--loss impact; 1 = on-policy). The "
+                             "schedule clock scales with it.")
+    parser.add_argument("--target_refresh_updates", type=int, default=8,
+                        help="Refresh the IMPACT target network every "
+                             "N optimizer updates (--loss impact).")
     parser.add_argument("--learning_rate", type=float, default=4.8e-4)
     parser.add_argument("--alpha", type=float, default=0.99)
     parser.add_argument("--momentum", type=float, default=0.0)
@@ -508,6 +527,22 @@ def _reap_servers(procs):
     (the standalone CLI needs it too, without importing this module's
     jax surface)."""
     polybeast_env.reap_group(procs)
+
+
+def effective_replica_refresh_updates(flags):
+    """Resolved --replica_refresh_updates. An explicit value always
+    wins. Under --loss impact the DEFAULT relaxes to every 10 updates
+    (vs every update when a store is armed): the clipped surrogate
+    absorbs the extra policy lag, so snapshot publishes — and with a
+    fleet, the TAG_SNAPSHOT fanout that inherits this cadence — drop
+    ~10x. V-trace keeps the tight default (0: replica tier off,
+    split publishes every update)."""
+    explicit = getattr(flags, "replica_refresh_updates", 0) or 0
+    if explicit > 0:
+        return explicit
+    if getattr(flags, "loss", "vtrace") == "impact":
+        return 10
+    return 0
 
 
 def train(flags):
@@ -1122,6 +1157,10 @@ def train(flags):
             "infer_params": local_view(params, device=infer_device),
             "opt_state": opt_state,
             "step": step,
+            # Frames consumed by updates: env frames x --replay_reuse
+            # in steady state (resume: the exact split isn't persisted,
+            # so seed with the steady-state estimate).
+            "learn_step": step * max(1, hp.replay_reuse),
             "stats": dict(stats),
             "rng": jax.random.PRNGKey(flags.seed + host_rank),
             "done": False,
@@ -1131,6 +1170,35 @@ def train(flags):
         # buffers) against checkpoint reads of opt_state. Deliberately separate
         # from state_lock so the inference hot path never waits on a dispatch.
         donation_lock = threading.Lock()
+
+        # IMPACT target network (--loss impact): full-precision params
+        # stamped every --target_refresh_updates updates ride the same
+        # versioned store class as serving snapshots, under the
+        # "learner.target" namespace (its cadence never folds into the
+        # serving counters). cast_bf16=False: the target forward must
+        # equal a forward of the exact stamped params.
+        target_store = None
+        target_forward = None
+        if hp.loss == "impact":
+            from torchbeast_tpu.serving import PolicySnapshotStore
+
+            target_store = PolicySnapshotStore(
+                max(1, getattr(flags, "target_refresh_updates", 8) or 1),
+                registry=reg,
+                namespace="learner.target",
+                cast_bf16=False,
+            )
+            # v0 before any update: the first batches train against the
+            # init params (ratio == 1, the V-trace-equivalent point).
+            target_store.publish(0, params)
+            target_forward = learner_lib.make_target_forward(
+                model, superstep_k=superstep_k
+            )
+            log.info(
+                "IMPACT loss: target network refresh every %d updates, "
+                "replay reuse %d",
+                target_store.refresh_updates, max(1, hp.replay_reuse),
+            )
 
         # Native-first runtime (ISSUE 14 / ROADMAP item 1): the C++
         # pool by default; an absent or stale _tbt_core falls back to
@@ -1340,7 +1408,7 @@ def train(flags):
         sebulba = None
         snapshot_store = None
         native_slice_router = None
-        refresh_updates = getattr(flags, "replica_refresh_updates", 0) or 0
+        refresh_updates = effective_replica_refresh_updates(flags)
         if split is not None:
             from torchbeast_tpu.parallel.sebulba import (
                 build_sebulba_serving,
@@ -1940,6 +2008,15 @@ def train(flags):
             initial_agent_state = precision_lib.cast_batch(
                 item["initial_agent_state"], policy.batch_dtype
             )
+            if arena is not None and superstep_k == 1:
+                # --replay_reuse with K=1: the arena stages [1, T+1, B]
+                # stacks (its slots are what replay re-serves); the K=1
+                # update step consumes plain [T+1, B] batches, so strip
+                # the unit column axis here (a view, not a copy).
+                batch = jax.tree_util.tree_map(lambda a: a[0], batch)
+                initial_agent_state = jax.tree_util.tree_map(
+                    lambda a: a[0], initial_agent_state
+                )
             if shard is not None:
                 return shard(batch, initial_agent_state)
             return (
@@ -1953,9 +2030,14 @@ def train(flags):
         # slots are release-fenced: the learner releases each at its
         # stats flush (completion proven), so pool = prefetch depth + a
         # filling slot + the two dispatched-unflushed supersteps.
+        # --replay_reuse rides the SAME arena (K=1 gets a unit-column
+        # one): slots are re-served K' times before refill, each handout
+        # re-placed to fresh device buffers so batch donation stays
+        # legal.
         prefetch_depth = 2
         arena = None
-        if superstep_k > 1:
+        replay_reuse = max(1, hp.replay_reuse)
+        if superstep_k > 1 or replay_reuse > 1:
             from torchbeast_tpu.runtime.queues import BatchArena
 
             # Same series prefix as the queue: learner_queue.batch_size
@@ -1968,6 +2050,7 @@ def train(flags):
                 # columns — the write-through copy IS the cast, and the
                 # staged [K, T+1, B, ...] transfer is half-width.
                 float_dtype=policy.batch_dtype,
+                replay_reuse=replay_reuse,
             )
         prefetcher = DevicePrefetcher(
             learner_queue, _place, depth=prefetch_depth,
@@ -2024,12 +2107,30 @@ def train(flags):
                     if not prefetcher.is_alive():
                         break
                     continue
-                if superstep_k > 1:
+                if arena is not None:
                     (batch, initial_agent_state), release = staged
                 else:
                     batch, initial_agent_state = staged
                     release = None
+                # Replay handouts (BatchArena re-serving a slot under
+                # --replay_reuse) carry release.fresh == False: they
+                # advance the LEARN clock but not the env-frame clock.
+                fresh = release is None or getattr(release, "fresh", True)
                 timings.time("dequeue")
+                if target_forward is not None:
+                    # Lagged target-network forward, threaded into the
+                    # batch under the learner.TARGET_*_KEYs (computed
+                    # per dispatch: replay handouts see the CURRENT
+                    # target, same as fresh ones).
+                    _, tparams = target_store.latest()
+                    t_logits, t_base = target_forward(
+                        tparams, batch, initial_agent_state
+                    )
+                    batch = {
+                        **batch,
+                        learner_lib.TARGET_LOGITS_KEY: t_logits,
+                        learner_lib.TARGET_BASELINE_KEY: t_base,
+                    }
                 if throttle is not None:
                     # Chaos learner_stall gate: models the busy-chip
                     # stall at the dispatch site (no-op unarmed).
@@ -2055,8 +2156,16 @@ def train(flags):
                         state["params"], state["opt_state"] = new_params, new_opt
                         state["infer_params"] = infer_view
                         # Global frames: every host ran this collective
-                        # dispatch of superstep_k updates.
-                        state["step"] += (
+                        # dispatch of superstep_k updates. Replay
+                        # handouts re-consume frames already counted —
+                        # only the learn clock moves for them.
+                        if fresh:
+                            state["step"] += (
+                                superstep_k
+                                * flags.unroll_length
+                                * flags.batch_size
+                            )
+                        state["learn_step"] += (
                             superstep_k
                             * flags.unroll_length
                             * flags.batch_size
@@ -2064,6 +2173,15 @@ def train(flags):
                         now_step = state["step"]
                 watchdog.ping()
                 updates_done += superstep_k
+                if target_store is not None and target_store.note_update(
+                    updates_done
+                ):
+                    # Full-precision target refresh (the store copies
+                    # the tree, so the next dispatch's donation of
+                    # these params cannot invalidate the snapshot).
+                    with state_lock:
+                        params_now = state["params"]
+                    target_store.publish(updates_done, params_now)
                 if fleet_coord is not None and strategy == "wire":
                     # DCN param composition (wire strategy): one
                     # synchronous fleet-mean round per dispatch — the
@@ -2159,6 +2277,7 @@ def train(flags):
         degraded_dead = 0  # dead-actor count already reported
         last_checkpoint = time.time()
         last_step, last_time = state["step"], time.time()
+        last_learn_step = state["learn_step"]
         while not state["done"]:
             # A halt cuts the monitor sleep short: HALTED must reach
             # the checkpoint-and-exit path now, not a tick later.
@@ -2236,14 +2355,26 @@ def train(flags):
                 break
             with state_lock:
                 now_step = state["step"]
+                now_learn_step = state["learn_step"]
                 stats_now = dict(state["stats"])
             now = time.time()
             sps = (now_step - last_step) / (now - last_time)
+            learn_sps = (now_learn_step - last_learn_step) / (
+                now - last_time
+            )
             last_step, last_time = now_step, now
+            last_learn_step = now_learn_step
             if telemetry_on:
                 # Gauges set here (not in the queues) also cover the
                 # native runtime, whose C++ queues carry no instruments.
                 reg.gauge("learner.sps").set(sps)
+                # env vs learn throughput split (ISSUE 18): env_sps
+                # counts unique env frames (== learner.sps, kept for
+                # back-compat); learn_sps counts frames consumed by
+                # updates — env_sps x --replay_reuse in steady state.
+                reg.gauge("learner.env_sps").set(sps)
+                reg.gauge("learner.learn_sps").set(learn_sps)
+                reg.gauge("learner.sample_reuse").set(replay_reuse)
                 reg.gauge("learner_queue.depth").set(learner_queue.size())
                 reg.gauge("inference.depth").set(serving_depth_fn())
                 tele.write(extra={"step": now_step})

@@ -261,13 +261,21 @@ class BatchingQueue:
 
 class _ArenaSlot:
     """One preallocated arena: per-leaf [K, ...] numpy arrays + a
-    free/busy latch. Released (reusable) only via its release()."""
+    free/busy latch. Released (reusable) only via its release().
 
-    __slots__ = ("arrays", "free")
+    Replay bookkeeping (--replay_reuse): `uses_left` counts the replay
+    handouts this filled slot still owes, `outstanding` the handouts
+    not yet released. The slot is free for rewrite only when BOTH hit
+    zero — the reuse-counter fence that replaces the single
+    release-flips-free latch."""
+
+    __slots__ = ("arrays", "free", "uses_left", "outstanding")
 
     def __init__(self):
         self.arrays = None  # lazily allocated from the first item
         self.free = True
+        self.uses_left = 0  # guarded-by: arena._free
+        self.outstanding = 0  # guarded-by: arena._free
 
 
 class BatchArena:
@@ -302,6 +310,16 @@ class BatchArena:
     and with it the host->device transfer, is half-width with zero
     extra passes. Non-f32 leaves (uint8 frames, ints, bools) are
     untouched. The learner upcasts at point of use (f32-accumulate).
+
+    Circular replay (`replay_reuse` K' > 1, --loss impact): after a
+    fresh fill, the SAME slot is handed out K'-1 more times WITHOUT
+    draining the queue — sample reuse as slot re-release. Each handout
+    carries its own release() (stamped `release.fresh`: True for the
+    queue-draining fill, False for replays) and the slot's rewrite
+    fence holds until every handout is released AND the replay quota is
+    spent — a slot is never rewritten mid-reuse. At K'=1 the behavior
+    (and the staged bytes) are bit-identical to the original
+    single-release arena.
     """
 
     def __init__(
@@ -313,6 +331,7 @@ class BatchArena:
         grow_timeout_s: float = 5.0,
         telemetry_name: Optional[str] = None,
         float_dtype=None,
+        replay_reuse: int = 1,
     ):
         if k < 1:
             raise ValueError(f"superstep k must be >= 1, got {k}")
@@ -322,6 +341,10 @@ class BatchArena:
             # One slot filling + at least one staged/consumed: fewer
             # would force a grow on every superstep.
             raise ValueError(f"arena pool must be >= 2, got {pool}")
+        if replay_reuse < 1:
+            raise ValueError(
+                f"replay_reuse must be >= 1, got {replay_reuse}"
+            )
         self._k = k
         self._rows = rows
         self._batch_dim = batch_dim
@@ -329,10 +352,13 @@ class BatchArena:
             np.dtype(float_dtype) if float_dtype is not None else None
         )
         self._grow_timeout_s = grow_timeout_s
+        self._replay_reuse = replay_reuse
+        self._replay_slot = None  # guarded-by: self._free
         self._slots = [_ArenaSlot() for _ in range(pool)]
         self._free = threading.Condition(threading.Lock())
         self._template = None  # nest structure of the first item
         self._tm_assemble = self._tm_batch_size = None
+        self._tm_occupancy = None
         if telemetry_name:
             reg = telemetry.get_registry()
             self._tm_assemble = reg.histogram(
@@ -340,6 +366,16 @@ class BatchArena:
             )
             self._tm_batch_size = reg.histogram(
                 f"{telemetry_name}.batch_size"
+            )
+            self._tm_occupancy = reg.gauge(
+                f"{telemetry_name}.occupancy"
+            )
+
+    def _set_occupancy(self):
+        # Caller holds self._free.
+        if self._tm_occupancy is not None:
+            self._tm_occupancy.set(
+                sum(1 for slot in self._slots if not slot.free)
             )
 
     def _acquire_slot(self) -> _ArenaSlot:
@@ -349,6 +385,7 @@ class BatchArena:
                 for slot in self._slots:
                     if slot.free:
                         slot.free = False
+                        self._set_occupancy()
                         return slot
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -367,15 +404,33 @@ class BatchArena:
         slot.free = False
         with self._free:
             self._slots.append(slot)
+            self._set_occupancy()
         return slot
 
-    def _release_fn(self, slot: _ArenaSlot):
+    def _release_fn(self, slot: _ArenaSlot, fresh: bool = True):
         def release():
             with self._free:
-                slot.free = True
-                self._free.notify()
+                slot.outstanding = max(0, slot.outstanding - 1)
+                if slot.outstanding == 0 and slot.uses_left == 0:
+                    slot.free = True
+                    self._set_occupancy()
+                    self._free.notify()
 
+        release.fresh = fresh
         return release
+
+    def _abort_slot(self, slot: _ArenaSlot):
+        """Drop a slot whose fill raised: a partial fill must never be
+        replayed, so the replay quota and handout count reset before
+        the slot frees."""
+        with self._free:
+            if self._replay_slot is slot:
+                self._replay_slot = None
+            slot.uses_left = 0
+            slot.outstanding = 0
+            slot.free = True
+            self._set_occupancy()
+            self._free.notify()
 
     def _allocate(self, slot: _ArenaSlot, item_leaves: List[np.ndarray]):
         bd = self._batch_dim
@@ -398,8 +453,24 @@ class BatchArena:
         drained from `queue`; returns (stacked_nest, release). Raises
         StopIteration when the queue closes — a partially filled arena
         is dropped (a fixed-K scan cannot consume it) and its slot
-        released."""
+        released.
+
+        With replay_reuse K' > 1 the last fresh fill is handed out
+        again (no queue drain) until its K'-fold quota is spent;
+        `release.fresh` says which kind this handout was."""
         t0 = time.perf_counter() if self._tm_assemble is not None else 0.0
+        with self._free:
+            replay = self._replay_slot
+            if replay is not None:
+                replay.uses_left -= 1
+                replay.outstanding += 1
+                if replay.uses_left == 0:
+                    self._replay_slot = None
+        if replay is not None:
+            return (
+                nest.pack_as(self._template, replay.arrays),
+                self._release_fn(replay, fresh=False),
+            )
         slot = self._acquire_slot()
         bd = self._batch_dim
         batch_idx, col = 0, 0
@@ -434,8 +505,13 @@ class BatchArena:
                     "BatchArena: dropping %d assembled rows (source "
                     "closed mid-superstep)", dropped,
                 )
-            self._release_fn(slot)()
+            self._abort_slot(slot)
             raise
+        with self._free:
+            slot.uses_left = self._replay_reuse - 1
+            slot.outstanding = 1
+            if slot.uses_left > 0:
+                self._replay_slot = slot
         if self._tm_assemble is not None:
             self._tm_assemble.observe(time.perf_counter() - t0)
         return nest.pack_as(self._template, slot.arrays), self._release_fn(

@@ -50,19 +50,39 @@ def bf16_cast(params: Any) -> Tuple[Any, Any]:
 
 
 class PolicySnapshotStore:
-    def __init__(self, refresh_updates: int, registry=None):
+    """`namespace` prefixes every instrument series (default "serving",
+    the replica/slice store) so a second store in the same process —
+    the IMPACT target network rides this class as "learner.target" —
+    never folds its publish cadence into the serving counters.
+
+    `cast_bf16=False` publishes FULL-PRECISION params (the target
+    network's case: the target forward must equal a forward of the
+    exact stamped params, and bf16 rounding is a publication format for
+    the wire/replica path, not a training-side contract)."""
+
+    def __init__(
+        self,
+        refresh_updates: int,
+        registry=None,
+        namespace: str = "serving",
+        cast_bf16: bool = True,
+    ):
         if refresh_updates < 1:
             raise ValueError(
                 f"refresh_updates must be >= 1, got {refresh_updates}"
             )
         self.refresh_updates = refresh_updates
+        self._cast_bf16 = cast_bf16
         reg = registry if registry is not None else telemetry.get_registry()
-        self._c_published = reg.counter("serving.snapshots_published")
-        self._c_refresh_failures = reg.counter(
-            "serving.snapshot_refresh_failures"
+        self._c_published = reg.counter(f"{namespace}.snapshots_published")
+        self._c_bytes_published = reg.counter(
+            f"{namespace}.snapshot_bytes_published"
         )
-        self._g_version = reg.gauge("serving.snapshot_version")
-        self._g_lag = reg.gauge("serving.snapshot_lag")
+        self._c_refresh_failures = reg.counter(
+            f"{namespace}.snapshot_refresh_failures"
+        )
+        self._g_version = reg.gauge(f"{namespace}.snapshot_version")
+        self._g_lag = reg.gauge(f"{namespace}.snapshot_lag")
         self._lock = threading.Lock()
         self._head = 0  # guarded-by: self._lock
         self._version = -1  # guarded-by: self._lock (-1: nothing published)
@@ -105,7 +125,18 @@ class PolicySnapshotStore:
         if drop:
             self._c_refresh_failures.inc()
             return False
-        bf16, dtypes = bf16_cast(params)
+        if self._cast_bf16:
+            bf16, dtypes = bf16_cast(params)
+        else:
+            import jax
+            import jax.numpy as jnp
+
+            # Full-precision publication COPIES the tree: the learner
+            # donates its params buffers into the next update dispatch,
+            # and a snapshot must outlive that. (The bf16 branch copies
+            # implicitly via astype.)
+            bf16 = jax.tree_util.tree_map(jnp.copy, params)
+            dtypes = jax.tree_util.tree_map(lambda a: a.dtype, params)
         with self._lock:
             self._version = version
             self._head = max(self._head, version)
@@ -113,6 +144,17 @@ class PolicySnapshotStore:
             self._dtypes = dtypes
             self._restored = None
         self._c_published.inc()
+        # The measurable side of the refresh cadence: bytes of the
+        # published tree per refresh (what --loss impact's relaxed
+        # --replica_refresh_updates default cuts ~10x).
+        import jax
+
+        self._c_bytes_published.inc(
+            sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(bf16)
+            )
+        )
         self._g_version.set(version)
         self._g_lag.set(0)
         return True
