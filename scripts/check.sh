@@ -1,10 +1,11 @@
 #!/bin/bash
 # The one-command merge gate (ISSUE 10): native build + C++ test suites
 # (plain AND under TSan) + the Python extension, then the full static
-# analysis lane — repo-wide beastlint in CI mode (15 rules incl. the
-# C++ frontend), the rule-fixture selftest, and the exhaustive
-# shm-protocol model check (shipped spec verifies; seeded mutants must
-# produce counterexample traces).
+# analysis lane — repo-wide beastlint in CI mode (18 rules incl. the
+# C++ frontend and the fleet/telemetry tier), the rule-fixture
+# selftest, and the exhaustive model checks for both protocol specs
+# (shm ring + doorbell, and the fleet control plane; shipped specs
+# verify, seeded mutants must produce counterexample traces).
 #
 #   scripts/check.sh            # everything
 #   scripts/check.sh --fast     # skip the native build (analysis only)
@@ -47,6 +48,9 @@ python -m torchbeast_tpu.analysis --selftest
 
 echo "== check: protocol model check (shm ring + doorbell)"
 python -m torchbeast_tpu.analysis --check-protocol
+
+echo "== check: fleet protocol model check (control plane under crash/wedge)"
+python -m torchbeast_tpu.analysis --check-fleet
 
 if [[ "$FAST" -eq 0 ]]; then
     echo "== check: chaos selftest, scaled (x2 fleet + x2 fault plan, shed audit)"

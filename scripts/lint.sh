@@ -17,4 +17,7 @@ set -euo pipefail
 # rev-parse, not dirname: invoked as .git/hooks/pre-commit (a symlink),
 # $0's directory is .git/hooks/ and dirname does not resolve symlinks.
 cd "$(git rev-parse --show-toplevel)"
-exec python -m torchbeast_tpu.analysis --ci --diff "${1:-HEAD}"
+# --timing: per-rule wall-clock after the report, so a rule whose cost
+# regresses shows up in the pre-commit output instead of silently
+# eating the CI budget.
+exec python -m torchbeast_tpu.analysis --ci --timing --diff "${1:-HEAD}"

@@ -1116,17 +1116,19 @@ class TestSelftestAndGate:
             "LOCK-DISCIPLINE", "EXCEPT-SWALLOW", "WIRE-PARITY",
             "ROUTE-PARITY", "FLAG-PARITY", "RACE", "LOCK-ORDER",
             "HOTPATH-SYNC-XPROC", "GIL-DISCIPLINE", "ATOMIC-ORDER",
-            "CXX-LOCK-DISCIPLINE",
+            "CXX-LOCK-DISCIPLINE", "FLEET-MSG-PARITY",
+            "FLEET-TIMEOUT-DISCIPLINE", "TELEMETRY-SCHEMA",
         }
         for name, checks in verdict["rules"].items():
             assert checks["positive"] and checks["clean"], (name, checks)
             assert checks["isolated"], (name, checks)
 
-    def test_list_rules_shows_all_fifteen(self):
-        """The 11 -> 14 -> 15 rule invariant (ISSUE 10; ROUTE-PARITY
-        joined in ISSUE 16): every registered rule appears in
-        --list-rules, and every listed rule has a selftest fixture pair
-        (the selftest set and the registry agree)."""
+    def test_list_rules_shows_all_eighteen(self):
+        """The 11 -> 14 -> 15 -> 18 rule invariant (ISSUE 10;
+        ROUTE-PARITY joined in ISSUE 16; the fleet tier in ISSUE 20):
+        every registered rule appears in --list-rules, and every listed
+        rule has a selftest fixture pair (the selftest set and the
+        registry agree)."""
         proc = subprocess.run(
             [sys.executable, "-m", "torchbeast_tpu.analysis",
              "--list-rules"],
@@ -1137,7 +1139,7 @@ class TestSelftestAndGate:
         listed = {
             line.split()[0] for line in proc.stdout.splitlines() if line
         }
-        assert len(listed) == 15, sorted(listed)
+        assert len(listed) == 18, sorted(listed)
         verdict = run_selftest()
         assert listed == set(verdict["rules"]), (
             listed ^ set(verdict["rules"])

@@ -1,0 +1,499 @@
+"""beastlint v4 (ISSUE 20): the distributed-systems tier — fleet
+message parity, timeout discipline, the telemetry-schema registry, and
+the exhaustive fleet control-plane model checker behind `--check-fleet`.
+
+The conformance tests are the acceptance contract: the shipped spec
+must verify clean on every scenario, every seeded protocol mutation
+must produce a counterexample trace (a checker that cannot fail proves
+nothing), and the spec constants must pin against the REAL
+fleet/coordinator.py — drift the source and the pin test fails."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchbeast_tpu import analysis
+from torchbeast_tpu.analysis import analyze_sources
+from torchbeast_tpu.analysis import config as lint_config
+from torchbeast_tpu.analysis import fleetproto, fleetrules
+from torchbeast_tpu.analysis.fleetrules import FLEET_RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COORD = "torchbeast_tpu/fleet/coordinator.py"
+SNAP_WIRE = "torchbeast_tpu/fleet/snapshot_wire.py"
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _fleet(sources):
+    return analyze_sources(sources, repo_rules=list(FLEET_RULES))
+
+
+def _rules(report, name):
+    return [f for f in report.findings if f.rule == name]
+
+
+# ---------------------------------------------------------------------------
+# FLEET-MSG-PARITY
+
+
+class TestMsgParity:
+    # Seeds every finding class: "claim" sent with no handler, "grant"
+    # handled but never sent, "sync" packs "extra" nobody reads and its
+    # handler reads "missing" nobody packs.
+    SRC = '''
+class Coordinator:
+    def _push(self):
+        self._send(0, {"type": "claim", "rank": 1, "epoch": 3})
+        self._broadcast({"type": "sync", "extra": 1, "round": 2})
+
+    def _handle(self, rank, msg):
+        kind = msg.get("type")
+        if kind == "grant":
+            pass
+        elif kind == "sync":
+            self._on_sync(msg)
+
+    def _on_sync(self, msg):
+        return msg.get("round"), msg.get("missing")
+'''
+
+    def test_all_four_finding_classes(self):
+        found = _rules(_fleet({COORD: self.SRC}), "FLEET-MSG-PARITY")
+        msgs = "\n".join(f.message for f in found)
+        assert "'claim'" in msgs and "no lead-side handler" in msgs
+        assert "packs field 'extra'" in msgs
+        assert "handler arm for message type 'grant'" in msgs
+        assert "reads field 'missing'" in msgs
+        assert len(found) == 4, msgs
+
+    def test_standard_fields_exempt(self):
+        # "rank" rides every message unread by the dispatch arm itself;
+        # the envelope fields never count as skew.
+        found = _rules(_fleet({COORD: self.SRC}), "FLEET-MSG-PARITY")
+        assert not any("'rank'" in f.message for f in found)
+
+    def test_clean_twin_quiet(self):
+        src = '''
+class Coordinator:
+    def _push(self):
+        self._broadcast({"type": "sync", "round": 2})
+
+    def _ack(self):
+        payload = {"type": "claim", "rank": 1, "epoch": 3}
+        self._send(0, payload)
+
+    def _handle(self, rank, msg):
+        kind = msg.get("type")
+        if kind == "claim":
+            self._on_claim(msg)
+        elif kind == "sync":
+            self._on_sync(msg)
+
+    def _on_claim(self, msg):
+        return msg.get("epoch")
+
+    def _on_sync(self, msg):
+        return msg["round"]
+'''
+        assert not _rules(_fleet({COORD: src}), "FLEET-MSG-PARITY")
+
+    def test_role_mismatch_flagged(self):
+        # Broadcast reaches remotes; a handler that only runs on the
+        # lead does not receive it.
+        src = '''
+class Coordinator:
+    def _push(self):
+        self._broadcast({"type": "sync", "round": 1})
+
+    def _start_lead(self, msg):
+        kind = msg.get("type")
+        if kind == "sync":
+            return msg.get("round")
+'''
+        found = _rules(_fleet({COORD: src}), "FLEET-MSG-PARITY")
+        assert any("no remote-side handler" in f.message for f in found)
+
+    def test_partial_scan_without_anchor_is_silent(self):
+        report = _fleet({"torchbeast_tpu/fleet/other.py": self.SRC})
+        assert not _rules(report, "FLEET-MSG-PARITY")
+
+    def test_suppression_with_reason(self):
+        src = self.SRC.replace(
+            'self._send(0, {"type": "claim", "rank": 1, "epoch": 3})',
+            'self._send(0, {"type": "claim", "rank": 1, "epoch": 3})'
+            "  # beastlint: disable=FLEET-MSG-PARITY  fixture",
+        )
+        report = _fleet({COORD: src})
+        found = _rules(report, "FLEET-MSG-PARITY")
+        assert not any("'claim'" in f.message for f in found)
+        assert any(
+            f.rule == "FLEET-MSG-PARITY" for f, _ in report.suppressed
+        )
+
+    def test_extractors_on_the_real_coordinator(self):
+        import ast
+
+        tree = ast.parse(_read(COORD))
+        sent = {s.msg_type
+                for s in fleetrules.extract_send_sites(tree)}
+        handled = {a.msg_type
+                   for a in fleetrules.extract_handler_arms(tree)}
+        assert sent == set(fleetproto.MSG_TYPES)
+        assert handled == set(fleetproto.MSG_TYPES)
+
+
+# ---------------------------------------------------------------------------
+# FLEET-TIMEOUT-DISCIPLINE
+
+
+class TestTimeoutDiscipline:
+    PATH = "torchbeast_tpu/fleet/fixture_ctl.py"
+
+    # One violation per blocking-op class.
+    SRC = '''
+def serve(sock):
+    conn, _ = sock.accept()
+    conn.settimeout(None)
+    return conn
+
+def pump(t, cv, worker):
+    msg = t.recv()
+    cv.wait()
+    worker.join()
+    return msg
+
+def dial(address):
+    return dial_transport(address)
+'''
+
+    def test_each_blocking_class_flagged(self):
+        found = _rules(
+            _fleet({self.PATH: self.SRC}), "FLEET-TIMEOUT-DISCIPLINE"
+        )
+        assert len(found) == 6, [f.render() for f in found]
+        assert all("no deadline" in f.message for f in found)
+
+    def test_clean_twin_quiet(self):
+        src = '''
+def serve(sock):
+    sock.settimeout(5.0)
+    conn, _ = sock.accept()
+    return conn
+
+def pump(t, cv, worker):
+    # unbounded-by-design: reader EOF is this fixture's loss detector
+    msg = t.recv()
+    cv.wait(1.0)
+    worker.join(2.0)
+    return msg
+
+def dial(address):
+    return dial_transport(address, deadline_s=10.0)
+'''
+        assert not _rules(
+            _fleet({self.PATH: src}), "FLEET-TIMEOUT-DISCIPLINE"
+        )
+
+    def test_trailing_annotation_covers_the_op(self):
+        src = (
+            "def pump(t):\n"
+            "    return t.recv()"
+            "  # unbounded-by-design: EOF drives loss detection\n"
+        )
+        assert not _rules(
+            _fleet({self.PATH: src}), "FLEET-TIMEOUT-DISCIPLINE"
+        )
+
+    def test_annotation_must_be_adjacent(self):
+        # A standalone annotation two lines up covers nothing.
+        src = (
+            "def pump(t):\n"
+            "    # unbounded-by-design: EOF drives loss detection\n"
+            "\n"
+            "    return t.recv()\n"
+        )
+        found = _rules(
+            _fleet({self.PATH: src}), "FLEET-TIMEOUT-DISCIPLINE"
+        )
+        assert len(found) == 1
+
+    def test_reasonless_annotation_is_itself_a_finding(self):
+        src = (
+            "def pump(t):\n"
+            "    # unbounded-by-design:\n"
+            "    return t.recv()\n"
+        )
+        found = _rules(
+            _fleet({self.PATH: src}), "FLEET-TIMEOUT-DISCIPLINE"
+        )
+        assert len(found) == 1
+        assert "without a reason" in found[0].message
+
+    def test_outside_fleet_not_scanned(self):
+        report = _fleet({"torchbeast_tpu/runtime/fixture_ctl.py":
+                         self.SRC})
+        assert not _rules(report, "FLEET-TIMEOUT-DISCIPLINE")
+
+
+# ---------------------------------------------------------------------------
+# TELEMETRY-SCHEMA
+
+
+class TestTelemetrySchema:
+    PATH = "torchbeast_tpu/runtime/fixture_tele.py"
+
+    def test_grammar_violations(self):
+        src = (
+            "def setup(reg):\n"
+            '    reg.counter("BadName")\n'
+            '    reg.gauge("queue")\n'
+        )
+        found = _rules(_fleet({self.PATH: src}), "TELEMETRY-SCHEMA")
+        assert len(found) == 2
+        assert all("naming" in f.message for f in found)
+
+    def test_fold_prefix_reserved(self):
+        src = (
+            "def setup(reg, rank):\n"
+            '    reg.gauge(f"host{rank}.queue.depth")\n'
+        )
+        found = _rules(_fleet({self.PATH: src}), "TELEMETRY-SCHEMA")
+        assert len(found) == 1 and "fold" in found[0].message
+        # The lead's telemetry folder is allowed to fold.
+        fold_path = lint_config.TELEMETRY_FOLD_FILES[0]
+        assert not _rules(_fleet({fold_path: src}), "TELEMETRY-SCHEMA")
+
+    def test_kind_conflict(self):
+        src = (
+            "def setup(reg):\n"
+            '    reg.counter("queue.depth")\n'
+            '    reg.gauge("queue.depth")\n'
+        )
+        found = _rules(_fleet({self.PATH: src}), "TELEMETRY-SCHEMA")
+        assert len(found) == 1 and "kind conflict" in found[0].message
+
+    def test_fstring_hole_becomes_wildcard_and_passes_grammar(self):
+        src = (
+            "def setup(reg, i):\n"
+            '    reg.histogram(f"inference.slice.{i}.depth")\n'
+        )
+        assert not _rules(_fleet({self.PATH: src}), "TELEMETRY-SCHEMA")
+
+    def test_outside_scan_paths_ignored(self):
+        src = 'def setup(reg):\n    reg.counter("BadName")\n'
+        report = _fleet({"tests/fixture_tele.py": src})
+        assert not _rules(report, "TELEMETRY-SCHEMA")
+
+    def test_patterns_overlap(self):
+        overlap = fleetrules.patterns_overlap
+        assert overlap("queue.depth", "queue.depth")
+        assert overlap("queue.*.depth", "queue.in.depth")
+        # A bare `*` hole can expand to a dotted name.
+        assert overlap("fleet.*", "fleet.snapshots_stale_dropped")
+        assert overlap("host*.queue.depth", "host3.queue.depth")
+        assert not overlap("queue.depth", "queue.items")
+
+    CONSUME = {
+        "torchbeast_tpu/telemetry/metrics.py": (
+            'def mk(reg):\n    reg.counter("recovery.restarts")\n'
+        ),
+        "scripts/chaos_run.py": (
+            "def verdict(counters):\n"
+            '    return counters.get("recovery.ghosts", 0)\n'
+        ),
+        "tests/test_telemetry.py": (
+            "def check(snap):\n"
+            '    return snap["counters"]["recovery.restarts"]\n'
+        ),
+    }
+
+    def test_consumed_but_never_emitted(self):
+        found = _rules(_fleet(self.CONSUME), "TELEMETRY-SCHEMA")
+        assert len(found) == 1
+        assert "'recovery.ghosts'" in found[0].message
+        assert found[0].path == "scripts/chaos_run.py"
+
+    def test_consumption_check_gated_on_full_scan(self):
+        # Without the sentinel file the scan is partial — a ghost read
+        # must NOT fire (--diff mode would false-positive otherwise).
+        partial = {
+            p: s for p, s in self.CONSUME.items()
+            if p != lint_config.TELEMETRY_SENTINEL_FILE
+        }
+        assert not _rules(_fleet(partial), "TELEMETRY-SCHEMA")
+
+
+# ---------------------------------------------------------------------------
+# The fleet control-plane model checker
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return fleetproto.verify_shipped_and_mutants(root=REPO)
+
+
+class TestFleetChecker:
+    def test_shipped_spec_verifies_on_every_scenario(self):
+        for scenario in fleetproto.SCENARIOS:
+            res = fleetproto.check_fleet(fleetproto.Spec(), scenario)
+            assert res.ok, (scenario.name, res.as_dict())
+            assert res.states > 0
+            assert res.properties == {
+                "error_free": True, "no_wedge": True,
+                "halt_propagation": True, "terminal_reachable": True,
+            }
+
+    def test_every_seeded_mutant_is_caught_with_a_trace(self, bundle):
+        assert set(bundle["mutants"]) == set(fleetproto.MUTATIONS)
+        for name, m in bundle["mutants"].items():
+            assert m["caught"], name
+            assert m["counterexample"]["trace"], name
+
+    def test_no_sync_deadline_wedges_the_barrier(self):
+        """The checker's reason for existing: a wedged host is invisible
+        to reader-EOF loss detection, so without the sync deadline both
+        sides of the averaging barrier park forever."""
+        res = fleetproto.check_fleet(
+            fleetproto.MUTATIONS["no_sync_deadline"],
+            fleetproto.SCENARIOS[0],
+        )
+        assert not res.properties["no_wedge"]
+        wedges = [v for v in res.violations if v.kind == "wedge"]
+        assert wedges and wedges[0].trace
+
+    def test_no_halt_broadcast_strands_survivors(self):
+        # Needs n=3 floor=3: a loss halts the lead while a live
+        # survivor exists to (not) hear about it.
+        res = fleetproto.check_fleet(
+            fleetproto.MUTATIONS["no_halt_broadcast"],
+            fleetproto.SCENARIOS[1],
+        )
+        assert not res.properties["halt_propagation"]
+
+    def test_acting_through_halt_is_a_safety_error(self):
+        res = fleetproto.check_fleet(
+            fleetproto.MUTATIONS["act_through_halt"],
+            fleetproto.SCENARIOS[0],
+        )
+        errors = [v for v in res.violations if v.kind == "error"]
+        assert any("acting step after" in v.detail for v in errors)
+
+    def test_no_snapshot_guard_breaks_monotonicity(self):
+        res = fleetproto.check_fleet(
+            fleetproto.MUTATIONS["no_snapshot_guard"],
+            fleetproto.SCENARIOS[0],
+        )
+        errors = [v for v in res.violations if v.kind == "error"]
+        assert any("monotonicity" in v.detail for v in errors)
+
+    def test_degrade_scenario_continues_without_halt(self):
+        # n=3 floor=1: a single loss shrinks the barrier and the fleet
+        # runs on — the shipped spec must still verify there.
+        res = fleetproto.check_fleet(
+            fleetproto.Spec(), fleetproto.SCENARIOS[2]
+        )
+        assert res.ok, res.as_dict()
+
+    def test_state_cap_raises_instead_of_truncating(self):
+        with pytest.raises(RuntimeError, match="state space"):
+            fleetproto.check_fleet(max_states=10)
+
+    def test_render_trace_format(self):
+        res = fleetproto.check_fleet(
+            fleetproto.MUTATIONS["act_through_halt"],
+            fleetproto.SCENARIOS[0],
+        )
+        text = fleetproto.render_trace(res.violations[0])
+        lines = text.splitlines()
+        assert lines[0].strip().startswith("1. ")
+        assert lines[-1].strip().startswith("=> ERROR:")
+
+
+class TestConformance:
+    def test_pins_hold_against_the_real_source(self, bundle):
+        conf = bundle["conformance"]
+        assert conf["ok"], conf
+        assert set(conf["pins"]) == {
+            "message_tags", "sync_timeout_positive",
+            "_sync_lead_deadline", "_sync_remote_deadline",
+            "floor_halts_and_broadcasts", "lead_loss_halts",
+            "snapshot_stale_guard",
+        }
+
+    def test_drifted_source_fails_its_pin(self, tmp_path):
+        """Disarm the default sync deadline in a copy of the real
+        coordinator: the model's no-wedge proof no longer describes the
+        shipped default, and the pin must catch it."""
+        fleet = tmp_path / "torchbeast_tpu" / "fleet"
+        fleet.mkdir(parents=True)
+        src = _read(COORD)
+        assert "sync_timeout_s: float = 30.0" in src
+        (fleet / "coordinator.py").write_text(src.replace(
+            "sync_timeout_s: float = 30.0",
+            "sync_timeout_s: float = 0.0",
+        ))
+        (fleet / "snapshot_wire.py").write_text(_read(SNAP_WIRE))
+        verdict = fleetproto.check_conformance(str(tmp_path))
+        assert not verdict["ok"]
+        assert not verdict["pins"]["sync_timeout_positive"]["ok"]
+        assert verdict["pins"]["message_tags"]["ok"]
+
+    def test_acceptance_bundle(self, bundle):
+        assert bundle["ok"], bundle
+        assert all(
+            s["ok"] for s in bundle["scenarios"].values()
+        )
+        assert sum(
+            s["states"] for s in bundle["scenarios"].values()
+        ) > 1000
+
+
+class TestCliAndRepoHygiene:
+    def test_cli_check_fleet(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchbeast_tpu.analysis",
+             "--check-fleet"],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        verdict = json.loads(proc.stdout.splitlines()[0])
+        assert verdict["ok"]
+        assert verdict["protocol"] == "fleet-control-plane"
+        assert verdict["explored_states_total"] > 1000
+        assert all(m["caught"] for m in verdict["mutants"].values())
+        assert all(verdict["conformance"].values())
+        assert "counterexample" in proc.stdout
+
+    def test_fleet_tier_zero_findings_on_the_repo(self):
+        """The repo itself is clean under the three new rules — every
+        real finding they surfaced was fixed (or suppressed in-line
+        with a reason) in this PR, and the baseline stays empty."""
+        files = analysis.discover_files([REPO], REPO)
+        contexts = [
+            c for c in (analysis.load_context(f, REPO) for f in files)
+            if c
+        ]
+        report = analysis.run_rules(
+            contexts, [], list(FLEET_RULES), root=REPO,
+            known_rules=analysis.ALL_RULE_NAMES,
+        )
+        assert not report.findings, (
+            [f.render() for f in report.findings]
+        )
+
+    def test_coordinator_keeps_its_contracts(self):
+        """The satellite fixes stay put: the reader's unbounded recv is
+        annotated, unknown control messages are counted, and the fleet
+        mean's contributor count lands in a gauge."""
+        src = _read(COORD)
+        assert src.count("unbounded-by-design:") >= 2
+        assert '"fleet.unknown_msgs"' in src
+        assert '"fleet.param_sync_contribs"' in src

@@ -55,6 +55,32 @@ checker runs as `--check-protocol`):
                          std::thread spawn sites and Python-facing entry
                          methods (the C++ half of PR 7's thread graph)
 
+Distributed-systems rules (ISSUE 20) ride the control-plane extractors
+in analysis/fleetrules.py and the fleet protocol spec in
+analysis/fleetproto.py (whose exhaustive model checker runs as
+`--check-fleet`):
+
+    FLEET-MSG-PARITY         every fleet control-plane send site (dict
+                             literals with a "type" key into
+                             _send/_broadcast) has a receiving-role
+                             handler arm and the field sets agree, per
+                             role (lead vs remote); handled types must
+                             be sent by someone
+    FLEET-TIMEOUT-DISCIPLINE every blocking control-plane operation
+                             under fleet/ (accept, recv, dial,
+                             cond/event wait, join) is under a deadline
+                             or carries an explicit
+                             `# unbounded-by-design: <why>` annotation
+                             (the reader threads' EOF-side loss
+                             detection, stated in the source)
+    TELEMETRY-SCHEMA         the repo-wide series registry: naming
+                             grammar (`layer.noun[_noun]`; the
+                             `host<r>.` fold prefix reserved to the
+                             lead's telemetry folder), one instrument
+                             kind per name, and every series the chaos
+                             verdicts / telemetry tests consume has an
+                             emitter
+
 See README "Static analysis" for the suppression syntax and how to add a
 rule. The package is stdlib-only by contract (enforced by its own
 IMPORT-PURITY entry).
@@ -73,14 +99,19 @@ from .engine import (  # noqa: F401
     write_baseline,
 )
 from .cxxrules import CXX_RULES  # noqa: F401
+from .fleetrules import FLEET_RULES  # noqa: F401
 from .parity import REPO_RULES as PARITY_RULES  # noqa: F401
 from .rules import CONCURRENCY_RULES, FILE_RULES  # noqa: F401
 
 # Repo-level rules: cross-language/cross-driver parity, the
 # whole-program concurrency rules (which share one Program model per
-# run via graph.get_program's cache), and the C++ concurrency rules
-# over the analysis/cxx.py frontend contexts.
-REPO_RULES = list(PARITY_RULES) + list(CONCURRENCY_RULES) + list(CXX_RULES)
+# run via graph.get_program's cache), the C++ concurrency rules over
+# the analysis/cxx.py frontend contexts, and the distributed-systems
+# rules over the fleet control plane + telemetry registry.
+REPO_RULES = (
+    list(PARITY_RULES) + list(CONCURRENCY_RULES) + list(CXX_RULES)
+    + list(FLEET_RULES)
+)
 
 ALL_RULE_NAMES = (
     {r.name for r in FILE_RULES}

@@ -83,6 +83,18 @@ def main(argv=None) -> int:
                              "seeded mutations produce counterexample "
                              "traces); prints a JSON verdict plus the "
                              "mutants' traces.")
+    parser.add_argument("--check-fleet", action="store_true",
+                        help="Exhaustively model-check the fleet "
+                             "control-plane protocol spec (rendezvous, "
+                             "sync barrier, halt plane, snapshot "
+                             "monotonicity under crash/wedge faults; "
+                             "conformance-pinned against "
+                             "fleet/coordinator.py); prints a JSON "
+                             "verdict plus the mutants' traces.")
+    parser.add_argument("--timing", action="store_true",
+                        help="Print per-rule wall-clock after the "
+                             "report (scripts/lint.sh passes this so "
+                             "rule-cost regressions are visible).")
     parser.add_argument("--diff", metavar="GIT_REF", default=None,
                         help="Lint only files changed vs GIT_REF "
                              "(committed, working tree, and untracked); "
@@ -109,6 +121,11 @@ def main(argv=None) -> int:
         from .protocol import main as protocol_main
 
         return protocol_main()
+
+    if args.check_fleet:
+        from .fleetproto import main as fleet_main
+
+        return fleet_main()
 
     if args.list_rules:
         for rule in (*FILE_RULES, *REPO_RULES):
@@ -195,6 +212,11 @@ def main(argv=None) -> int:
             f"{len(report.baselined)} baselined; "
             f"{report.files_scanned} files in {report.elapsed_s:.2f}s"
         )
+        if args.timing:
+            for name, t in sorted(
+                report.rule_timings.items(), key=lambda kv: -kv[1]
+            ):
+                print(f"beastlint-timing: {name:24s} {t:7.3f}s")
         if args.ci:
             print(f"beastlint-ci: {verdict}")
     return 1 if report.findings else 0

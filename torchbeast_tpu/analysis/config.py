@@ -220,3 +220,57 @@ ATOMIC_ORDER_REQUIRED = {
 # the findings recommend, so its result must never re-taint).
 HOST_JAX_NAMESPACES = ("tree_util", "tree", "dtypes", "typing")
 HOST_RETURNING_CALLS = ("jax.device_get",)
+
+# ---------------------------------------------------------------------
+# Distributed-systems analysis tier (ISSUE 20, analysis/fleetrules.py +
+# fleetproto.py).
+
+# FLEET-MSG-PARITY anchor: the one file that speaks the fleet
+# control-plane dict protocol. The rule extracts every send site
+# (dict literals with a "type" key flowing into _send/_broadcast) and
+# every handler arm, then cross-checks types and field sets per role.
+FLEET_COORDINATOR = "torchbeast_tpu/fleet/coordinator.py"
+# The payload-carrying senders the extractor follows. `_send`'s first
+# argument is the destination rank (a literal 0 means "to the lead");
+# `_broadcast` fans out lead -> remotes.
+FLEET_SEND_FUNCS = ("_send", "_broadcast")
+# Role assignment for handler arms found OUTSIDE the shared `_handle` /
+# `_reader` dispatch (which both roles run): the lead-only accept loop
+# handles "hello"; anything in the remote-only dial path is remote.
+FLEET_LEAD_FUNCS = ("_start_lead",)
+FLEET_REMOTE_FUNCS = ("_start_remote",)
+# Fields every control-plane message may carry without a reader: "type"
+# is consumed by the dispatch itself, and "rank" is the sender identity
+# (verified once at hello, implied by the connection thereafter).
+FLEET_MSG_STANDARD_FIELDS = ("type", "rank")
+
+# FLEET-TIMEOUT-DISCIPLINE scope: path prefixes where every blocking
+# control-plane operation (accept, recv, dial, condition/event wait,
+# join) must be under a deadline or carry an explicit
+# `# unbounded-by-design: <why>` annotation.
+FLEET_TIMEOUT_PATHS = ("torchbeast_tpu/fleet",)
+# Dial helpers that bound their own retry loop ONLY when a deadline is
+# passed; calling them without one is an unbounded dial.
+FLEET_DIAL_FUNCS = ("dial_transport", "connect_transport")
+
+# TELEMETRY-SCHEMA scope: where series registrations
+# (reg.counter/gauge/histogram with a literal or f-string name) are
+# collected from. tests/ stays out: fixture registries use throwaway
+# names by design.
+TELEMETRY_SCAN_PATHS = ("torchbeast_tpu", "scripts", "benchmarks")
+# The `host<r>.` fold prefix is reserved to the lead's telemetry folder
+# (NativeTelemetryFolder): any other emitter would collide with the
+# folded remote series and corrupt fleet dashboards.
+TELEMETRY_FOLD_FILES = ("torchbeast_tpu/runtime/native.py",)
+# Files whose series READS are schema commitments: the chaos harness'
+# verdict counters and the telemetry test suite's snapshot assertions.
+# A name consumed here that no scanned code emits is drift (a rename
+# that silently turned the verdict/assert into a no-op).
+TELEMETRY_CONSUMER_FILES = (
+    "scripts/chaos_run.py",
+    "tests/test_telemetry.py",
+)
+# The consumed-but-never-emitted check only runs when the scan plainly
+# covers the whole tree (partial scans would see a truncated emitter
+# set and flag everything): this sentinel file must be in scope.
+TELEMETRY_SENTINEL_FILE = "torchbeast_tpu/telemetry/metrics.py"

@@ -41,6 +41,7 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -265,6 +266,12 @@ class Report:
     baselined: List[Finding]
     files_scanned: int
     elapsed_s: float = 0.0
+    # Wall-clock per rule name, seconds (file rules summed across
+    # contexts) — scripts/lint.sh prints these so a new whole-tree scan
+    # cannot silently regress the CI budget.
+    rule_timings: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
 
     def as_dict(self) -> dict:
         return {
@@ -276,6 +283,10 @@ class Report:
             "baselined": [f.as_dict() for f in self.baselined],
             "files_scanned": self.files_scanned,
             "elapsed_s": self.elapsed_s,
+            "rule_timings": {
+                name: round(t, 4)
+                for name, t in sorted(self.rule_timings.items())
+            },
         }
 
 
@@ -318,15 +329,24 @@ def run_rules(
     suppressed — that would be a hole in the gate).
     """
     raw: List[Finding] = []
+    timings: Dict[str, float] = {}
     ctx_by_path: Dict[str, FileContext] = {}
     for ctx in contexts:
         ctx_by_path[ctx.path] = ctx
         if ctx.is_cxx:
             continue  # Python file rules; C++ rules are repo rules
         for rule in file_rules:
+            t0 = time.perf_counter()
             raw.extend(rule.check(ctx))
+            timings[rule.name] = (
+                timings.get(rule.name, 0.0) + time.perf_counter() - t0
+            )
     for rule in repo_rules:
+        t0 = time.perf_counter()
         raw.extend(rule.check_repo(root, contexts))
+        timings[rule.name] = (
+            timings.get(rule.name, 0.0) + time.perf_counter() - t0
+        )
     if only_paths is not None:
         raw = [f for f in raw if f.path in only_paths]
 
@@ -375,6 +395,7 @@ def run_rules(
         suppressed=suppressed,
         baselined=baselined,
         files_scanned=len(contexts),
+        rule_timings=timings,
     )
 
 

@@ -1,0 +1,825 @@
+"""Exhaustive explicit-state model checking of the fleet control-plane
+protocol (ISSUE 20 tentpole, `--check-fleet`).
+
+fleet/coordinator.py speaks a hand-rolled dict protocol over sockets —
+hello rendezvous, heartbeat/verdict health folding, a synchronous
+param-averaging barrier, snapshot fan-out — exactly the class of code
+IMPALA-style multi-host systems historically get wrong under partial
+failure. This module writes that protocol down ONCE as a small
+transition system and enumerates EVERY interleaving of one lead and
+N-1 remotes with at most one injected fault, the same way protocol.py
+does for the shm ring.
+
+What is modeled (matching fleet/coordinator.py):
+
+- Rendezvous: remotes dial and send hello; the lead accepts until all
+  are in or its connect deadline fires (TimeoutError -> the lead run
+  fails). Remote dials are deadline-bounded the same way. Both
+  deadlines are untimed transitions — "the deadline eventually fires",
+  true for ANY finite positive bound.
+- The run: the lead publishes MAX_SNAPS policy snapshots (fan-out to
+  every connected remote; delivery per remote is unordered, because
+  the store-level version guard — not the socket — is the ordering
+  authority apply_snapshot relies on across re-broadcasts and
+  reconnects); each remote takes MAX_ACTS acting steps, then enters
+  one param-sync round.
+- The sync barrier: a remote sends `params` and waits for
+  `params_mean`; the lead waits until every expected live remote
+  contributed, then broadcasts the mean. Both waits escape by
+  `sync_timeout_s` (the spec knob `sync_deadline`), by halt, or — on
+  the remote — by lead departure. A mean that arrived BEFORE the
+  remote entered the round is stale (the `_mean_seq` capture in
+  `_sync_remote`) and does not satisfy the wait.
+- Failures (at most one per run): "crash" — the process dies, its
+  socket EOFs, the peer's reader DETECTS it (`_on_host_lost` /
+  `_on_lead_lost`); and "wedge" — the process hangs with the socket
+  alive, which is NEVER detected, because the lead's loss detection is
+  reader-EOF only (there is no heartbeat timeout — the
+  unbounded-by-design contract FLEET-TIMEOUT-DISCIPLINE pins). Sync
+  deadlines are the only thing standing between a wedged host and a
+  fleet-wide barrier deadlock; the no_sync_deadline mutant proves they
+  are load-bearing.
+- The halt plane: a detected loss that drops live hosts below
+  `min_live_hosts` halts the lead and broadcasts a HALT verdict
+  (`_on_host_lost` -> `_broadcast_verdict`); a remote processing it
+  halts. Above the floor the lead degrades and keeps going.
+
+Checked properties (check_fleet), per scenario:
+
+- error_free: no reachable state applies a snapshot version below the
+  one already applied (monotonicity), and no host that processed a
+  HALT verdict takes another acting step.
+- no_wedge: from every reachable state, a state where every host is
+  terminal (done / halted / crashed / wedged / dial-failed) is still
+  reachable — this subsumes "rendezvous terminates" and "sync_params
+  always returns by its deadline with no barrier deadlock".
+- halt_propagation: from every reachable state where the lead is
+  floor-halted and remote r is still live, a state where r has halted
+  is reachable (the HALT verdict cannot be lost short of r crashing).
+
+Seeded mutations (MUTATIONS) re-run the checker on a broken spec and
+must FIND the bug as a counterexample trace:
+
+- no_sync_deadline: remove the sync_timeout_s escape — a wedged host
+  deadlocks the averaging barrier fleet-wide (wedge trace).
+- no_halt_broadcast: the floor-halted lead never tells the survivors —
+  they run to completion un-halted (halt_propagation trace).
+- act_through_halt: a remote processes the HALT verdict and keeps
+  acting (direct safety error).
+- no_snapshot_guard: drop apply_snapshot's stale-version guard — an
+  unordered delivery applies versions backwards (monotonicity error).
+
+Conformance (check_conformance): the model's constants are pinned
+against the real source the way protocol.py pins the ring offsets —
+the message-tag set is re-extracted from coordinator.py with the
+FLEET-MSG-PARITY extractors, `sync_timeout_s` must default positive,
+both sync waits must carry the `remaining <= 0` deadline escape,
+`_on_host_lost` must check `min_live_hosts` and halt+broadcast,
+`_on_lead_lost` must halt, and snapshot_wire.apply_snapshot must keep
+the `snap.version <= store.version` guard. The model cannot silently
+drift from the code.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+from . import config
+from .fleetrules import extract_handler_arms, extract_send_sites
+
+# ---------------------------------------------------------------------------
+# The spec, as data
+
+# Every control-plane message tag the coordinator speaks; conformance
+# re-extracts this set from the source so a new tag (or a renamed one)
+# fails --check-fleet until the model covers it.
+MSG_TYPES = (
+    "hello", "hb", "verdict", "params", "params_mean", "done", "bye",
+)
+
+# Bounded run shape: snapshots the lead publishes (two, so stale-vs-
+# fresh ordering exists to check) and acting steps per remote (one: the
+# act-after-halt property needs an act that can land after a verdict).
+MAX_SNAPS = 2
+MAX_ACTS = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """Protocol variant knobs. The shipped configuration is Spec();
+    mutations flip one knob each (MUTATIONS)."""
+
+    # Both sync_params waits escape at sync_timeout_s (degrade to a
+    # partial mean / None) — the only defense against a WEDGED host,
+    # which reader-EOF loss detection never sees.
+    sync_deadline: bool = True
+    # The floor-halted lead broadcasts the HALT verdict to survivors
+    # (_on_host_lost -> _broadcast_verdict).
+    halt_broadcast: bool = True
+    # A remote that processed a HALT verdict stops acting (the driver
+    # checkpoint-and-exits instead of training on).
+    halt_stops_acting: bool = True
+    # apply_snapshot drops snap.version <= store.version (the stale
+    # guard that makes unordered delivery safe).
+    snapshot_guard: bool = True
+
+
+MUTATIONS: Dict[str, Spec] = {
+    # A wedged host parks the averaging barrier forever on BOTH sides:
+    # the lead waits for params that never come from a host it cannot
+    # detect; remotes wait for a mean a wedged lead never sends.
+    "no_sync_deadline": Spec(sync_deadline=False),
+    # The lead halts below the floor but the survivors never hear it:
+    # they finish the run un-halted (checkpoint skew across the fleet).
+    "no_halt_broadcast": Spec(halt_broadcast=False),
+    # The verdict arrives and is ignored: a live host keeps acting
+    # after the fleet decided to checkpoint-and-exit.
+    "act_through_halt": Spec(halt_stops_acting=False),
+    # Without the store guard, re-broadcast/reconnect reordering
+    # applies an old snapshot over a newer one.
+    "no_snapshot_guard": Spec(snapshot_guard=False),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One bounded fleet shape to enumerate."""
+
+    hosts: int  # num_hosts (lead + hosts-1 remotes)
+    min_live: int  # --min_live_hosts floor
+    failures: int = 1  # fault budget (crash OR wedge, any host)
+
+    @property
+    def name(self) -> str:
+        return f"n{self.hosts}_floor{self.min_live}_f{self.failures}"
+
+
+# n=2 exercises the two-party barrier; n=3 with floor 3 gives the halt
+# a live survivor to propagate to; n=3 with floor 1 is the
+# degrade-and-continue path (a loss shrinks the barrier, nobody halts).
+SCENARIOS = (
+    Scenario(hosts=2, min_live=2),
+    Scenario(hosts=3, min_live=3),
+    Scenario(hosts=3, min_live=1),
+)
+
+
+# ---------------------------------------------------------------------------
+# State
+#
+# Immutable tuples throughout; the whole state is hashable.
+#
+#   lead      lead phase: 'accept' -> 'run' (publishes snapshots) ->
+#             'sync' (the barrier) -> 'done'; 'failed' (rendezvous
+#             deadline), 'halted' (floor), 'crashed', 'wedged'.
+#   published snapshot versions published so far (1..published)
+#   lost      frozenset of remote ranks whose crash the lead DETECTED
+#   got       frozenset of remote ranks whose params the lead holds
+#   remotes   tuple of per-remote tuples:
+#               (phase, acts, applied, snaps, halt_pending, mean_pending)
+#             phase: 'join' -> 'run' -> 'sync' -> 'done'; 'halted',
+#             'crashed', 'wedged', 'dialfail'.
+#             applied = newest snapshot version applied; snaps = the
+#             in-flight (unordered) snapshot channel.
+#   fuel      remaining fault budget
+
+State = Tuple
+
+_LEAD, _PUB, _LOST, _GOT, _REMOTES, _FUEL = 0, 1, 2, 3, 4, 5
+_RPHASE, _RACTS, _RAPPLIED, _RSNAPS, _RHALT, _RMEAN = 0, 1, 2, 3, 4, 5
+
+# Phases from which a host takes no further steps, ever.
+LEAD_TERMINAL = ("done", "failed", "halted", "crashed", "wedged")
+REMOTE_TERMINAL = ("done", "halted", "crashed", "wedged", "dialfail")
+
+
+def _initial(scenario: Scenario) -> State:
+    remote = ("join", 0, 0, frozenset(), False, False)
+    return (
+        "accept", 0, frozenset(), frozenset(),
+        tuple(remote for _ in range(scenario.hosts - 1)),
+        scenario.failures,
+    )
+
+
+def _with_remote(state: State, idx: int, **kw) -> State:
+    names = ["phase", "acts", "applied", "snaps", "halt_pending",
+             "mean_pending"]
+    r = list(state[_REMOTES][idx])
+    for key, value in kw.items():
+        r[names.index(key)] = value
+    remotes = list(state[_REMOTES])
+    remotes[idx] = tuple(r)
+    return state[:_REMOTES] + (tuple(remotes),) + state[_REMOTES + 1:]
+
+
+def _with(state: State, **kw) -> State:
+    names = ["lead", "published", "lost", "got", "remotes", "fuel"]
+    vals = list(state)
+    for key, value in kw.items():
+        vals[names.index(key)] = value
+    return tuple(vals)
+
+
+def _joined(remote: Tuple) -> bool:
+    # 'join' has not said hello yet; 'dialfail' never will.
+    return remote[_RPHASE] not in ("join", "dialfail")
+
+
+def _expected(state: State) -> FrozenSet[int]:
+    """The lead barrier's rendezvous set: connected ranks that have not
+    finished cleanly (`set(self._conns) - self._done`). Crashed-but-
+    undetected and wedged hosts ARE still expected — that is the bug
+    class the sync deadline exists for."""
+    return frozenset(
+        i for i, r in enumerate(state[_REMOTES])
+        if r[_RPHASE] in ("run", "sync", "crashed", "wedged")
+        and i not in state[_LOST]
+    )
+
+
+def _broadcast_flag(state: State, flag: str) -> State:
+    """Set halt_pending/mean_pending on every remote that can still
+    read it (run/sync; terminal hosts have no reader to care)."""
+    for i, r in enumerate(state[_REMOTES]):
+        if r[_RPHASE] in ("run", "sync") and i not in state[_LOST]:
+            state = _with_remote(state, i, **{flag: True})
+    return state
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str  # 'error' | 'wedge' | 'halt_propagation'
+    detail: str
+    trace: List[str]
+
+
+@dataclasses.dataclass
+class Result:
+    ok: bool
+    states: int
+    violations: List[Violation]
+    properties: Dict[str, bool]
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "states": self.states,
+            "properties": self.properties,
+            "violations": [
+                {"kind": v.kind, "detail": v.detail, "trace": v.trace}
+                for v in self.violations
+            ],
+        }
+
+
+def transitions(state: State, spec: Spec,
+                scenario: Scenario) -> Iterator[
+                    Tuple[str, State, Optional[str]]]:
+    """Yield (label, next_state, error) for every enabled atomic step.
+
+    `error` carries a safety-violation description when the step lands
+    in a violation state (the caller records it and stops exploring
+    that branch).
+    """
+    lead, published, lost, got, remotes, fuel = state
+    n = scenario.hosts
+
+    def floor_check(st: State, label: str):
+        """A detected loss: live drops; below the floor the lead halts
+        and (spec permitting) broadcasts the HALT verdict — and, when
+        torn out of the sync wait, still broadcasts its partial mean
+        (_sync_lead breaks on is_halted and publishes what it has)."""
+        live = n - len(st[_LOST])
+        if live >= scenario.min_live:
+            return label + " degrade", st, None
+        if st[_LEAD] == "sync":
+            st = _broadcast_flag(st, "mean_pending")
+        st = _with(st, lead="halted")
+        if spec.halt_broadcast:
+            st = _broadcast_flag(st, "halt_pending")
+        return label + " floor_halt", st, None
+
+    # -- fault injection ---------------------------------------------------
+    if fuel > 0:
+        if lead in ("accept", "run", "sync"):
+            yield ("lead:crash",
+                   _with(state, lead="crashed", fuel=fuel - 1), None)
+            yield ("lead:wedge",
+                   _with(state, lead="wedged", fuel=fuel - 1), None)
+        for i, r in enumerate(remotes):
+            if r[_RPHASE] in ("run", "sync"):
+                # In-flight messages to the dying host are lost with it.
+                dead = _with_remote(
+                    state, i, snaps=frozenset(), halt_pending=False,
+                    mean_pending=False,
+                )
+                yield (f"r{i}:crash",
+                       _with_remote(dead, i, phase="crashed",
+                                    )[:_FUEL] + (fuel - 1,), None)
+                yield (f"r{i}:wedge",
+                       _with_remote(dead, i, phase="wedged",
+                                    )[:_FUEL] + (fuel - 1,), None)
+
+    # -- rendezvous ----------------------------------------------------------
+    if lead == "accept":
+        if all(_joined(r) for r in remotes):
+            yield "lead:rendezvous_done", _with(state, lead="run"), None
+        else:
+            # The accept loop's connect_timeout_s: raises TimeoutError,
+            # the lead run fails before it starts.
+            yield ("lead:accept_deadline",
+                   _with(state, lead="failed"), None)
+    for i, r in enumerate(remotes):
+        if r[_RPHASE] != "join":
+            continue
+        if lead == "accept":
+            yield (f"r{i}:hello",
+                   _with_remote(state, i, phase="run"), None)
+        # dial_transport's deadline_s: the remote gives up (also the
+        # shape a host that died before joining takes).
+        yield (f"r{i}:dial_deadline",
+               _with_remote(state, i, phase="dialfail"), None)
+
+    # -- lead: snapshots, barrier, loss detection ----------------------------
+    if lead == "run":
+        if published < MAX_SNAPS:
+            version = published + 1
+            st = _with(state, published=version)
+            for i, r in enumerate(remotes):
+                if r[_RPHASE] in ("run", "sync") and i not in lost:
+                    st = _with_remote(
+                        st, i, snaps=st[_REMOTES][i][_RSNAPS]
+                        | {version},
+                    )
+            yield f"lead:publish_snapshot[v{version}]", st, None
+        else:
+            yield "lead:enter_sync", _with(state, lead="sync"), None
+    elif lead == "sync":
+        expected = _expected(state)
+        if expected <= got:
+            st = _broadcast_flag(state, "mean_pending")
+            yield ("lead:sync_complete",
+                   _with(st, lead="done"), None)
+        elif spec.sync_deadline:
+            # sync_timeout_s fires: mean whatever arrived, broadcast
+            # the partial, move on (the round degraded, nobody waits).
+            st = _broadcast_flag(state, "mean_pending")
+            yield ("lead:sync_deadline",
+                   _with(st, lead="done"), None)
+    if lead in ("run", "sync"):
+        for i, r in enumerate(remotes):
+            if r[_RPHASE] == "crashed" and i not in lost:
+                # Reader EOF: _on_host_lost pops the conn and the
+                # pending params, then checks the floor.
+                st = _with(state, lost=lost | {i}, got=got - {i})
+                yield floor_check(st, f"lead:detect_loss[r{i}]")
+
+    # -- remotes -------------------------------------------------------------
+    for i, r in enumerate(remotes):
+        phase, acts, applied, snaps, halt_pending, mean_pending = r
+        if phase == "run":
+            if acts < MAX_ACTS:
+                yield (f"r{i}:act",
+                       _with_remote(state, i, acts=acts + 1), None)
+            else:
+                # Enter the sync round: send params (delivered unless
+                # the lead process is gone), arm the wait. A mean that
+                # arrived before this point is STALE — _sync_remote
+                # captures _mean_seq before sending, so the old bump
+                # does not satisfy the new wait.
+                st = _with_remote(state, i, phase="sync",
+                                  mean_pending=False)
+                if lead in ("accept", "run", "sync", "halted"):
+                    st = _with(st, got=st[_GOT] | {i})
+                yield f"r{i}:send_params", st, None
+        elif phase == "sync":
+            if mean_pending:
+                yield (f"r{i}:recv_mean",
+                       _with_remote(state, i, phase="done",
+                                    mean_pending=False), None)
+            if spec.sync_deadline:
+                yield (f"r{i}:sync_deadline",
+                       _with_remote(state, i, phase="done"), None)
+            if lead == "done":
+                # Clean lead departure: _lead_gone, sync returns None.
+                yield (f"r{i}:lead_gone",
+                       _with_remote(state, i, phase="done"), None)
+        elif phase == "halted" and not spec.halt_stops_acting:
+            if acts < MAX_ACTS:
+                yield (
+                    f"r{i}:act",
+                    _with_remote(state, i, acts=acts + 1),
+                    f"safety: host {i + 1} took an acting step after "
+                    "processing a HALT verdict",
+                )
+        if phase in ("run", "sync"):
+            if halt_pending:
+                yield (f"r{i}:process_halt",
+                       _with_remote(state, i, phase="halted",
+                                    halt_pending=False,
+                                    mean_pending=False), None)
+            if lead in ("crashed", "failed"):
+                # Reader EOF on the lead socket: _on_lead_lost halts.
+                yield (f"r{i}:detect_lead_loss",
+                       _with_remote(state, i, phase="halted",
+                                    halt_pending=False,
+                                    mean_pending=False), None)
+            for version in sorted(snaps):
+                st = _with_remote(state, i, snaps=snaps - {version})
+                if spec.snapshot_guard:
+                    if version > applied:
+                        st = _with_remote(st, i, applied=version)
+                        yield (f"r{i}:apply_snapshot[v{version}]",
+                               st, None)
+                    else:
+                        yield (f"r{i}:drop_stale_snapshot[v{version}]",
+                               st, None)
+                else:
+                    st = _with_remote(st, i, applied=version)
+                    error = None
+                    if version < applied:
+                        error = (
+                            f"monotonicity: host {i + 1} applied "
+                            f"snapshot v{version} after v{applied}"
+                        )
+                    yield (f"r{i}:apply_snapshot[v{version}]", st,
+                           error)
+
+
+def _is_terminal(state: State) -> bool:
+    return state[_LEAD] in LEAD_TERMINAL and all(
+        r[_RPHASE] in REMOTE_TERMINAL for r in state[_REMOTES]
+    )
+
+
+def _explore(spec: Spec, scenario: Scenario, max_states: int):
+    """BFS the full state graph. Returns (parents, succ, violations)."""
+    init = _initial(scenario)
+    parents: Dict[State, Optional[Tuple[State, str]]] = {init: None}
+    order: List[State] = [init]
+    succ: Dict[State, List[State]] = {}
+    violations: List[Violation] = []
+    i = 0
+    while i < len(order):
+        state = order[i]
+        i += 1
+        if len(parents) > max_states:
+            raise RuntimeError(
+                f"state space exceeded {max_states} states — shrink "
+                "the scenario"
+            )
+        outs: List[State] = []
+        for label, nxt, error in transitions(state, spec, scenario):
+            if error is not None:
+                violations.append(
+                    Violation("error", error,
+                              _trace(parents, state) + [label]))
+                continue
+            outs.append(nxt)
+            if nxt not in parents:
+                parents[nxt] = (state, label)
+                order.append(nxt)
+        succ[state] = outs
+    return parents, succ, violations
+
+
+def _backward_reachable(succ: Dict[State, List[State]],
+                        targets) -> set:
+    reach = set(targets)
+    changed = True
+    while changed:
+        changed = False
+        for state, outs in succ.items():
+            if state not in reach and any(o in reach for o in outs):
+                reach.add(state)
+                changed = True
+    return reach
+
+
+def check_fleet(spec: Spec = Spec(),
+                scenario: Scenario = SCENARIOS[0],
+                max_states: int = 2_000_000) -> Result:
+    """Enumerate every interleaving of one scenario; verify safety
+    (monotonic snapshots, no acting past a HALT) + no-wedge +
+    halt-propagation. Counterexamples carry the full transition-label
+    trace from the initial state."""
+    parents, succ, violations = _explore(spec, scenario, max_states)
+
+    # No-wedge: every reachable state can still reach all-terminal.
+    can_finish = _backward_reachable(
+        succ, {s for s in parents if _is_terminal(s)}
+    )
+    wedged = [s for s in parents if s not in can_finish]
+    if wedged:
+        first = min(wedged, key=lambda s: len(_trace(parents, s)))
+        remote_txt = ", ".join(
+            f"r{i}={r[_RPHASE]}" for i, r in enumerate(first[_REMOTES])
+        )
+        violations.append(Violation(
+            "wedge",
+            "wedged state: no terminal state reachable "
+            f"(lead={first[_LEAD]}, {remote_txt}, "
+            f"expected_barrier={sorted(_expected(first))}, "
+            f"got_params={sorted(first[_GOT])})",
+            _trace(parents, first),
+        ))
+
+    # Halt propagation: a floor-halted lead's verdict reaches every
+    # still-live remote (a state where that remote has halted stays
+    # reachable; crashing out instead is the remote's own business).
+    halt_holes = []
+    for i in range(scenario.hosts - 1):
+        can_halt = _backward_reachable(
+            succ,
+            {s for s in parents
+             if s[_REMOTES][i][_RPHASE] == "halted"},
+        )
+        for s in parents:
+            if (
+                s[_LEAD] == "halted"
+                and s[_REMOTES][i][_RPHASE] in ("run", "sync")
+                and s not in can_halt
+            ):
+                halt_holes.append((i, s))
+    if halt_holes:
+        i, first = min(
+            halt_holes, key=lambda pair: len(_trace(parents, pair[1]))
+        )
+        violations.append(Violation(
+            "halt_propagation",
+            f"lead is floor-halted but live host {i + 1} "
+            f"(phase {first[_REMOTES][i][_RPHASE]}) can never learn "
+            "it — the HALT verdict is lost",
+            _trace(parents, first),
+        ))
+
+    properties = {
+        "error_free": not any(v.kind == "error" for v in violations),
+        "no_wedge": not wedged,
+        "halt_propagation": not halt_holes,
+        "terminal_reachable": bool(can_finish),
+    }
+    return Result(
+        ok=all(properties.values()),
+        states=len(parents),
+        violations=violations,
+        properties=properties,
+    )
+
+
+def _trace(parents, state: State) -> List[str]:
+    labels: List[str] = []
+    cur = state
+    while parents.get(cur) is not None:
+        prev, label = parents[cur]
+        labels.append(label)
+        cur = prev
+    return list(reversed(labels))
+
+
+def render_trace(violation: Violation) -> str:
+    """The counterexample format the README documents: one numbered
+    `actor:action` step per line, then the violated property."""
+    lines = [
+        f"  {i + 1:3d}. {step}" for i, step in enumerate(violation.trace)
+    ]
+    lines.append(f"  => {violation.kind.upper()}: {violation.detail}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Conformance: pin the model's constants against the real source
+
+
+def _parse(root: str, rel: str) -> Optional[ast.Module]:
+    path = os.path.join(root, rel)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=rel)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _find_method(tree: ast.Module, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _has_deadline_escape(func) -> bool:
+    """A `remaining <= 0` compare — the sync waits' deadline escape."""
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Compare)
+            and isinstance(node.left, ast.Name)
+            and node.left.id == "remaining"
+            and len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.LtE, ast.Lt))
+            and isinstance(node.comparators[0], ast.Constant)
+            and node.comparators[0].value == 0
+        ):
+            return True
+    return False
+
+
+def _calls_attr(func, attr: str) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == attr
+        for n in ast.walk(func)
+    )
+
+
+def _names_attr(func, attr: str) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == attr
+        for n in ast.walk(func)
+    )
+
+
+def check_conformance(root: str) -> dict:
+    """Pin the model against fleet/coordinator.py and snapshot_wire.py.
+    Returns {"ok": bool, "pins": {name: {"ok": bool, "detail": str}}}."""
+    pins: Dict[str, dict] = {}
+
+    def pin(name: str, ok: bool, detail: str) -> None:
+        pins[name] = {"ok": bool(ok), "detail": detail}
+
+    coord = _parse(root, config.FLEET_COORDINATOR)
+    if coord is None:
+        pin("coordinator_parses", False,
+            f"{config.FLEET_COORDINATOR} missing or unparseable")
+        return {"ok": False, "pins": pins}
+
+    # 1. The tag set: every sent and every handled message type, as the
+    # FLEET-MSG-PARITY extractors see them, equals the model's.
+    seen = {s.msg_type for s in extract_send_sites(coord)}
+    seen |= {a.msg_type for a in extract_handler_arms(coord)}
+    pin("message_tags", seen == set(MSG_TYPES),
+        f"source speaks {sorted(seen)}, model speaks "
+        f"{sorted(MSG_TYPES)}")
+
+    # 2. sync_timeout_s defaults positive (the deadline the no-wedge
+    # proof needs is actually armed by default).
+    init = _find_method(coord, "__init__")
+    default_ok = False
+    detail = "no sync_timeout_s default found"
+    if init is not None:
+        args = init.args
+        names = [a.arg for a in args.args]
+        defaults = args.defaults
+        offset = len(names) - len(defaults)
+        for idx, arg_name in enumerate(names):
+            if arg_name == "sync_timeout_s" and idx >= offset:
+                d = defaults[idx - offset]
+                if isinstance(d, ast.Constant) and isinstance(
+                    d.value, (int, float)
+                ):
+                    default_ok = d.value > 0
+                    detail = f"sync_timeout_s defaults to {d.value}"
+    pin("sync_timeout_positive", default_ok, detail)
+
+    # 3. Both sync waits carry the deadline escape.
+    for fn in ("_sync_lead", "_sync_remote"):
+        func = _find_method(coord, fn)
+        pin(f"{fn}_deadline", func is not None
+            and _has_deadline_escape(func),
+            f"{fn} has the `remaining <= 0` escape"
+            if func is not None else f"{fn} not found")
+
+    # 4. The floor: _on_host_lost checks min_live_hosts, halts, and
+    # broadcasts the verdict.
+    ohl = _find_method(coord, "_on_host_lost")
+    pin("floor_halts_and_broadcasts", ohl is not None
+        and _names_attr(ohl, "min_live_hosts")
+        and _calls_attr(ohl, "halt")
+        and _calls_attr(ohl, "_broadcast_verdict"),
+        "_on_host_lost: min_live_hosts check -> halt -> "
+        "_broadcast_verdict" if ohl is not None
+        else "_on_host_lost not found")
+
+    # 5. Lead loss halts the remote.
+    oll = _find_method(coord, "_on_lead_lost")
+    pin("lead_loss_halts", oll is not None and _calls_attr(oll, "halt"),
+        "_on_lead_lost calls _health.halt" if oll is not None
+        else "_on_lead_lost not found")
+
+    # 6. The snapshot stale guard the monotonicity proof rests on.
+    wire_tree = _parse(root, "torchbeast_tpu/fleet/snapshot_wire.py")
+    guard_ok = False
+    if wire_tree is not None:
+        apply_fn = _find_method(wire_tree, "apply_snapshot")
+        if apply_fn is not None:
+            for node in ast.walk(apply_fn):
+                if (
+                    isinstance(node, ast.Compare)
+                    and isinstance(node.left, ast.Attribute)
+                    and node.left.attr == "version"
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], ast.LtE)
+                    and isinstance(node.comparators[0], ast.Attribute)
+                    and node.comparators[0].attr == "version"
+                ):
+                    guard_ok = True
+    pin("snapshot_stale_guard", guard_ok,
+        "apply_snapshot keeps the `snap.version <= store.version` "
+        "guard")
+
+    return {"ok": all(p["ok"] for p in pins.values()), "pins": pins}
+
+
+# ---------------------------------------------------------------------------
+# The acceptance bundle
+
+
+def verify_shipped_and_mutants(root: Optional[str] = None) -> dict:
+    """The `--check-fleet` verdict: the shipped spec must verify clean
+    on every scenario; every seeded mutation must produce a
+    counterexample on at least one; the conformance pins must hold."""
+    out: dict = {"scenarios": {}, "mutants": {}}
+    shipped_ok = True
+    for scenario in SCENARIOS:
+        res = check_fleet(Spec(), scenario)
+        out["scenarios"][scenario.name] = res.as_dict()
+        shipped_ok = shipped_ok and res.ok
+    for name, spec in MUTATIONS.items():
+        found: List[dict] = []
+        per_scenario: Dict[str, dict] = {}
+        for scenario in SCENARIOS:
+            res = check_fleet(spec, scenario)
+            per_scenario[scenario.name] = {
+                "ok": res.ok,
+                "violations": len(res.violations),
+            }
+            if res.violations and not found:
+                found = [
+                    {"kind": v.kind, "detail": v.detail,
+                     "trace": v.trace, "scenario": scenario.name}
+                    for v in res.violations[:1]
+                ]
+        out["mutants"][name] = {
+            "caught": bool(found),
+            "scenarios": per_scenario,
+            "counterexample": found[0] if found else None,
+        }
+    if root is None:
+        from .engine import repo_root
+
+        root = repo_root()
+    out["conformance"] = check_conformance(root)
+    out["ok"] = (
+        shipped_ok
+        and all(m["caught"] for m in out["mutants"].values())
+        and out["conformance"]["ok"]
+    )
+    return out
+
+
+def main() -> int:
+    verdict = verify_shipped_and_mutants()
+    print(json.dumps({
+        "protocol": "fleet-control-plane",
+        "ok": verdict["ok"],
+        "scenarios": {
+            name: {"states": s["states"], "properties": s["properties"]}
+            for name, s in verdict["scenarios"].items()
+        },
+        "explored_states_total": sum(
+            s["states"] for s in verdict["scenarios"].values()
+        ),
+        "mutants": {
+            name: {"caught": m["caught"]}
+            for name, m in verdict["mutants"].items()
+        },
+        "conformance": {
+            name: p["ok"]
+            for name, p in verdict["conformance"]["pins"].items()
+        },
+    }))
+    if not verdict["ok"]:
+        for name, s in verdict["scenarios"].items():
+            for v in s["violations"]:
+                print(f"-- shipped-spec violation in {name}:")
+                print(render_trace(Violation(v["kind"], v["detail"],
+                                             v["trace"])))
+        for name, m in verdict["mutants"].items():
+            if not m["caught"]:
+                print(f"mutant {name}: NOT caught")
+        for name, p in verdict["conformance"]["pins"].items():
+            if not p["ok"]:
+                print(f"conformance pin {name}: FAILED — {p['detail']}")
+    else:
+        # Show one counterexample per mutant (the README's documented
+        # trace format).
+        for name, m in verdict["mutants"].items():
+            v = m["counterexample"]
+            print(f"-- counterexample for mutant {name} "
+                  f"({v['scenario']}):")
+            print(render_trace(Violation(v["kind"], v["detail"],
+                                         v["trace"])))
+    return 0 if verdict["ok"] else 1

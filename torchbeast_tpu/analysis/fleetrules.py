@@ -1,0 +1,731 @@
+"""beastlint distributed-systems rules (ISSUE 20): the fleet
+control-plane dict protocol and the telemetry series schema.
+
+Three repo-level rules, same extractor -> summaries -> rules shape as
+the ISSUE 7/10 tiers:
+
+- FLEET-MSG-PARITY extracts every control-plane send site (dict
+  literals with a "type" key flowing into `_send`/`_broadcast`) and
+  every handler arm (`_handle`'s `msg.get("type")` dispatch plus the
+  `hello`/`bye` special cases in `_start_lead`/`_reader`) from
+  fleet/coordinator.py, assigns each a role (lead vs remote), and
+  cross-checks: sent types must have a receiving-role handler, handled
+  types must be sent by someone, and the field sets must agree (a key a
+  handler reads that no send site packs is a silent default; a key a
+  send site packs that no handler reads is dead wire weight).
+
+- FLEET-TIMEOUT-DISCIPLINE requires every blocking control-plane
+  operation under fleet/ (accept, recv, dial, condition/event wait,
+  join) to be deadline-bounded or carry an explicit
+  `# unbounded-by-design: <why>` annotation — the reader threads'
+  EOF-side loss-detection contract stated in the source instead of in a
+  reviewer's head.
+
+- TELEMETRY-SCHEMA builds the registry of every reg.counter / gauge /
+  histogram name across the tree (f-string names become `*` patterns),
+  checks the naming grammar (`layer.noun[_noun]`, the `host<r>.` fold
+  prefix reserved to the lead's telemetry folder), flags duplicate
+  registrations with conflicting instrument kinds, and flags series the
+  chaos verdicts / telemetry tests consume that no scanned code emits.
+
+All three read their anchors/scopes from analysis/config.py and return
+[] on partial scans that lack them — same contract as WIRE-PARITY.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import config
+from .engine import FileContext, Finding
+
+# The annotation grammar FLEET-TIMEOUT-DISCIPLINE accepts: a trailing
+# comment on the blocking call's line (or a standalone comment on the
+# line above) naming the contract that bounds it instead of a deadline.
+_UNBOUNDED_RE = re.compile(r"#\s*unbounded-by-design\s*:?\s*(.*)$")
+
+
+# ---------------------------------------------------------------------------
+# Shared extraction helpers
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _iter_funcs(tree: ast.Module):
+    """Yield (name, FunctionDef) for module functions and methods of
+    top-level classes (the coordinator's surface)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield item.name, item
+
+
+def _dict_fields(d: ast.Dict) -> Optional[Tuple[str, Dict[str, int]]]:
+    """A control-plane dict literal -> (msg type, {field: lineno}), or
+    None when it has no literal "type" key."""
+    msg_type = None
+    fields: Dict[str, int] = {}
+    for key, value in zip(d.keys, d.values):
+        name = _const_str(key) if key is not None else None
+        if name is None:
+            continue
+        if name == "type":
+            msg_type = _const_str(value)
+        else:
+            fields[name] = key.lineno
+    if msg_type is None:
+        return None
+    return msg_type, fields
+
+
+def _reads_of(body: Sequence[ast.AST], var: str) -> Dict[str, int]:
+    """Keys read from dict variable `var` via var.get("k") / var["k"]
+    anywhere under `body` -> {key: lineno}."""
+    out: Dict[str, int] = {}
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == var
+                and node.args
+            ):
+                key = _const_str(node.args[0])
+                if key is not None:
+                    out.setdefault(key, node.lineno)
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == var
+            ):
+                key = _const_str(node.slice)
+                if key is not None:
+                    out.setdefault(key, node.lineno)
+    return out
+
+
+class _SendSite:
+    def __init__(self, msg_type: str, fields: Dict[str, int],
+                 roles: Set[str], line: int, func: str):
+        self.msg_type = msg_type
+        self.fields = fields  # field -> lineno
+        self.roles = roles  # receiving roles
+        self.line = line
+        self.func = func
+
+
+class _HandlerArm:
+    def __init__(self, msg_type: str, reads: Dict[str, int],
+                 roles: Set[str], line: int, func: str):
+        self.msg_type = msg_type
+        self.reads = reads  # field -> lineno
+        self.roles = roles  # roles that run this handler
+        self.line = line
+        self.func = func
+
+
+def extract_send_sites(tree: ast.Module) -> List[_SendSite]:
+    """Every dict literal with a "type" key flowing into a
+    config.FLEET_SEND_FUNCS call — directly or through one local
+    assignment (`bye = {...}; self._send(rank, bye)`)."""
+    sites: List[_SendSite] = []
+    for fname, func in _iter_funcs(tree):
+        local_dicts: Dict[str, ast.Dict] = {}
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Dict)
+            ):
+                local_dicts[node.targets[0].id] = node.value
+        for node in ast.walk(func):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in config.FLEET_SEND_FUNCS
+            ):
+                continue
+            if node.func.attr == "_send":
+                if len(node.args) < 2:
+                    continue
+                target, payload = node.args[0], node.args[1]
+                if (
+                    isinstance(target, ast.Constant)
+                    and target.value == 0
+                ):
+                    roles = {"lead"}
+                else:
+                    roles = {"lead", "remote"}
+            else:  # _broadcast: the lead fans out to every remote
+                if not node.args:
+                    continue
+                payload, roles = node.args[0], {"remote"}
+            if isinstance(payload, ast.Name):
+                payload = local_dicts.get(payload.id)
+            if not isinstance(payload, ast.Dict):
+                continue
+            parsed = _dict_fields(payload)
+            if parsed is None:
+                continue
+            msg_type, fields = parsed
+            sites.append(
+                _SendSite(msg_type, fields, roles, node.lineno, fname)
+            )
+    return sites
+
+
+def _walk_bodies(stmts: Sequence[ast.AST]):
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            yield node
+
+
+def _arm_roles(fname: str) -> Set[str]:
+    if fname in config.FLEET_LEAD_FUNCS:
+        return {"lead"}
+    if fname in config.FLEET_REMOTE_FUNCS:
+        return {"remote"}
+    return {"lead", "remote"}
+
+
+def extract_handler_arms(tree: ast.Module) -> List[_HandlerArm]:
+    """Every dispatch arm: `kind = msg.get("type")` equality compares
+    (the `_handle` chain) plus direct `x.get("type") == "lit"` compares
+    (`_reader`'s bye, `_start_lead`'s hello). An arm's field reads are
+    the dispatch variable's reads in the arm body, plus — one level
+    deep — the reads of any method the arm forwards the message to."""
+    methods = dict(_iter_funcs(tree))
+    arms: List[_HandlerArm] = []
+    for fname, func in _iter_funcs(tree):
+        roles = _arm_roles(fname)
+        # Dispatch variables: kind = <msg>.get("type").
+        kind_vars: Dict[str, str] = {}  # kind var -> msg var
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "get"
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.args
+                and _const_str(node.value.args[0]) == "type"
+            ):
+                kind_vars[node.targets[0].id] = node.value.func.value.id
+
+        def _compare_arm(test: ast.AST) -> Optional[Tuple[str, str]]:
+            """An If test of the form `kind == "t"` / `x.get("type") ==
+            "t"` (Eq or NotEq) -> (msg var, msg type)."""
+            for cmp_node in ast.walk(test):
+                if not (
+                    isinstance(cmp_node, ast.Compare)
+                    and len(cmp_node.ops) == 1
+                    and isinstance(cmp_node.ops[0], (ast.Eq, ast.NotEq))
+                ):
+                    continue
+                left, right = cmp_node.left, cmp_node.comparators[0]
+                lit = _const_str(right)
+                if lit is None:
+                    continue
+                if (
+                    isinstance(left, ast.Name)
+                    and left.id in kind_vars
+                ):
+                    return kind_vars[left.id], lit
+                if (
+                    isinstance(left, ast.Call)
+                    and isinstance(left.func, ast.Attribute)
+                    and left.func.attr == "get"
+                    and isinstance(left.func.value, ast.Name)
+                    and left.args
+                    and _const_str(left.args[0]) == "type"
+                ):
+                    return left.func.value.id, lit
+            return None
+
+        for node in ast.walk(func):
+            if not isinstance(node, ast.If):
+                continue
+            arm = _compare_arm(node.test)
+            if arm is None:
+                continue
+            msg_var, msg_type = arm
+            # NotEq arms ("bad hello" guards) read fields in the rest
+            # of the FUNCTION, not the If body; approximate both shapes
+            # by scanning the whole function for the message var.
+            reads = _reads_of([func], msg_var)
+            reads.pop("type", None)
+            # One-level delegation: self._on_x(..., msg) pulls in the
+            # target method's reads of its corresponding parameter.
+            # Scan the arm's BODY only — an elif chain is nested Ifs in
+            # `orelse`, and walking the whole node would smear every
+            # later arm's delegate into this one.
+            for call in _walk_bodies(node.body):
+                if not (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == "self"
+                    and call.func.attr in methods
+                ):
+                    continue
+                for pos, arg in enumerate(call.args):
+                    if (
+                        isinstance(arg, ast.Name)
+                        and arg.id == msg_var
+                    ):
+                        target_fn = methods[call.func.attr]
+                        params = [
+                            a.arg for a in target_fn.args.args
+                            if a.arg != "self"
+                        ]
+                        if pos < len(params):
+                            inner = _reads_of([target_fn], params[pos])
+                            inner.pop("type", None)
+                            reads.update(inner)
+            arms.append(
+                _HandlerArm(msg_type, reads, roles, node.lineno, fname)
+            )
+    return arms
+
+
+# ---------------------------------------------------------------------------
+# FLEET-MSG-PARITY
+
+
+class FleetMsgParityRule:
+    """Fleet control-plane sends and handlers agree on message types and
+    field sets, per role (lead vs remote)."""
+
+    name = "FLEET-MSG-PARITY"
+
+    def check_repo(self, root: str,
+                   contexts: Sequence[FileContext]) -> List[Finding]:
+        ctx = next(
+            (c for c in contexts if c.path == config.FLEET_COORDINATOR),
+            None,
+        )
+        if ctx is None:
+            return []  # partial scan without the anchor
+        findings: List[Finding] = []
+        sends = extract_send_sites(ctx.tree)
+        arms = extract_handler_arms(ctx.tree)
+        standard = set(config.FLEET_MSG_STANDARD_FIELDS)
+
+        sent_types = {s.msg_type for s in sends}
+        arm_types = {a.msg_type for a in arms}
+
+        for site in sends:
+            receivers = [
+                a for a in arms
+                if a.msg_type == site.msg_type and a.roles & site.roles
+            ]
+            if not receivers:
+                role_txt = "/".join(sorted(site.roles))
+                findings.append(Finding(
+                    self.name, ctx.path, site.line,
+                    f"message type {site.msg_type!r} is sent "
+                    f"(in {site.func}) but no {role_txt}-side handler "
+                    "dispatches on it",
+                ))
+                continue
+            read_fields = set()
+            for a in receivers:
+                read_fields |= set(a.reads)
+            for field in sorted(set(site.fields) - read_fields - standard):
+                findings.append(Finding(
+                    self.name, ctx.path, site.fields[field],
+                    f"send site of {site.msg_type!r} (in {site.func}) "
+                    f"packs field {field!r} that no handler of that "
+                    "type reads",
+                ))
+
+        for arm in arms:
+            senders = [
+                s for s in sends
+                if s.msg_type == arm.msg_type and s.roles & arm.roles
+            ]
+            if not senders:
+                findings.append(Finding(
+                    self.name, ctx.path, arm.line,
+                    f"handler arm for message type {arm.msg_type!r} "
+                    f"(in {arm.func}) but no send site produces it",
+                ))
+                continue
+            packed = set()
+            for s in senders:
+                packed |= set(s.fields)
+            for field in sorted(set(arm.reads) - packed - standard):
+                findings.append(Finding(
+                    self.name, ctx.path, arm.reads[field],
+                    f"handler of {arm.msg_type!r} (in {arm.func}) reads "
+                    f"field {field!r} that no send site of that type "
+                    "packs (the read always hits its default)",
+                ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# FLEET-TIMEOUT-DISCIPLINE
+
+
+class FleetTimeoutRule:
+    """Blocking control-plane operations under fleet/ are deadline-
+    bounded or carry `# unbounded-by-design: <why>`."""
+
+    name = "FLEET-TIMEOUT-DISCIPLINE"
+
+    def check_repo(self, root: str,
+                   contexts: Sequence[FileContext]) -> List[Finding]:
+        findings: List[Finding] = []
+        for ctx in contexts:
+            if ctx.is_cxx or not ctx.path.startswith(
+                config.FLEET_TIMEOUT_PATHS
+            ):
+                continue
+            findings.extend(self._check_file(ctx))
+        return findings
+
+    def _annotation(self, ctx: FileContext,
+                    line: int) -> Optional[Tuple[int, str]]:
+        """The unbounded-by-design annotation covering `line`:
+        trailing on the line itself, or a standalone comment above."""
+        for cand in (line, line - 1):
+            text = ctx.comments.get(cand)
+            if text is None:
+                continue
+            if cand == line - 1 and not ctx.comment_only(cand):
+                continue
+            m = _UNBOUNDED_RE.search(text)
+            if m:
+                return cand, m.group(1).strip()
+        return None
+
+    def _flag(self, ctx: FileContext, node: ast.AST, what: str,
+              findings: List[Finding]) -> None:
+        ann = self._annotation(ctx, node.lineno)
+        if ann is None:
+            findings.append(Finding(
+                self.name, ctx.path, node.lineno,
+                f"{what} with no deadline — bound it or annotate the "
+                "contract that bounds it "
+                "(`# unbounded-by-design: <why>`)",
+            ))
+        elif not ann[1]:
+            findings.append(Finding(
+                self.name, ctx.path, ann[0],
+                "unbounded-by-design annotation without a reason "
+                "(write `# unbounded-by-design: <why>`)",
+            ))
+
+    def _check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fname, func in _iter_funcs(ctx.tree):
+            # Does this function ever arm a finite socket timeout?
+            has_settimeout = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "settimeout"
+                and n.args
+                and not (
+                    isinstance(n.args[0], ast.Constant)
+                    and n.args[0].value is None
+                )
+                for n in ast.walk(func)
+            )
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    attr = fn.attr
+                    if (
+                        attr == "settimeout"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value is None
+                    ):
+                        self._flag(ctx, node,
+                                   "settimeout(None) (socket made "
+                                   "blocking forever)", findings)
+                    elif attr == "accept" and not has_settimeout:
+                        self._flag(ctx, node,
+                                   "accept() on a socket this function "
+                                   "never arms a timeout on", findings)
+                    elif (
+                        attr == "recv"
+                        and not node.args
+                        and not has_settimeout
+                    ):
+                        self._flag(ctx, node,
+                                   "recv() on a transport this "
+                                   "function never arms a timeout on",
+                                   findings)
+                    elif attr in ("wait", "wait_for") and not (
+                        node.args or node.keywords
+                    ):
+                        self._flag(ctx, node,
+                                   f"{attr}() with no timeout",
+                                   findings)
+                    elif attr == "join" and not (
+                        node.args or node.keywords
+                    ):
+                        self._flag(ctx, node, "join() with no timeout",
+                                   findings)
+                name = None
+                if isinstance(fn, ast.Name):
+                    name = fn.id
+                elif isinstance(fn, ast.Attribute):
+                    name = fn.attr
+                if name in config.FLEET_DIAL_FUNCS:
+                    bounded = len(node.args) >= 2 or any(
+                        k.arg == "deadline_s" for k in node.keywords
+                    )
+                    if not bounded:
+                        self._flag(ctx, node,
+                                   f"{name}() without deadline_s "
+                                   "(unbounded redial)", findings)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TELEMETRY-SCHEMA
+
+
+_KINDS = ("counter", "gauge", "histogram")
+# layer.noun[_noun]: lowercase/digit/underscore segments, >= 2 deep.
+# `*` is the wildcard a dynamic f-string segment collapses to.
+_SEGMENT_RE = re.compile(r"^[a-z0-9_*]+$")
+_FOLD_PREFIX_RE = re.compile(r"^host(\d+|\*)$")
+
+
+def _series_pattern(node: ast.AST) -> Optional[str]:
+    """A registration/consumption name argument -> the series name, with
+    every dynamic f-string piece collapsed to `*`. None when the name is
+    not statically visible at all (a plain variable)."""
+    lit = _const_str(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _valid_series(pattern: str) -> bool:
+    segments = pattern.split(".")
+    return len(segments) >= 2 and all(
+        seg and _SEGMENT_RE.match(seg) for seg in segments
+    )
+
+
+def _segments_overlap(a: List[str], b: List[str]) -> bool:
+    """Can the two dotted patterns name the same series? A bare `*`
+    segment matches one-or-more segments of the other side (an f-string
+    hole can expand to a dotted name); a partial-wildcard segment
+    (`host*`) matches a single segment."""
+    if not a and not b:
+        return True
+    if not a or not b:
+        return False
+    a0, b0 = a[0], b[0]
+    if a0 == "*" or b0 == "*":
+        if _segments_overlap(a[1:], b[1:]):
+            return True
+        if a0 == "*" and _segments_overlap(a, b[1:]):
+            return True
+        if b0 == "*" and _segments_overlap(a[1:], b):
+            return True
+        return False
+    import fnmatch
+
+    if not (
+        fnmatch.fnmatchcase(a0, b0) or fnmatch.fnmatchcase(b0, a0)
+    ):
+        return False
+    return _segments_overlap(a[1:], b[1:])
+
+
+def patterns_overlap(a: str, b: str) -> bool:
+    return _segments_overlap(a.split("."), b.split("."))
+
+
+def extract_registrations(
+    tree: ast.Module,
+) -> List[Tuple[str, str, int]]:
+    """Every reg.counter/gauge/histogram call with a statically visible
+    name -> (pattern, kind, lineno)."""
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _KINDS
+            and node.args
+        ):
+            continue
+        pattern = _series_pattern(node.args[0])
+        if pattern is not None:
+            out.append((pattern, node.func.attr, node.lineno))
+    return out
+
+
+def _is_telemetry_receiver(node: ast.AST) -> bool:
+    """Does the receiver expression plainly hold a counters / gauges /
+    histograms mapping (`counters.get(...)`, `snap["gauges"][...]`)?"""
+    for sub in ast.walk(node):
+        text = None
+        if isinstance(sub, ast.Name):
+            text = sub.id
+        elif isinstance(sub, ast.Attribute):
+            text = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            text = sub.value
+        if text and any(k in text.lower() for k in _KINDS):
+            return True
+    return False
+
+
+def extract_consumptions(tree: ast.Module) -> Dict[str, int]:
+    """Series names a consumer file commits to: .get()/[...] reads on a
+    telemetry mapping, plus the keys of `expected`-style dict literals
+    in functions that sweep a telemetry mapping with a variable key."""
+    out: Dict[str, int] = {}
+
+    def _note(node: ast.AST, lineno: int) -> None:
+        pattern = _series_pattern(node)
+        if pattern is not None and _valid_series(pattern):
+            out.setdefault(pattern, lineno)
+
+    funcs = [f for _, f in _iter_funcs(tree)] or [tree]
+    for func in funcs:
+        swept = False  # telemetry .get with a non-literal key
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and _is_telemetry_receiver(node.func.value)
+            ):
+                if _series_pattern(node.args[0]) is None:
+                    swept = True
+                else:
+                    _note(node.args[0], node.lineno)
+            elif (
+                isinstance(node, ast.Subscript)
+                and _is_telemetry_receiver(node.value)
+            ):
+                _note(node.slice, node.lineno)
+        if not swept:
+            continue
+        for node in ast.walk(func):
+            if isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        _note(key, key.lineno)
+            elif isinstance(node, ast.DictComp):
+                _note(node.key, node.key.lineno)
+    return out
+
+
+class TelemetrySchemaRule:
+    """Telemetry series names follow the grammar, register with one
+    instrument kind, and every consumed series has an emitter."""
+
+    name = "TELEMETRY-SCHEMA"
+
+    def check_repo(self, root: str,
+                   contexts: Sequence[FileContext]) -> List[Finding]:
+        findings: List[Finding] = []
+        # (pattern, kind) -> first (path, line); emitted patterns.
+        first_kind: Dict[str, Tuple[str, str, int]] = {}
+        emitted: List[str] = []
+        by_path = {c.path: c for c in contexts}
+        for ctx in contexts:
+            if ctx.is_cxx or not ctx.path.startswith(
+                config.TELEMETRY_SCAN_PATHS
+            ):
+                continue
+            for pattern, kind, line in extract_registrations(ctx.tree):
+                emitted.append(pattern)
+                if not _valid_series(pattern):
+                    findings.append(Finding(
+                        self.name, ctx.path, line,
+                        f"series name {pattern!r} violates the naming "
+                        "grammar (lowercase `layer.noun[_noun]` dotted "
+                        "segments, at least two deep)",
+                    ))
+                    continue
+                if (
+                    _FOLD_PREFIX_RE.match(pattern.split(".")[0])
+                    and ctx.path not in config.TELEMETRY_FOLD_FILES
+                ):
+                    findings.append(Finding(
+                        self.name, ctx.path, line,
+                        f"series {pattern!r} uses the `host<r>.` fold "
+                        "prefix, which is reserved to the lead's "
+                        "telemetry folder "
+                        f"({', '.join(config.TELEMETRY_FOLD_FILES)})",
+                    ))
+                prev = first_kind.get(pattern)
+                if prev is None:
+                    first_kind[pattern] = (kind, ctx.path, line)
+                elif prev[0] != kind:
+                    findings.append(Finding(
+                        self.name, ctx.path, line,
+                        f"series {pattern!r} registered as {kind} here "
+                        f"but as {prev[0]} at {prev[1]}:{prev[2]} — the "
+                        "registry raises on the kind conflict at "
+                        "runtime",
+                    ))
+
+        # Consumed-but-never-emitted: only when the scan plainly covers
+        # the tree (the sentinel and every consumer file in scope).
+        scan_complete = (
+            config.TELEMETRY_SENTINEL_FILE in by_path
+            and all(
+                path in by_path
+                for path in config.TELEMETRY_CONSUMER_FILES
+            )
+        )
+        if scan_complete:
+            for path in config.TELEMETRY_CONSUMER_FILES:
+                ctx = by_path[path]
+                for pattern, line in sorted(
+                    extract_consumptions(ctx.tree).items()
+                ):
+                    if not any(
+                        patterns_overlap(pattern, e) for e in emitted
+                    ):
+                        findings.append(Finding(
+                            self.name, ctx.path, line,
+                            f"series {pattern!r} is consumed here but "
+                            "no scanned code registers it (emitter "
+                            "renamed or removed?)",
+                        ))
+        return findings
+
+
+FLEET_RULES = [
+    FleetMsgParityRule(),
+    FleetTimeoutRule(),
+    TelemetrySchemaRule(),
+]

@@ -15,6 +15,7 @@ from . import (
     analyze_sources,
 )
 from .engine import FileContext, run_rules
+from .fleetrules import FLEET_RULES
 from .parity import (
     check_flag_parity,
     check_route_parity,
@@ -614,6 +615,138 @@ constexpr const char kSliceSeriesPrefix[] = "serving.slice.";
 '''
 
 
+# -- fleet fixtures (ISSUE 20) ----------------------------------------------
+
+# Seeded: a sent type with no handler ("claim"), a handled type never
+# sent ("grant"), and field skew both ways on "sync" (packs "extra"
+# nobody reads; the handler reads "missing" nobody packs).
+_FLEET_PARITY_POSITIVE = '''
+class Coordinator:
+    def _push(self):
+        self._send(0, {"type": "claim", "rank": 1, "epoch": 3})
+        self._broadcast({"type": "sync", "extra": 1, "round": 2})
+
+    def _handle(self, rank, msg):
+        kind = msg.get("type")
+        if kind == "grant":
+            pass
+        elif kind == "sync":
+            self._on_sync(msg)
+
+    def _on_sync(self, msg):
+        return msg.get("round"), msg.get("missing")
+'''
+
+_FLEET_PARITY_CLEAN = '''
+class Coordinator:
+    def _push(self):
+        self._broadcast({"type": "sync", "round": 2})
+
+    def _ack(self):
+        payload = {"type": "claim", "rank": 1, "epoch": 3}
+        self._send(0, payload)
+
+    def _handle(self, rank, msg):
+        kind = msg.get("type")
+        if kind == "claim":
+            self._on_claim(msg)
+        elif kind == "sync":
+            self._on_sync(msg)
+
+    def _on_claim(self, msg):
+        return msg.get("epoch")
+
+    def _on_sync(self, msg):
+        return msg["round"]
+'''
+
+# Seeded: settimeout(None), accept/recv with no armed timeout, a bare
+# cond wait, a deadline-less dial, and a reasonless annotation.
+_FLEET_TIMEOUT_POSITIVE = '''
+def serve(sock):
+    conn, _ = sock.accept()
+    conn.settimeout(None)
+    return conn
+
+def pump(t, cv):
+    msg = t.recv()
+    cv.wait()
+    return msg
+
+def dial(address):
+    return dial_transport(address)
+
+def drain(t):
+    # unbounded-by-design:
+    return t.recv()
+'''
+
+_FLEET_TIMEOUT_CLEAN = '''
+def serve(sock):
+    sock.settimeout(5.0)
+    conn, _ = sock.accept()
+    return conn
+
+def pump(t, cv):
+    # unbounded-by-design: reader EOF is this fixture's loss detector
+    msg = t.recv()
+    cv.wait(1.0)
+    return msg
+
+def dial(address):
+    return dial_transport(address, deadline_s=10.0)
+'''
+
+# Seeded: a name outside the `layer.noun` grammar, the reserved
+# `host<r>.` fold prefix outside the telemetry folder, and one name
+# registered under two instrument kinds.
+_TELEMETRY_POSITIVE = '''
+def setup(reg, rank):
+    reg.counter("BadName")
+    reg.gauge(f"host{rank}.inference.depth")
+    reg.counter("queue.depth")
+    reg.gauge("queue.depth")
+'''
+
+_TELEMETRY_CLEAN = '''
+def setup(reg, slice_index):
+    reg.counter("queue.items_in")
+    reg.gauge("queue.depth")
+    reg.histogram(f"inference.slice.{slice_index}.depth")
+'''
+
+# Consumption drift: the chaos verdict reads a counter nothing emits;
+# the telemetry test reads one that IS emitted (no finding). The
+# sentinel file must be present or the check stays off (partial scan).
+_TELEMETRY_CONSUME_POSITIVE = {
+    "torchbeast_tpu/telemetry/metrics.py": (
+        'def mk(reg):\n    reg.counter("recovery.server_restarts")\n'
+    ),
+    "scripts/chaos_run.py": (
+        "def verdict(counters):\n"
+        '    return counters.get("recovery.ghost_restarts", 0)\n'
+    ),
+    "tests/test_telemetry.py": (
+        "def check(snap):\n"
+        '    return snap["counters"]["recovery.server_restarts"]\n'
+    ),
+}
+
+_TELEMETRY_CONSUME_CLEAN = {
+    "torchbeast_tpu/telemetry/metrics.py": (
+        'def mk(reg):\n    reg.counter("recovery.server_restarts")\n'
+    ),
+    "scripts/chaos_run.py": (
+        "def verdict(counters):\n"
+        '    return counters.get("recovery.server_restarts", 0)\n'
+    ),
+    "tests/test_telemetry.py": (
+        "def check(snap):\n"
+        '    return snap["counters"]["recovery.server_restarts"]\n'
+    ),
+}
+
+
 def run_selftest() -> dict:
     t0 = time.perf_counter()
     rules: dict = {}
@@ -699,6 +832,54 @@ def run_selftest() -> dict:
                 f.rule == name for f in pos_report.findings
             ),
         }
+
+    # Fleet rules are repo rules over plain Python contexts; the paths
+    # matter (FLEET-MSG-PARITY anchors on the real coordinator path,
+    # FLEET-TIMEOUT-DISCIPLINE only scans under fleet/).
+    fleet_pairs = {
+        "FLEET-MSG-PARITY": (
+            _FLEET_PARITY_POSITIVE, _FLEET_PARITY_CLEAN,
+            "torchbeast_tpu/fleet/coordinator.py",
+        ),
+        "FLEET-TIMEOUT-DISCIPLINE": (
+            _FLEET_TIMEOUT_POSITIVE, _FLEET_TIMEOUT_CLEAN,
+            "torchbeast_tpu/fleet/fixture_ctl.py",
+        ),
+        "TELEMETRY-SCHEMA": (
+            _TELEMETRY_POSITIVE, _TELEMETRY_CLEAN,
+            "torchbeast_tpu/runtime/fixture_tele.py",
+        ),
+    }
+    for name, (positive, clean, path) in fleet_pairs.items():
+        pos_report = analyze_sources(
+            {path: positive}, repo_rules=list(FLEET_RULES)
+        )
+        clean_report = analyze_sources(
+            {path: clean}, repo_rules=list(FLEET_RULES)
+        )
+        rules[name] = {
+            "positive": any(f.rule == name for f in pos_report.findings),
+            "clean": not any(
+                f.rule == name for f in clean_report.findings
+            ),
+            "isolated": all(
+                f.rule == name for f in pos_report.findings
+            ),
+        }
+
+    # TELEMETRY-SCHEMA's consumption check only arms on a full scan
+    # (sentinel + both consumer files present) — exercise it with a
+    # multi-file program where the chaos verdict reads a ghost series.
+    consume_pos = analyze_sources(
+        _TELEMETRY_CONSUME_POSITIVE, repo_rules=list(FLEET_RULES)
+    )
+    consume_clean = analyze_sources(
+        _TELEMETRY_CONSUME_CLEAN, repo_rules=list(FLEET_RULES)
+    )
+    rules["TELEMETRY-SCHEMA"]["positive"] &= any(
+        f.rule == "TELEMETRY-SCHEMA" for f in consume_pos.findings
+    )
+    rules["TELEMETRY-SCHEMA"]["clean"] &= not consume_clean.findings
 
     wire_ctx = FileContext("torchbeast_tpu/runtime/wire.py", _WIRE_PY)
     drifted = check_wire_parity(

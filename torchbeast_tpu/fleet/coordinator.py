@@ -174,6 +174,12 @@ class FleetCoordinator:
         self._c_snap_stale = reg.counter("fleet.snapshots_stale_dropped")
         self._c_syncs = reg.counter("fleet.param_syncs")
         self._c_sync_timeouts = reg.counter("fleet.param_sync_timeouts")
+        # Hosts whose params the last fleet mean averaged (the lead
+        # packs it as "n"; a degraded round shows up as n < fleet).
+        self._g_sync_contribs = reg.gauge("fleet.param_sync_contribs")
+        # Control-plane messages whose type no dispatch arm knows —
+        # nonzero means a version-skewed peer, not just a log line.
+        self._c_unknown = reg.counter("fleet.unknown_msgs")
         self._reg = reg
 
         self._lock = threading.Lock()
@@ -297,6 +303,10 @@ class FleetCoordinator:
                 t.close()
                 continue
             rank = int(hello["rank"])
+            # Reader sockets idle for unbounded stretches between
+            # control messages; a per-recv deadline would fault
+            # idle-but-healthy hosts.
+            # unbounded-by-design: loss detection is reader-EOF plus the heartbeat plane, not a recv deadline
             conn.settimeout(None)
             with self._lock:
                 if rank in self._conns:
@@ -379,6 +389,7 @@ class FleetCoordinator:
         why = "connection closed"
         try:
             while not self._closing.is_set():
+                # unbounded-by-design: this blocking recv IS the loss detector — EOF/error here drives _on_host_lost/_on_lead_lost
                 msg = t.recv()
                 if msg is None:
                     break  # EOF at a frame boundary
@@ -427,6 +438,7 @@ class FleetCoordinator:
                 self._done.add(rank)
                 self._cv.notify_all()
         else:
+            self._c_unknown.inc()
             log.warning("fleet: unknown message type %r", kind)
 
     # -- health plane ------------------------------------------------------
@@ -470,6 +482,12 @@ class FleetCoordinator:
             )
 
     def _on_verdict(self, msg: dict) -> None:
+        live = msg.get("live")
+        if live is not None:
+            # The lead's fleet-wide live count: fold it into this
+            # host's gauge so remote dashboards agree with the lead
+            # (locally a remote only knows lead-reachable yes/no).
+            self._g_live.set(int(live))
         states = msg.get("states") or {}
         folds = []
         with self._lock:
@@ -718,6 +736,9 @@ class FleetCoordinator:
         if not isinstance(leaves, list):
             log.warning("fleet: bad params_mean message")
             return
+        # How many hosts the round actually averaged: n < fleet size
+        # means the barrier degraded (timeout / loss) on the lead.
+        self._g_sync_contribs.set(int(msg.get("n", 0)))
         copied = [np.array(a, copy=True) for a in leaves]
         with self._lock:
             self._mean_leaves = copied
